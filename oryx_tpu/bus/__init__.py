@@ -1,0 +1,27 @@
+"""Pluggable message bus: the framework's transport between layers.
+
+Replaces the reference's Kafka (transport) + ZooKeeper (offset/coordination)
+pairing (SURVEY.md §2.2, §2.12) with a broker abstraction:
+
+- ``inproc://<name>``  — in-process broker, the cornerstone test asset
+  (analogue of the reference's embedded LocalKafkaBroker/LocalZKServer).
+- ``file:/<dir>``      — file-backed broker for cross-process single-host
+  deployments: append-only partition logs plus a per-group offset ledger.
+
+Topics have partitions; messages are (key, message) string pairs routed by
+key hash; consumer groups persist offsets so layers resume where they left
+off (reference: KafkaUtils.getOffsets/setOffsets, KafkaUtils.java:123-162).
+"""
+
+from oryx_tpu.bus.core import (  # noqa: F401
+    KeyMessage,
+    TopicProducer,
+    TopicConsumer,
+    Broker,
+    get_broker,
+    maybe_create_topic,
+    topic_exists,
+    delete_topic,
+    get_offsets,
+    set_offsets,
+)

@@ -1,0 +1,183 @@
+"""Bus abstractions and the broker registry/factory.
+
+API surface mirrors the reference's messaging SPI (framework/oryx-api:
+KeyMessage.java, TopicProducer.java) and admin utils (framework/kafka-util/
+src/main/java/com/cloudera/oryx/kafka/util/KafkaUtils.java:42-190).
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+from dataclasses import dataclass
+from typing import Iterator
+
+
+@dataclass(frozen=True)
+class KeyMessage:
+    """A key/message pair (KeyMessage/KeyMessageImpl analogue)."""
+
+    key: str | None
+    message: str
+
+
+class TopicProducer(abc.ABC):
+    """Wraps access to one topic of a broker (TopicProducer.java)."""
+
+    @property
+    @abc.abstractmethod
+    def update_broker(self) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def topic(self) -> str: ...
+
+    @abc.abstractmethod
+    def send(self, key: str | None, message: str) -> None: ...
+
+    def send_message(self, message: str) -> None:
+        self.send(None, message)
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    def __enter__(self) -> "TopicProducer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class TopicConsumer(abc.ABC):
+    """Iterates KeyMessage records from a topic.
+
+    `poll(max_records, timeout)` returns possibly-empty batches;
+    iteration blocks until `close()` (like a Kafka consumer stream).
+    """
+
+    @abc.abstractmethod
+    def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]: ...
+
+    @abc.abstractmethod
+    def positions(self) -> dict[int, int]:
+        """Current partition -> next-offset map."""
+
+    @abc.abstractmethod
+    def commit(self) -> None:
+        """Persist current positions to the group offset ledger."""
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    @abc.abstractmethod
+    def closed(self) -> bool: ...
+
+    def __iter__(self) -> Iterator[KeyMessage]:
+        while not self.closed():
+            for rec in self.poll(timeout=0.2):
+                yield rec
+
+    def __enter__(self) -> "TopicConsumer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class Broker(abc.ABC):
+    """Topic admin + producer/consumer factory for one bus locator."""
+
+    @abc.abstractmethod
+    def create_topic(self, topic: str, partitions: int = 1, config: dict | None = None) -> None: ...
+
+    @abc.abstractmethod
+    def topic_exists(self, topic: str) -> bool: ...
+
+    @abc.abstractmethod
+    def delete_topic(self, topic: str) -> None: ...
+
+    @abc.abstractmethod
+    def producer(self, topic: str) -> TopicProducer: ...
+
+    @abc.abstractmethod
+    def consumer(
+        self,
+        topic: str,
+        group: str | None = None,
+        from_beginning: bool = False,
+    ) -> TopicConsumer:
+        """A consumer. With `group` set and offsets stored, resumes from the
+        stored offsets; `from_beginning=True` starts at offset 0 (the
+        update-topic replay path, SpeedLayer.java:107-121); otherwise starts
+        at the topic end (latest)."""
+
+    @abc.abstractmethod
+    def get_offsets(self, group: str, topic: str) -> dict[int, int]: ...
+
+    @abc.abstractmethod
+    def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None: ...
+
+    @abc.abstractmethod
+    def latest_offsets(self, topic: str) -> dict[int, int]: ...
+
+
+def partition_for(key: str | None, num_partitions: int) -> int:
+    if num_partitions <= 1:
+        return 0
+    if key is None:
+        return 0
+    h = hashlib.md5(key.encode("utf-8")).digest()
+    return int.from_bytes(h[:4], "big") % num_partitions
+
+
+# ---------------------------------------------------------------------------
+# Broker factory
+# ---------------------------------------------------------------------------
+
+
+def get_broker(locator: str) -> Broker:
+    """Resolve a bus locator to a Broker.
+
+    inproc://<name> — process-local named broker (tests, single-process runs)
+    file:/<dir> or file://<dir> or a bare path — file-backed broker
+    """
+    if locator.startswith("inproc://"):
+        from oryx_tpu.bus.inproc import InProcessBroker
+
+        return InProcessBroker.named(locator[len("inproc://") :])
+    if locator.startswith("file:"):
+        path = locator[len("file:") :]
+        while path.startswith("//"):
+            path = path[1:]
+        from oryx_tpu.bus.filebus import FileBroker
+
+        return FileBroker(path)
+    # bare filesystem path
+    from oryx_tpu.bus.filebus import FileBroker
+
+    return FileBroker(locator)
+
+
+# -- KafkaUtils-style module-level admin helpers ----------------------------
+
+
+def maybe_create_topic(locator: str, topic: str, partitions: int = 1, config: dict | None = None) -> None:
+    get_broker(locator).create_topic(topic, partitions, config)
+
+
+def topic_exists(locator: str, topic: str) -> bool:
+    return get_broker(locator).topic_exists(topic)
+
+
+def delete_topic(locator: str, topic: str) -> None:
+    broker = get_broker(locator)
+    if broker.topic_exists(topic):
+        broker.delete_topic(topic)
+
+
+def get_offsets(locator: str, group: str, topic: str) -> dict[int, int]:
+    return get_broker(locator).get_offsets(group, topic)
+
+
+def set_offsets(locator: str, group: str, topic: str, offsets: dict[int, int]) -> None:
+    get_broker(locator).set_offsets(group, topic, offsets)

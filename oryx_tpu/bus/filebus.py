@@ -1,0 +1,263 @@
+"""File-backed broker: durable cross-process bus on a shared filesystem.
+
+The single-host production analogue of Kafka + ZooKeeper in the reference:
+each topic is a directory of append-only partition logs (one JSON record
+per line), and consumer-group offsets live in a ledger file per group —
+the rebuild of the reference's ZK offset storage (KafkaUtils.java:123-162)
+that makes layers resume where they left off. Appends are serialized with
+fcntl advisory locks so batch/speed/serving processes can share one bus
+directory. Multi-host deployments plug a real broker behind the same
+Broker interface.
+
+Layout:
+    <root>/<topic>/partition-<i>.log     one JSON line per record
+    <root>/<topic>/.meta.json            {"partitions": N, "config": {...}}
+    <root>/__offsets__/<group>.json      {"<topic>": {"0": 17, ...}}
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+from pathlib import Path
+
+from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, partition_for
+
+_OFFSETS_DIR = "__offsets__"
+
+
+class _Flock:
+    def __init__(self, path: Path) -> None:
+        self._path = path
+
+    def __enter__(self):
+        self._f = open(self._path, "a+")
+        fcntl.flock(self._f.fileno(), fcntl.LOCK_EX)
+        return self._f
+
+    def __exit__(self, *exc):
+        fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+        self._f.close()
+        return False
+
+
+class FileBroker(Broker):
+    def __init__(self, root: str) -> None:
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def locator(self) -> str:
+        return f"file:{self.root}"
+
+    # -- admin --------------------------------------------------------------
+
+    def _topic_dir(self, topic: str) -> Path:
+        return self.root / topic
+
+    def _meta_path(self, topic: str) -> Path:
+        return self._topic_dir(topic) / ".meta.json"
+
+    def create_topic(self, topic: str, partitions: int = 1, config: dict | None = None) -> None:
+        d = self._topic_dir(topic)
+        d.mkdir(parents=True, exist_ok=True)
+        meta = self._meta_path(topic)
+        if not meta.exists():
+            meta.write_text(json.dumps({"partitions": max(1, partitions), "config": config or {}}))
+            for i in range(max(1, partitions)):
+                (d / f"partition-{i}.log").touch()
+
+    def topic_exists(self, topic: str) -> bool:
+        return self._meta_path(topic).exists()
+
+    def delete_topic(self, topic: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._topic_dir(topic), ignore_errors=True)
+        off_dir = self.root / _OFFSETS_DIR
+        if off_dir.is_dir():
+            for ledger in off_dir.glob("*.json"):
+                with _Flock(ledger.with_suffix(".lock")):
+                    try:
+                        data = json.loads(ledger.read_text() or "{}")
+                    except json.JSONDecodeError:
+                        data = {}
+                    if topic in data:
+                        del data[topic]
+                        ledger.write_text(json.dumps(data))
+
+    def _num_partitions(self, topic: str) -> int:
+        try:
+            return int(json.loads(self._meta_path(topic).read_text())["partitions"])
+        except (OSError, json.JSONDecodeError, KeyError):
+            return 1
+
+    # -- offsets ------------------------------------------------------------
+
+    def _ledger_path(self, group: str) -> Path:
+        d = self.root / _OFFSETS_DIR
+        d.mkdir(parents=True, exist_ok=True)
+        return d / f"{group}.json"
+
+    def get_offsets(self, group: str, topic: str) -> dict[int, int]:
+        ledger = self._ledger_path(group)
+        if not ledger.exists():
+            return {}
+        with _Flock(ledger.with_suffix(".lock")):
+            try:
+                data = json.loads(ledger.read_text() or "{}")
+            except json.JSONDecodeError:
+                return {}
+        return {int(k): int(v) for k, v in data.get(topic, {}).items()}
+
+    def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None:
+        ledger = self._ledger_path(group)
+        with _Flock(ledger.with_suffix(".lock")):
+            try:
+                data = json.loads(ledger.read_text() or "{}") if ledger.exists() else {}
+            except json.JSONDecodeError:
+                data = {}
+            data.setdefault(topic, {}).update({str(k): int(v) for k, v in offsets.items()})
+            tmp = ledger.with_suffix(".tmp")
+            tmp.write_text(json.dumps(data))
+            os.replace(tmp, ledger)
+
+    def latest_offsets(self, topic: str) -> dict[int, int]:
+        out: dict[int, int] = {}
+        d = self._topic_dir(topic)
+        for i in range(self._num_partitions(topic)):
+            p = d / f"partition-{i}.log"
+            out[i] = _count_lines(p) if p.exists() else 0
+        return out
+
+    # -- produce/consume ----------------------------------------------------
+
+    def producer(self, topic: str) -> TopicProducer:
+        if not self.topic_exists(topic):
+            self.create_topic(topic, 1)
+        return _FileProducer(self, topic)
+
+    def consumer(
+        self, topic: str, group: str | None = None, from_beginning: bool = False
+    ) -> TopicConsumer:
+        if not self.topic_exists(topic):
+            self.create_topic(topic, 1)
+        return _FileConsumer(self, topic, group, from_beginning)
+
+
+def _count_lines(path: Path) -> int:
+    n = 0
+    with open(path, "rb") as f:
+        for _ in f:
+            n += 1
+    return n
+
+
+class _FileProducer(TopicProducer):
+    def __init__(self, broker: FileBroker, topic: str) -> None:
+        self._broker = broker
+        self._topic = topic
+        self._nparts = broker._num_partitions(topic)
+
+    @property
+    def update_broker(self) -> str:
+        return self._broker.locator()
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    def send(self, key: str | None, message: str) -> None:
+        p = partition_for(key, self._nparts)
+        path = self._broker._topic_dir(self._topic) / f"partition-{p}.log"
+        record = json.dumps({"k": key, "m": message}, separators=(",", ":"))
+        with _Flock(path.with_suffix(".lock")):
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(record + "\n")
+
+    def close(self) -> None:
+        pass
+
+
+class _FileConsumer(TopicConsumer):
+    def __init__(
+        self, broker: FileBroker, topic: str, group: str | None, from_beginning: bool
+    ) -> None:
+        self._broker = broker
+        self._topic = topic
+        self._group = group
+        self._closed = False
+        nparts = broker._num_partitions(topic)
+        stored = broker.get_offsets(group, topic) if group else {}
+        if stored:
+            self._pos = {i: stored.get(i, 0) for i in range(nparts)}
+        elif from_beginning:
+            self._pos = {i: 0 for i in range(nparts)}
+        else:
+            latest = broker.latest_offsets(topic)
+            self._pos = {i: latest.get(i, 0) for i in range(nparts)}
+        # byte position of record self._pos[i] in each log; established
+        # lazily (one O(n) scan per partition), then advanced incrementally
+        # so each poll seeks instead of re-reading the whole log.
+        self._byte: dict[int, int] = {}
+
+    def _seek_start(self, f, partition: int) -> None:
+        """Position f at record index self._pos[partition]."""
+        byte = self._byte.get(partition)
+        if byte is not None:
+            f.seek(byte)
+            return
+        for _ in range(self._pos[partition]):
+            if not f.readline():
+                break
+        self._byte[partition] = f.tell()
+
+    def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
+        deadline = time.monotonic() + timeout
+        while True:
+            out: list[KeyMessage] = []
+            d = self._broker._topic_dir(self._topic)
+            for i in sorted(self._pos):
+                path = d / f"partition-{i}.log"
+                if not path.exists():
+                    continue
+                scanned = 0  # complete records consumed this poll
+                with open(path, "rb") as f:
+                    self._seek_start(f, i)
+                    while True:
+                        raw = f.readline()
+                        if not raw:
+                            break
+                        if not raw.endswith(b"\n"):
+                            break  # partial tail of an in-flight append; retry
+                        scanned += 1
+                        self._byte[i] = f.tell()
+                        line = raw.decode("utf-8", errors="replace").strip()
+                        if line:
+                            try:
+                                rec = json.loads(line)
+                            except json.JSONDecodeError:
+                                continue  # corrupt complete line: skip it for good
+                            out.append(KeyMessage(rec.get("k"), rec.get("m", "")))
+                        if len(out) >= max_records:
+                            break
+                self._pos[i] += scanned
+                if len(out) >= max_records:
+                    return out
+            if out or self._closed or time.monotonic() >= deadline:
+                return out
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+    def positions(self) -> dict[int, int]:
+        return dict(self._pos)
+
+    def commit(self) -> None:
+        if self._group:
+            self._broker.set_offsets(self._group, self._topic, self._pos)
+
+    def close(self) -> None:
+        self._closed = True
+
+    def closed(self) -> bool:
+        return self._closed

@@ -1,0 +1,4 @@
+"""Common substrate: config, RNG, text wire formats, IO, concurrency, PMML.
+
+Rebuild of the reference's framework/oryx-common module (SURVEY.md §2.1).
+"""

@@ -1,0 +1,139 @@
+"""Bus tests: produce/consume, partitions, offsets, replay-from-zero
+(reference: ProduceConsumeIT, KafkaUtilsIT, LargeMessageIT)."""
+
+import threading
+
+import pytest
+
+from oryx_tpu import bus
+
+
+@pytest.fixture(params=["inproc", "file"])
+def locator(request, tmp_path):
+    if request.param == "inproc":
+        return "inproc://test-broker"
+    return f"file:{tmp_path}/bus"
+
+
+def test_topic_admin(locator):
+    assert not bus.topic_exists(locator, "T")
+    bus.maybe_create_topic(locator, "T", partitions=4)
+    assert bus.topic_exists(locator, "T")
+    bus.maybe_create_topic(locator, "T", partitions=4)  # idempotent
+    bus.delete_topic(locator, "T")
+    assert not bus.topic_exists(locator, "T")
+
+
+def test_produce_consume_from_beginning(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("In", partitions=2)
+    with broker.producer("In") as p:
+        for i in range(20):
+            p.send(f"k{i}", f"m{i}")
+    consumer = broker.consumer("In", from_beginning=True)
+    got = consumer.poll(max_records=100, timeout=1.0)
+    assert sorted(m.message for m in got) == sorted(f"m{i}" for i in range(20))
+    # keys preserved
+    by_key = {m.key: m.message for m in got}
+    assert by_key["k3"] == "m3"
+    consumer.close()
+
+
+def test_consumer_from_latest_sees_only_new(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", 1)
+    with broker.producer("T") as p:
+        p.send(None, "old")
+    consumer = broker.consumer("T")  # latest
+    with broker.producer("T") as p:
+        p.send(None, "new")
+    got = consumer.poll(timeout=1.0)
+    assert [m.message for m in got] == ["new"]
+    consumer.close()
+
+
+def test_group_offsets_resume(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", 2)
+    with broker.producer("T") as p:
+        for i in range(10):
+            p.send(f"k{i}", f"m{i}")
+    c1 = broker.consumer("T", group="g1", from_beginning=True)
+    first = c1.poll(max_records=100, timeout=1.0)
+    assert len(first) == 10
+    c1.commit()
+    c1.close()
+    # more data arrives
+    with broker.producer("T") as p:
+        for i in range(10, 15):
+            p.send(f"k{i}", f"m{i}")
+    # new consumer in same group resumes where c1 left off
+    c2 = broker.consumer("T", group="g1")
+    rest = c2.poll(max_records=100, timeout=1.0)
+    assert sorted(m.message for m in rest) == [f"m{i}" for i in range(10, 15)]
+    c2.close()
+
+
+def test_get_set_offsets_api(locator):
+    bus.maybe_create_topic(locator, "T", 2)
+    bus.set_offsets(locator, "grp", "T", {0: 5, 1: 7})
+    assert bus.get_offsets(locator, "grp", "T") == {0: 5, 1: 7}
+
+
+def test_large_message(locator):
+    # reference LargeMessageIT sends ~16MB messages through the update topic
+    broker = bus.get_broker(locator)
+    broker.create_topic("U", 1)
+    big = "x" * (1 << 20)
+    with broker.producer("U") as p:
+        p.send("MODEL", big)
+    got = broker.consumer("U", from_beginning=True).poll(timeout=1.0)
+    assert got[0].key == "MODEL"
+    assert len(got[0].message) == len(big)
+
+
+def test_blocking_poll_wakes_on_send():
+    locator = "inproc://wake-test"
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", 1)
+    consumer = broker.consumer("T", from_beginning=True)
+    result = []
+
+    def consume():
+        result.extend(consumer.poll(timeout=5.0))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    with broker.producer("T") as p:
+        p.send(None, "ping")
+    t.join(timeout=5.0)
+    assert [m.message for m in result] == ["ping"]
+    consumer.close()
+
+
+def test_file_bus_cross_instance(tmp_path):
+    # two FileBroker instances over the same dir see each other's writes
+    loc = f"file:{tmp_path}/shared"
+    b1 = bus.get_broker(loc)
+    b2 = bus.get_broker(loc)
+    b1.create_topic("T", 1)
+    with b1.producer("T") as p:
+        p.send("a", "1")
+    got = b2.consumer("T", from_beginning=True).poll(timeout=1.0)
+    assert [(m.key, m.message) for m in got] == [("a", "1")]
+
+
+def test_file_consumer_incremental_polls_no_dupes(tmp_path):
+    loc = f"file:{tmp_path}/bus"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    c = broker.consumer("T", from_beginning=True)
+    seen = []
+    with broker.producer("T") as p:
+        for batch in range(5):
+            for i in range(10):
+                p.send(None, f"b{batch}-m{i}")
+            seen.extend(m.message for m in c.poll(max_records=100, timeout=1.0))
+    assert len(seen) == 50
+    assert len(set(seen)) == 50
+    c.close()

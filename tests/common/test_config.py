@@ -1,0 +1,140 @@
+"""Config system tests (reference: ConfigUtilsTest, ConfigToPropertiesTest)."""
+
+import pytest
+
+from oryx_tpu.common import config as C
+
+
+def test_parse_basic_types():
+    cfg = C.from_string(
+        """
+        a = 1
+        b = 2.5
+        c = true
+        d = null
+        e = "hello"
+        f = unquoted-string
+        """
+    )
+    assert cfg.get_int("a") == 1
+    assert cfg.get_float("b") == 2.5
+    assert cfg.get_bool("c") is True
+    assert cfg.get("d") is None
+    assert cfg.get_string("e") == "hello"
+    assert cfg.get_string("f") == "unquoted-string"
+
+
+def test_nested_and_dotted_keys_merge():
+    cfg = C.from_string(
+        """
+        oryx {
+          batch { generation-interval-sec = 300 }
+        }
+        oryx.batch.update-class = "my.mod:Cls"
+        oryx { speed = { x = 1 } }
+        """
+    )
+    assert cfg.get_int("oryx.batch.generation-interval-sec") == 300
+    assert cfg.get_string("oryx.batch.update-class") == "my.mod:Cls"
+    assert cfg.get_int("oryx.speed.x") == 1
+
+
+def test_lists_and_comments():
+    cfg = C.from_string(
+        """
+        # comment
+        names = [ "a", "b", "c" ]  // trailing comment
+        nums = [1, 2, 3]
+        """
+    )
+    assert cfg.get_strings("names") == ["a", "b", "c"]
+    assert cfg.get_list("nums") == [1, 2, 3]
+
+
+def test_substitution_and_concat():
+    cfg = C.from_string(
+        """
+        base = "/data/oryx"
+        brokers = "b1:9092"
+        oryx {
+          input-topic.broker = ${brokers}
+          batch.storage.data-dir = ${base}"/data/"
+        }
+        """
+    )
+    assert cfg.get_string("oryx.input-topic.broker") == "b1:9092"
+    assert cfg.get_string("oryx.batch.storage.data-dir") == "/data/oryx/data/"
+
+
+def test_optional_substitution():
+    cfg = C.from_string("a = ${?nope}\nb = 2")
+    assert cfg.get("a") is None
+    assert cfg.get_int("b") == 2
+
+
+def test_unresolvable_substitution_raises():
+    with pytest.raises(C.ConfigError):
+        C.from_string("a = ${definitely.not.there}")
+
+
+def test_optional_getters_null_and_missing():
+    cfg = C.from_string("a = null\nlst = null\ncsv = \"x,y\"")
+    assert cfg.get_optional_string("a") is None
+    assert cfg.get_optional_string("zzz") is None
+    assert cfg.get_optional_strings("lst") is None
+    assert cfg.get_optional_strings("csv") == ["x", "y"]
+    assert not cfg.has("a")
+    assert not cfg.has("zzz")
+
+
+def test_overlay_precedence():
+    base = C.from_string("x = 1\nsub { a = 1\n b = 2 }")
+    merged = base.with_overlay("sub { a = 10 }")
+    assert merged.get_int("sub.a") == 10
+    assert merged.get_int("sub.b") == 2
+    assert merged.get_int("x") == 1
+    # original untouched
+    assert base.get_int("sub.a") == 1
+
+
+def test_serialize_round_trip():
+    cfg = C.from_string("oryx { id = \"foo\"\n n = 3 }")
+    text = cfg.serialize()
+    again = C.from_string(text)
+    assert again.get_string("oryx.id") == "foo"
+    assert again.get_int("oryx.n") == 3
+
+
+def test_get_default_loads_reference_conf():
+    cfg = C.get_default()
+    assert cfg.get_int("oryx.update-topic.message.max-size") == 16777216
+    assert cfg.get_int("oryx.batch.streaming.generation-interval-sec") == 21600
+    assert cfg.get_float("oryx.ml.eval.test-fraction") == 0.1
+    # app tier defaults merged too
+    assert cfg.get_int("oryx.als.hyperparams.features") == 10
+    assert cfg.get_string("oryx.rdf.hyperparams.impurity") == "entropy"
+
+
+def test_to_properties():
+    cfg = C.from_string("a { b = 1\n c = true }")
+    props = cfg.to_properties()
+    assert props == {"a.b": "1", "a.c": "true"}
+
+
+def test_key_value_to_properties():
+    assert C.key_value_to_properties("a", 1, "b", "x") == {"a": "1", "b": "x"}
+
+
+def test_serialize_non_ascii_round_trip():
+    cfg = C.from_string('name = "café"')
+    assert C.from_string(cfg.serialize()).get_string("name") == "café"
+
+
+def test_overlay_substitution_references_base():
+    base = C.from_string("a = 5")
+    merged = base.with_overlay("b = ${a}")
+    assert merged.get_int("b") == 5
+
+
+def test_literal_dollar_in_unquoted_value():
+    assert C.from_string("v = ab$cd").get_string("v") == "ab$cd"

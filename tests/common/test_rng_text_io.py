@@ -1,0 +1,60 @@
+"""Tests for rng, text wire formats, io utils (reference: RandomManagerTest,
+TextUtilsTest, IOUtilsTest)."""
+
+import numpy as np
+
+from oryx_tpu.common import io_utils, rng, text
+
+
+def test_test_seed_deterministic():
+    rng.use_test_seed()
+    a = rng.get_random().standard_normal(5)
+    rng.use_test_seed()
+    b = rng.get_random().standard_normal(5)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_distinct_generators_differ():
+    g1 = rng.get_random()
+    g2 = rng.get_random()
+    assert not np.array_equal(g1.standard_normal(8), g2.standard_normal(8))
+
+
+def test_parse_csv_and_json_lines():
+    assert text.parse_line("a,1,2.5") == ["a", "1", "2.5"]
+    assert text.parse_line('["a",1,2.5]') == ["a", "1", "2.5"]
+    assert text.parse_line('["x",[1,2],["y"]]') == ["x", "[1, 2]", '["y"]']
+
+
+def test_csv_quoting_round_trip():
+    row = ["a,b", 'he said "hi"', "plain"]
+    joined = text.join_csv(row)
+    assert text.parse_csv(joined) == ["a,b", 'he said "hi"', "plain"]
+
+
+def test_join_json_compact_and_nan():
+    s = text.join_json(["X", "u1", [0.5, 1.0], ["i1"]])
+    assert s == '["X","u1",[0.5,1.0],["i1"]]'
+    assert "NaN" in text.join_json([float("nan")])
+
+
+def test_join_json_numpy():
+    s = text.join_json(["Y", "i1", np.asarray([1.0, 2.0], dtype=np.float32)])
+    assert s == '["Y","i1",[1.0,2.0]]'
+
+
+def test_choose_free_port_and_delete(tmp_path):
+    port = io_utils.choose_free_port()
+    assert 1024 <= port <= 65535
+    d = tmp_path / "x" / "y"
+    io_utils.mkdirs(d)
+    (d / "f.txt").write_text("hi")
+    io_utils.delete_recursively(tmp_path / "x")
+    assert not (tmp_path / "x").exists()
+
+
+def test_list_files_glob(tmp_path):
+    for name in ["a.data", "b.data", "c.txt"]:
+        (tmp_path / name).write_text("")
+    files = io_utils.list_files(tmp_path, "*.data")
+    assert [f.name for f in files] == ["a.data", "b.data"]

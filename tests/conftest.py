@@ -1,0 +1,39 @@
+"""Test bootstrap: virtual 8-device CPU mesh + deterministic seeding.
+
+Mirrors the reference's OryxTest base class, which seeds every RNG for
+reproducibility (framework/oryx-common/src/test/.../OryxTest.java:37-56,
+RandomManager.useTestSeed). JAX runs on CPU with 8 virtual devices so all
+mesh/sharding tests exercise real multi-device code paths without TPUs.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_rng():
+    from oryx_tpu.common import rng
+
+    rng.use_test_seed()
+    yield
+    rng.clear_test_seed()
+
+
+@pytest.fixture(autouse=True)
+def _reset_inproc_brokers():
+    yield
+    from oryx_tpu.bus.inproc import InProcessBroker
+
+    InProcessBroker.reset_all()
+
+
+@pytest.fixture()
+def tmp_bus(tmp_path):
+    """A fresh file-backed bus locator."""
+    return f"file:{tmp_path}/bus"
