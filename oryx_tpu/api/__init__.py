@@ -1,0 +1,17 @@
+"""User-facing SPI: the three interfaces apps implement.
+
+Rebuild of framework/oryx-api (SURVEY.md §2.3): `BatchLayerUpdate`,
+`SpeedModelManager`, `ServingModelManager` plus the model readiness
+contract. User implementations are named in config
+(oryx.batch.update-class, oryx.speed.model-manager-class,
+oryx.serving.model-manager-class) and loaded reflectively by the layer
+runtimes, exactly as the reference does (BatchLayer.java:152-184).
+"""
+
+from oryx_tpu.api.batch import BatchLayerUpdate  # noqa: F401
+from oryx_tpu.api.speed import SpeedModel, SpeedModelManager  # noqa: F401
+from oryx_tpu.api.serving import (  # noqa: F401
+    AbstractServingModelManager,
+    ServingModel,
+    ServingModelManager,
+)

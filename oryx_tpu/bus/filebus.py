@@ -85,7 +85,9 @@ class FileBroker(Broker):
                         data = {}
                     if topic in data:
                         del data[topic]
-                        ledger.write_text(json.dumps(data))
+                        tmp = ledger.with_suffix(".tmp")
+                        tmp.write_text(json.dumps(data))
+                        os.replace(tmp, ledger)
 
     def _num_partitions(self, topic: str) -> int:
         try:

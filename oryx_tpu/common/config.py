@@ -74,15 +74,40 @@ class _Concat:
         self.parts = parts
 
 
+class _Fallback:
+    """An optional substitution shadowing an earlier value: ``a = ${?x}``
+    over an existing ``a`` keeps the existing value when x is absent
+    (HOCON fall-through semantics)."""
+
+    __slots__ = ("sub", "fallback")
+
+    def __init__(self, sub: "_Sub", fallback: Any) -> None:
+        self.sub = sub
+        self.fallback = fallback
+
+
 def _tokenize(text: str) -> list[Any]:
+    """Tokens: punctuation chars, "\n", ("str", s), ("raw", s), _Sub, and
+    ("ws",) markers recording whitespace between adjacent value tokens (so
+    string concatenation preserves separators, per HOCON)."""
     toks: list[Any] = []
+    pending_ws = False
+
+    def emit(tok: Any) -> None:
+        nonlocal pending_ws
+        if pending_ws and toks and _is_value_token(toks[-1]) and _is_value_token(tok):
+            toks.append(("ws",))
+        pending_ws = False
+        toks.append(tok)
+
     i, n = 0, len(text)
     while i < n:
         c = text[i]
         if c in " \t\r":
+            pending_ws = True
             i += 1
         elif c == "\n":
-            toks.append("\n")
+            emit("\n")
             i += 1
         elif c == "#" or text.startswith("//", i):
             while i < n and text[i] != "\n":
@@ -92,7 +117,7 @@ def _tokenize(text: str) -> list[Any]:
                 end = text.find('"""', i + 3)
                 if end < 0:
                     raise ConfigError("unterminated triple-quoted string")
-                toks.append(("str", text[i + 3 : end]))
+                emit(("str", text[i + 3 : end]))
                 i = end + 3
             else:
                 j = i + 1
@@ -100,12 +125,20 @@ def _tokenize(text: str) -> list[Any]:
                 while j < n and text[j] != '"':
                     if text[j] == "\\" and j + 1 < n:
                         esc = text[j + 1]
-                        if esc == "u" and j + 6 <= n:
-                            buf.append(chr(int(text[j + 2 : j + 6], 16)))
+                        if esc == "u":
+                            if j + 6 > n:
+                                raise ConfigError("malformed \\u escape")
+                            try:
+                                buf.append(chr(int(text[j + 2 : j + 6], 16)))
+                            except ValueError as e:
+                                raise ConfigError(f"malformed \\u escape: {text[j:j+6]!r}") from e
                             j += 6
                         else:
                             buf.append(
-                                {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\", "/": "/"}.get(esc, esc)
+                                {
+                                    "n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+                                    '"': '"', "\\": "\\", "/": "/",
+                                }.get(esc, esc)
                             )
                             j += 2
                     else:
@@ -113,7 +146,7 @@ def _tokenize(text: str) -> list[Any]:
                         j += 1
                 if j >= n:
                     raise ConfigError("unterminated string")
-                toks.append(("str", "".join(buf)))
+                emit(("str", "".join(buf)))
                 i = j + 1
         elif c == "$":
             if text.startswith("${", i):
@@ -124,17 +157,17 @@ def _tokenize(text: str) -> list[Any]:
                 optional = inner.startswith("?")
                 if optional:
                     inner = inner[1:].strip()
-                toks.append(_Sub(inner, optional))
+                emit(_Sub(inner, optional))
                 i = end + 1
             else:
                 # a literal '$' inside an unquoted value
                 j = i + 1
                 while j < n and text[j] not in _UNQUOTED_FORBIDDEN:
                     j += 1
-                toks.append(("raw", text[i:j].strip()))
+                emit(("raw", text[i:j].strip()))
                 i = j
         elif c in _PUNCT:
-            toks.append(c)
+            emit(c)
             i += 1
         else:
             j = i
@@ -142,9 +175,15 @@ def _tokenize(text: str) -> list[Any]:
                 j += 1
             raw = text[i:j].strip()
             if raw:
-                toks.append(("raw", raw))
+                emit(("raw", raw))
             i = j if j > i else i + 1
     return toks
+
+
+def _is_value_token(tok: Any) -> bool:
+    return isinstance(tok, _Sub) or (
+        isinstance(tok, tuple) and len(tok) == 2 and tok[0] in ("str", "raw")
+    )
 
 
 def _coerce_raw(raw: str) -> Any:
@@ -229,6 +268,9 @@ class _Parser:
         parts: list[str] = []
         while True:
             tok = self.peek()
+            if tok == ("ws",):
+                self.next()
+                continue
             if isinstance(tok, tuple) and tok[0] in ("raw", "str"):
                 self.next()
                 text = tok[1]
@@ -255,6 +297,9 @@ class _Parser:
             elif isinstance(tok, _Sub):
                 self.next()
                 parts.append(tok)
+            elif tok == ("ws",):
+                self.next()
+                parts.append(" ")  # preserved separator inside a concatenation
             elif isinstance(tok, tuple):
                 self.next()
                 kind, text = tok
@@ -289,9 +334,11 @@ def _put_path(obj: dict, path: list[str], value: Any) -> None:
             node[part] = child
         node = child
     last = path[-1]
-    existing = node.get(last)
+    existing = node.get(last, _MISSING)
     if isinstance(existing, dict) and isinstance(value, dict):
         _deep_merge(existing, value)
+    elif isinstance(value, _Sub) and value.optional and existing is not _MISSING:
+        node[last] = _Fallback(value, existing)
     else:
         node[last] = value
 
@@ -300,6 +347,8 @@ def _deep_merge(base: dict, overlay: dict) -> dict:
     for k, v in overlay.items():
         if isinstance(v, dict) and isinstance(base.get(k), dict):
             _deep_merge(base[k], v)
+        elif isinstance(v, _Sub) and v.optional and k in base:
+            base[k] = _Fallback(copy.deepcopy(v), base[k])
         else:
             base[k] = copy.deepcopy(v)
     return base
@@ -331,13 +380,23 @@ def _resolve_pass(node: Any, root: dict) -> tuple[bool, list[str]]:
     unresolved: list[str] = []
 
     def resolve_value(v: Any) -> tuple[Any, bool]:
-        """Return (new_value, resolved?)."""
+        """Return (new_value, resolved?). An absent optional ${?path}
+        resolves to _MISSING: the key is then removed entirely (HOCON
+        semantics — it must not clobber a lower layer's value)."""
         if isinstance(v, _Sub):
             target = _lookup(root, v.path)
             if target is _MISSING or isinstance(target, (_Sub, _Concat)):
                 if v.optional and target is _MISSING:
-                    return None, True
+                    return _MISSING, True
                 unresolved.append(v.path)
+                return v, False
+            return copy.deepcopy(target), True
+        if isinstance(v, _Fallback):
+            target = _lookup(root, v.sub.path)
+            if target is _MISSING:
+                return resolve_value(v.fallback)
+            if isinstance(target, (_Sub, _Concat, _Fallback)):
+                unresolved.append(v.sub.path)
                 return v, False
             return copy.deepcopy(target), True
         if isinstance(v, _Concat):
@@ -349,12 +408,13 @@ def _resolve_pass(node: Any, root: dict) -> tuple[bool, list[str]]:
                 new_parts.append(np)
             if not ok:
                 return _Concat(new_parts), False
-            if all(isinstance(p, dict) for p in new_parts):
+            real = [p for p in new_parts if p is not _MISSING]
+            if real and all(isinstance(p, dict) for p in real):
                 merged: dict = {}
-                for p in new_parts:
+                for p in real:
                     _deep_merge(merged, p)
                 return merged, True
-            return "".join("" if p is None else str(p) for p in new_parts), True
+            return "".join("" if p is None or p is _MISSING else str(p) for p in real), True
         return v, True
 
     if isinstance(node, dict):
@@ -363,26 +423,35 @@ def _resolve_pass(node: Any, root: dict) -> tuple[bool, list[str]]:
                 c, u = _resolve_pass(v, root)
                 changed = changed or c
                 unresolved.extend(u)
-            elif isinstance(v, (_Sub, _Concat)):
+            elif isinstance(v, (_Sub, _Concat, _Fallback)):
                 nv, ok = resolve_value(v)
                 if ok:
-                    node[k] = nv
+                    if nv is _MISSING:
+                        del node[k]
+                    else:
+                        node[k] = nv
                     changed = True
                 elif nv is not v:
                     node[k] = nv
     elif isinstance(node, list):
+        drop: list[int] = []
         for i, v in enumerate(list(node)):
             if isinstance(v, (dict, list)):
                 c, u = _resolve_pass(v, root)
                 changed = changed or c
                 unresolved.extend(u)
-            elif isinstance(v, (_Sub, _Concat)):
+            elif isinstance(v, (_Sub, _Concat, _Fallback)):
                 nv, ok = resolve_value(v)
                 if ok:
-                    node[i] = nv
+                    if nv is _MISSING:
+                        drop.append(i)
+                    else:
+                        node[i] = nv
                     changed = True
                 elif nv is not v:
                     node[i] = nv
+        for i in reversed(drop):
+            del node[i]
     return changed, unresolved
 
 
@@ -397,6 +466,13 @@ def parse_hocon(text: str, resolve: bool = True) -> dict:
 # ---------------------------------------------------------------------------
 # Config object
 # ---------------------------------------------------------------------------
+
+
+def _render_scalar(v: Any) -> str:
+    """HOCON-style string rendering: booleans are true/false."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
 
 
 class Config:
@@ -426,7 +502,7 @@ class Config:
         v = self.get(path)
         if v is None or isinstance(v, (dict, list)):
             raise ConfigError(f"{path} is not a string: {v!r}")
-        return str(v)
+        return _render_scalar(v)
 
     def get_int(self, path: str) -> int:
         v = self.get(path)
@@ -469,14 +545,18 @@ class Config:
         v = _lookup(self._data, path)
         if v is _MISSING or v is None:
             return None
-        return str(v)
+        if isinstance(v, (dict, list)):
+            raise ConfigError(f"{path} is not a string: {v!r}")
+        return _render_scalar(v)
 
     def get_optional_strings(self, path: str) -> list[str] | None:
         v = _lookup(self._data, path)
         if v is _MISSING or v is None:
             return None
         if isinstance(v, list):
-            return [str(x) for x in v]
+            return [_render_scalar(x) for x in v]
+        if isinstance(v, dict):
+            raise ConfigError(f"{path} is not a string list: {v!r}")
         return [s.strip() for s in str(v).split(",") if s.strip()]
 
     def get_optional_float(self, path: str) -> float | None:

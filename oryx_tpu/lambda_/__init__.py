@@ -1,0 +1,9 @@
+"""Lambda-tier runtimes: the batch and speed layer processes.
+
+Rebuild of framework/oryx-lambda (SURVEY.md §2.4): interval-driven batch
+model rebuilds over all historical data, and micro-batch incremental speed
+updates, both fed from the input topic and publishing to the update topic.
+"""
+
+from oryx_tpu.lambda_.batch import BatchLayer  # noqa: F401
+from oryx_tpu.lambda_.speed import SpeedLayer  # noqa: F401

@@ -1,0 +1,85 @@
+"""Shared layer base: config parsing, topic init, input consumption.
+
+Rebuild of AbstractSparkLayer (framework/oryx-lambda/.../AbstractSparkLayer
+.java:57-254): parses id/topics/interval from config, builds the input
+stream starting from stored group offsets (the reference reads them from
+ZooKeeper; here from the bus's offset ledger).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Iterator
+
+from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, get_broker
+from oryx_tpu.common.config import Config
+
+log = logging.getLogger(__name__)
+
+
+class AbstractLayer:
+    def __init__(self, config: Config, layer_name: str) -> None:
+        self.config = config
+        self.layer_name = layer_name
+        self.id = config.get_optional_string("oryx.id")
+        self.input_broker_loc = config.get_string("oryx.input-topic.broker")
+        self.input_topic = config.get_string("oryx.input-topic.message.topic")
+        self.input_partitions = config.get_optional_int("oryx.input-topic.message.partitions") or 1
+        self.update_broker_loc = config.get_optional_string("oryx.update-topic.broker")
+        self.update_topic = config.get_optional_string("oryx.update-topic.message.topic")
+        self.update_partitions = config.get_optional_int("oryx.update-topic.message.partitions") or 1
+        self.generation_interval_sec = config.get_int(
+            f"oryx.{layer_name}.streaming.generation-interval-sec"
+        )
+        # consumer group: "OryxGroup-<LayerName>[-<id>]"
+        # (AbstractSparkLayer.java:108-116); without oryx.id there is no
+        # durable identity so offsets are not persisted and consumption
+        # starts at latest (reference.conf:14-20 comment).
+        self.group_id = f"OryxGroup-{layer_name}" + (f"-{self.id}" if self.id else "")
+        self._stop_event = threading.Event()
+
+    # -- topics -------------------------------------------------------------
+
+    def input_broker(self) -> Broker:
+        return get_broker(self.input_broker_loc)
+
+    def update_broker(self) -> Broker | None:
+        if self.update_broker_loc and self.update_topic:
+            return get_broker(self.update_broker_loc)
+        return None
+
+    def init_topics(self) -> None:
+        """Create topics if missing (the reference delegates this to
+        `oryx-run.sh kafka-setup`; layers here do it on startup for
+        operational simplicity)."""
+        self.input_broker().create_topic(self.input_topic, self.input_partitions)
+        ub = self.update_broker()
+        if ub is not None:
+            ub.create_topic(self.update_topic, self.update_partitions)
+
+    def make_input_consumer(self) -> TopicConsumer:
+        """Input consumer resuming from stored offsets when oryx.id is set
+        (AbstractSparkLayer.buildInputDStream:179-252)."""
+        return self.input_broker().consumer(
+            self.input_topic,
+            group=self.group_id if self.id else None,
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def is_stopped(self) -> bool:
+        return self._stop_event.is_set()
+
+    def await_termination(self, timeout: float | None = None) -> None:
+        self._stop_event.wait(timeout)
+
+    def close(self) -> None:
+        self._stop_event.set()
+
+
+def blocking_iterator(consumer: TopicConsumer, stop_event: threading.Event) -> Iterator[KeyMessage]:
+    """Endless KeyMessage iterator over a consumer, ending on close/stop."""
+    while not stop_event.is_set() and not consumer.closed():
+        for rec in consumer.poll(timeout=0.2):
+            yield rec

@@ -1,0 +1,126 @@
+"""Batch layer runtime.
+
+Rebuild of BatchLayer + BatchUpdateFunction + SaveToHDFSFunction +
+UpdateOffsetsFn + DeleteOldDataFn (framework/oryx-lambda/.../batch/,
+SURVEY.md §2.4, call stack §3.1). Per generation interval:
+
+1. drain the input topic into a micro-batch,
+2. read all surviving past data from the data dir,
+3. invoke the configured BatchLayerUpdate (which trains on past+new and
+   publishes MODEL/MODEL-REF + UP messages),
+4. append the micro-batch to the data dir,
+5. commit input offsets to the offset ledger (at-least-once),
+6. GC data/models past their max age.
+
+Step 3 runs before step 4 so the update sees `new_data` and `past_data`
+disjoint, matching the reference's foreachRDD registration order
+(BatchLayer.java:103-122).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import load_instance_of
+from oryx_tpu.lambda_ import data as data_store
+from oryx_tpu.lambda_.base import AbstractLayer
+
+log = logging.getLogger(__name__)
+
+
+class BatchLayer(AbstractLayer):
+    def __init__(self, config: Config) -> None:
+        super().__init__(config, "batch")
+        self.update_class = config.get_string("oryx.batch.update-class")
+        self.data_dir = config.get_string("oryx.batch.storage.data-dir")
+        self.model_dir = config.get_string("oryx.batch.storage.model-dir")
+        self.max_data_age_hours = config.get_int("oryx.batch.storage.max-age-data-hours")
+        self.max_model_age_hours = (
+            config.get_optional_int("oryx.batch.storage.max-age-model-hours") or -1
+        )
+        self._update = load_instance_of(self.update_class, config)
+        self._consumer = None
+        self._thread: threading.Thread | None = None
+        self._generation_count = 0
+
+    # -- public lifecycle ---------------------------------------------------
+
+    def prepare(self) -> None:
+        """Create topics and attach the input consumer without starting the
+        background loop; from this point input is observed. Useful when
+        driving generations explicitly (tests, one-shot CLI runs)."""
+        self.init_topics()
+        if self._consumer is None:
+            self._consumer = self.make_input_consumer()
+
+    def start(self) -> None:
+        self.prepare()
+        self._thread = threading.Thread(target=self._loop, name="BatchLayer", daemon=True)
+        self._thread.start()
+        log.info("BatchLayer started: interval=%ss update=%s", self.generation_interval_sec, self.update_class)
+
+    def close(self) -> None:
+        super().close()
+        if self._consumer is not None:
+            self._consumer.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    @property
+    def generation_count(self) -> int:
+        return self._generation_count
+
+    # -- generation loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self.is_stopped():
+            self._stop_event.wait(self.generation_interval_sec)
+            if self.is_stopped():
+                break
+            try:
+                self.run_one_generation()
+            except Exception:
+                log.exception("batch generation failed")
+
+    def run_one_generation(self, timestamp_ms: int | None = None) -> None:
+        """One full generation; callable directly for deterministic tests."""
+        if self._consumer is None:
+            self._consumer = self.make_input_consumer()
+        timestamp_ms = int(time.time() * 1000) if timestamp_ms is None else timestamp_ms
+
+        # 1. drain whatever is currently available on the input topic
+        new_data: list[KeyMessage] = []
+        while True:
+            batch = self._consumer.poll(max_records=10_000, timeout=0.05)
+            if not batch:
+                break
+            new_data.extend(batch)
+
+        # 2. all surviving past data
+        past_data = data_store.read_past_data(self.data_dir)
+
+        # 3. user update, with a producer for the update topic
+        ub = self.update_broker()
+        producer = ub.producer(self.update_topic) if ub is not None else None
+        try:
+            self._update.run_update(timestamp_ms, new_data, past_data, self.model_dir, producer)
+        finally:
+            if producer is not None:
+                producer.close()
+
+        # 4. persist the micro-batch
+        data_store.save_micro_batch(self.data_dir, timestamp_ms, new_data)
+
+        # 5. commit offsets (UpdateOffsetsFn.java:57-65)
+        if self.id:
+            self._consumer.commit()
+
+        # 6. age-based GC
+        data_store.delete_old_data(self.data_dir, self.max_data_age_hours)
+        data_store.delete_old_models(self.model_dir, self.max_model_age_hours)
+
+        self._generation_count += 1

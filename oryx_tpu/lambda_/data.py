@@ -1,0 +1,90 @@
+"""Historical-data storage for the batch layer.
+
+Rebuild of SaveToHDFSFunction (framework/oryx-lambda/.../batch/
+SaveToHDFSFunction.java:31-77: append each non-empty micro-batch as
+``dataDir/oryx-<timestampMs>.data``), the past-data re-read in
+BatchUpdateFunction.java:103-130, and age-based GC in
+DeleteOldDataFn.java:38-78 (timestamp parsed from the file/dir name).
+
+Records are JSON lines ``{"k": key, "m": message}`` — the plain-file
+equivalent of the reference's Hadoop SequenceFile<Text,Text>.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shutil
+import time
+from pathlib import Path
+from typing import Iterable, Iterator
+
+from oryx_tpu.bus.core import KeyMessage
+
+_DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.data$")
+_MODEL_DIR_RE = re.compile(r"^(\d+)$")
+
+
+def save_micro_batch(data_dir: str | Path, timestamp_ms: int, records: list[KeyMessage]) -> Path | None:
+    """Append one micro-batch; empty batches write nothing
+    (SaveToHDFSFunction.java:60-66)."""
+    if not records:
+        return None
+    d = Path(data_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"oryx-{timestamp_ms}.data"
+    tmp = d / f".oryx-{timestamp_ms}.data.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps({"k": rec.key, "m": rec.message}, separators=(",", ":")) + "\n")
+    tmp.replace(path)
+    return path
+
+
+def read_past_data(data_dir: str | Path) -> Iterator[KeyMessage]:
+    """Stream all surviving historical records, oldest file first."""
+    d = Path(data_dir)
+    if not d.is_dir():
+        return
+    files = sorted(
+        (p for p in d.iterdir() if _DATA_FILE_RE.match(p.name)),
+        key=lambda p: int(_DATA_FILE_RE.match(p.name).group(1)),
+    )
+    for path in files:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rec = json.loads(line)
+                    yield KeyMessage(rec.get("k"), rec.get("m", ""))
+
+
+def delete_old_data(data_dir: str | Path, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
+    """Delete data files older than max_age_hours; -1 disables
+    (DeleteOldDataFn.java:54-74)."""
+    return _delete_old(data_dir, _DATA_FILE_RE, max_age_hours, now_ms)
+
+
+def delete_old_models(model_dir: str | Path, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
+    """Delete versioned model dirs (named <timestampMs>) older than
+    max_age_hours; -1 disables."""
+    return _delete_old(model_dir, _MODEL_DIR_RE, max_age_hours, now_ms)
+
+
+def _delete_old(root: str | Path, pattern: re.Pattern, max_age_hours: int, now_ms: int | None) -> list[Path]:
+    if max_age_hours < 0:
+        return []
+    d = Path(root)
+    if not d.is_dir():
+        return []
+    cutoff = (time.time() * 1000 if now_ms is None else now_ms) - max_age_hours * 3600_000
+    deleted = []
+    for p in d.iterdir():
+        m = pattern.match(p.name)
+        if m and int(m.group(1)) < cutoff:
+            if p.is_dir():
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                p.unlink(missing_ok=True)
+            deleted.append(p)
+    return deleted

@@ -1,0 +1,17 @@
+"""Serving-layer runtime: embedded HTTP server + model manager lifecycle.
+
+Rebuild of framework/oryx-lambda-serving (SURVEY.md §2.5): the reference
+embeds Tomcat + Jersey and discovers JAX-RS resources by package scan
+(OryxApplication.java:42-98); here an embedded threaded HTTP server routes
+to resources registered with the @resource decorator from the modules
+listed in oryx.serving.application-resources.
+"""
+
+from oryx_tpu.serving.web import (  # noqa: F401
+    OryxServingException,
+    Request,
+    Response,
+    ServingContext,
+    resource,
+)
+from oryx_tpu.serving.layer import ServingLayer  # noqa: F401

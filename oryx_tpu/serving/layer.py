@@ -1,0 +1,266 @@
+"""The serving layer process: HTTP server + model-manager lifecycle.
+
+Rebuild of ServingLayer (framework/oryx-lambda-serving/.../ServingLayer
+.java:55-339) and ModelManagerListener (.../ModelManagerListener.java:
+62-238): on start, creates the input-topic producer (unless read-only),
+loads the configured ServingModelManager, starts a daemon thread replaying
+the update topic from the beginning into manager.consume, and serves the
+registered resources over HTTP with optional Basic auth, gzip, a context
+path, and /ready readiness gating (Ready.java:34-42).
+
+Divergence from the reference, by design: Tomcat+DIGEST auth becomes a
+threaded stdlib HTTP server with Basic auth (front with a real TLS
+terminator in production); Jersey package scanning becomes import of the
+modules named in oryx.serving.application-resources.
+"""
+
+from __future__ import annotations
+
+import base64
+import gzip
+import importlib
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from oryx_tpu.bus.core import get_broker
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import load_instance_of
+from oryx_tpu.lambda_.base import blocking_iterator
+from oryx_tpu.serving.web import (
+    OryxServingException,
+    Request,
+    Response,
+    Router,
+    ServingContext,
+    render,
+    resource,
+)
+
+log = logging.getLogger(__name__)
+
+
+@resource("GET", "/ready")
+def _ready(ctx: ServingContext, req: Request) -> Response:
+    """503 until the model is sufficiently loaded (Ready.java:34-42)."""
+    if _model_ready(ctx):
+        return Response(200, None)
+    return Response(503, None)
+
+
+def _model_ready(ctx: ServingContext) -> bool:
+    manager = ctx.model_manager
+    if manager is None:
+        return False
+    model = manager.get_model()
+    if model is None:
+        return False
+    min_fraction = ctx.config.get_float("oryx.serving.min-model-load-fraction")
+    fraction = getattr(model, "get_fraction_loaded", lambda: 1.0)()
+    return fraction >= min_fraction
+
+
+class ServingLayer:
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.port = config.get_int("oryx.serving.api.port")
+        self.context_path = config.get_string("oryx.serving.api.context-path").rstrip("/")
+        self.read_only = config.get_bool("oryx.serving.api.read-only")
+        self.user_name = config.get_optional_string("oryx.serving.api.user-name")
+        self.password = config.get_optional_string("oryx.serving.api.password")
+        self.no_init_topics = config.get_optional_bool("oryx.serving.no-init-topics") or False
+        self.model_manager_class = config.get_optional_string("oryx.serving.model-manager-class")
+        self.app_resources = config.get_optional_strings("oryx.serving.application-resources")
+
+        self.model_manager = None
+        self.input_producer = None
+        self._update_consumer = None
+        self._consume_thread: threading.Thread | None = None
+        self._server: ThreadingHTTPServer | None = None
+        self._server_thread: threading.Thread | None = None
+
+        self.router = Router()
+        if self.app_resources:
+            for mod in self.app_resources:
+                importlib.import_module(mod)
+        # framework resources (this module) + configured app resources
+        self.router.add_from_registry(
+            ([__name__] + list(self.app_resources)) if self.app_resources else None
+        )
+
+    # -- lifecycle (ModelManagerListener.contextInitialized analogue) -------
+
+    def start(self) -> None:
+        cfg = self.config
+        input_broker_loc = cfg.get_optional_string("oryx.input-topic.broker")
+        input_topic = cfg.get_optional_string("oryx.input-topic.message.topic")
+        update_broker_loc = cfg.get_optional_string("oryx.update-topic.broker")
+        update_topic = cfg.get_optional_string("oryx.update-topic.message.topic")
+
+        if input_broker_loc and input_topic and not self.read_only:
+            broker = get_broker(input_broker_loc)
+            if not self.no_init_topics:
+                broker.create_topic(
+                    input_topic, cfg.get_optional_int("oryx.input-topic.message.partitions") or 1
+                )
+            self.input_producer = broker.producer(input_topic)
+
+        if self.model_manager_class:
+            self.model_manager = load_instance_of(self.model_manager_class, cfg)
+            if update_broker_loc and update_topic:
+                broker = get_broker(update_broker_loc)
+                if not self.no_init_topics:
+                    broker.create_topic(
+                        update_topic,
+                        cfg.get_optional_int("oryx.update-topic.message.partitions") or 1,
+                    )
+                # replay the update topic from offset 0 on every start
+                # (ModelManagerListener.java:118-132)
+                self._update_consumer = broker.consumer(update_topic, from_beginning=True)
+                self._stop_event = threading.Event()
+                self._consume_thread = threading.Thread(
+                    target=self._consume_updates, name="ServingUpdateConsumer", daemon=True
+                )
+                self._consume_thread.start()
+
+        ctx = ServingContext(self.model_manager, self.input_producer, self.config)
+        handler_cls = _make_handler(self, ctx)
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), handler_cls)
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, name="ServingHTTP", daemon=True
+        )
+        self._server_thread.start()
+        log.info("ServingLayer listening on :%d%s", self.port, self.context_path or "/")
+
+    def _consume_updates(self) -> None:
+        try:
+            self.model_manager.consume(
+                blocking_iterator(self._update_consumer, self._stop_event)
+            )
+        except Exception:
+            log.exception("serving model consume thread failed")
+
+    def await_termination(self, timeout: float | None = None) -> None:
+        if self._server_thread is not None:
+            self._server_thread.join(timeout)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        if self._update_consumer is not None:
+            self._stop_event.set()
+            self._update_consumer.close()
+        if self._consume_thread is not None:
+            self._consume_thread.join(timeout=5)
+        if self.model_manager is not None:
+            self.model_manager.close()
+        if self.input_producer is not None:
+            self.input_producer.close()
+
+    def __enter__(self) -> "ServingLayer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _make_handler(layer: ServingLayer, ctx: ServingContext):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "oryx_tpu"
+
+        def log_message(self, fmt, *args):  # route to logging, not stderr
+            log.debug("%s " + fmt, self.address_string(), *args)
+
+        def _handle(self, method: str) -> None:
+            try:
+                status, payload, ct, extra = self._dispatch(method)
+            except OryxServingException as e:
+                self._send_error(e.status, e.message)
+                return
+            except Exception:
+                log.exception("internal error handling %s %s", method, self.path)
+                self._send_error(500, "internal error")
+                return
+            body = payload
+            headers = dict(extra)
+            if len(body) > 1024 and "gzip" in self.headers.get("Accept-Encoding", ""):
+                body = gzip.compress(body)
+                headers["Content-Encoding"] = "gzip"
+            self.send_response(status)
+            self.send_header("Content-Type", ct)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if method != "HEAD":
+                self.wfile.write(body)
+
+        def _dispatch(self, method: str):
+            if not self._authorized():
+                raise OryxServingException(401, "unauthorized")
+            split = urlsplit(self.path)
+            path = split.path
+            if layer.context_path:
+                if not path.startswith(layer.context_path):
+                    raise OryxServingException(404, "outside context path")
+                path = path[len(layer.context_path) :] or "/"
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if self.headers.get("Content-Encoding") == "gzip":
+                body = gzip.decompress(body)
+            req = Request(
+                method=method,
+                path=path,
+                params={},
+                query=parse_qs(split.query),
+                headers={k: v for k, v in self.headers.items()},
+                body=body,
+            )
+            response = layer.router.dispatch(ctx, req)
+            return render(response, self.headers.get("Accept", "application/json"))
+
+        def _authorized(self) -> bool:
+            if not layer.user_name:
+                return True
+            auth = self.headers.get("Authorization", "")
+            if not auth.startswith("Basic "):
+                return False
+            try:
+                userpass = base64.b64decode(auth[6:]).decode("utf-8")
+            except Exception:
+                return False
+            return userpass == f"{layer.user_name}:{layer.password}"
+
+        def _send_error(self, status: int, message: str) -> None:
+            # plain error body (ErrorResource.java renders status + message)
+            body = f"{status} {message}\n".encode("utf-8")
+            self.send_response(status)
+            if status == 401:
+                self.send_header("WWW-Authenticate", 'Basic realm="Oryx"')
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except BrokenPipeError:
+                pass
+
+        def do_GET(self):
+            self._handle("GET")
+
+        def do_POST(self):
+            self._handle("POST")
+
+        def do_DELETE(self):
+            self._handle("DELETE")
+
+        def do_HEAD(self):
+            self._handle("HEAD")
+
+    return Handler

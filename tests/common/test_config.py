@@ -66,9 +66,10 @@ def test_substitution_and_concat():
     assert cfg.get_string("oryx.batch.storage.data-dir") == "/data/oryx/data/"
 
 
-def test_optional_substitution():
+def test_optional_substitution_absent_key_not_set():
     cfg = C.from_string("a = ${?nope}\nb = 2")
-    assert cfg.get("a") is None
+    assert not cfg.has("a")
+    assert cfg.get("a", "default") == "default"
     assert cfg.get_int("b") == 2
 
 
@@ -138,3 +139,34 @@ def test_overlay_substitution_references_base():
 
 def test_literal_dollar_in_unquoted_value():
     assert C.from_string("v = ab$cd").get_string("v") == "ab$cd"
+
+
+def test_optional_sub_falls_back_to_shadowed_value():
+    base = C.from_string('a = "keep-me"')
+    assert base.with_overlay("a = ${?x}").get_string("a") == "keep-me"
+    assert base.with_overlay('x = "got"\na = ${?x}').get_string("a") == "got"
+    assert C.from_string('a = "orig"\na = ${?nope}').get_string("a") == "orig"
+
+
+def test_whitespace_preserved_in_concat():
+    cfg = C.from_string('first = "John"\nlast = "Smith"\nfull = ${first} ${last}')
+    assert cfg.get_string("full") == "John Smith"
+    assert C.from_string('a = "x" "y"').get_string("a") == "x y"
+
+
+def test_get_string_renders_bool_hocon_style():
+    assert C.from_string("f = true").get_string("f") == "true"
+    assert C.from_string("f = false").get_optional_string("f") == "false"
+
+
+def test_optional_string_rejects_object():
+    with pytest.raises(C.ConfigError):
+        C.from_string("o { a = 1 }").get_optional_string("o")
+
+
+def test_escapes_round_trip():
+    cfg = C.from_string('v = "a\\bb\\fc\\u00e9"')
+    assert cfg.get_string("v") == "a\bb\fcé"
+    assert C.from_string(cfg.serialize()).get_string("v") == "a\bb\fcé"
+    with pytest.raises(C.ConfigError):
+        C.from_string('v = "bad\\uZZZZ"')

@@ -1,0 +1,114 @@
+"""Batch layer integration tests over the in-process bus
+(reference: BatchLayerIT, SimpleMLUpdateIT patterns, SURVEY.md §4 ring 3)."""
+
+import json
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.common import config as C
+from oryx_tpu.lambda_ import data as data_store
+from oryx_tpu.lambda_.batch import BatchLayer
+
+
+def make_config(tmp_path, broker="inproc://batch-it", update_class="oryx_tpu.example.batch:ExampleBatchLayerUpdate"):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "BatchIT"
+          input-topic.broker = "{broker}"
+          update-topic.broker = "{broker}"
+          batch {{
+            streaming.generation-interval-sec = 1
+            update-class = "{update_class}"
+            storage {{
+              data-dir = "{tmp_path}/data/"
+              model-dir = "{tmp_path}/model/"
+            }}
+          }}
+        }}
+        """
+    )
+
+
+def test_generation_produces_model_and_persists_data(tmp_path):
+    cfg = make_config(tmp_path)
+    layer = BatchLayer(cfg)
+    layer.prepare()
+    broker = bus.get_broker("inproc://batch-it")
+    with broker.producer("OryxInput") as p:
+        p.send(None, "a b c")
+        p.send(None, "a b")
+    update_tail = broker.consumer("OryxUpdate", from_beginning=True)
+
+    layer.run_one_generation(timestamp_ms=1000)
+
+    models = update_tail.poll(timeout=1.0)
+    assert [m.key for m in models] == ["MODEL"]
+    counts = json.loads(models[0].message)
+    assert counts == {"a": 2, "b": 2, "c": 2}
+    # data persisted
+    past = list(data_store.read_past_data(f"{tmp_path}/data/"))
+    assert sorted(r.message for r in past) == ["a b", "a b c"]
+    # offsets committed: re-running with no new input yields same model from past only
+    with broker.producer("OryxInput") as p:
+        p.send(None, "c d")
+    layer.run_one_generation(timestamp_ms=2000)
+    models2 = update_tail.poll(timeout=1.0)
+    counts2 = json.loads(models2[0].message)
+    assert counts2 == {"a": 2, "b": 2, "c": 3, "d": 1}
+    layer.close()
+
+
+def test_new_and_past_data_disjoint(tmp_path):
+    seen = {}
+
+    class RecordingUpdate:
+        def run_update(self, ts, new_data, past_data, model_dir, producer):
+            seen[ts] = (list(new_data), list(past_data))
+
+    import tests.lambda_.test_batch_layer as me
+
+    me.RecordingUpdate = RecordingUpdate
+    cfg = make_config(tmp_path, broker="inproc://batch-it2",
+                      update_class="tests.lambda_.test_batch_layer:RecordingUpdate")
+    layer = BatchLayer(cfg)
+    layer.prepare()
+    broker = bus.get_broker("inproc://batch-it2")
+    with broker.producer("OryxInput") as p:
+        p.send(None, "one")
+    layer.run_one_generation(timestamp_ms=1)
+    with broker.producer("OryxInput") as p:
+        p.send(None, "two")
+    layer.run_one_generation(timestamp_ms=2)
+    assert [r.message for r in seen[1][0]] == ["one"]
+    assert [r.message for r in seen[1][1]] == []
+    assert [r.message for r in seen[2][0]] == ["two"]
+    assert [r.message for r in seen[2][1]] == ["one"]
+    layer.close()
+
+
+def test_background_loop_runs_generations(tmp_path):
+    cfg = make_config(tmp_path, broker="inproc://batch-it3")
+    layer = BatchLayer(cfg)
+    layer.start()
+    broker = bus.get_broker("inproc://batch-it3")
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    with broker.producer("OryxInput") as p:
+        p.send(None, "x y")
+    got = tail.poll(timeout=5.0)
+    assert got and got[0].key == "MODEL"
+    layer.close()
+    assert layer.generation_count >= 1
+
+
+def test_old_data_gc(tmp_path):
+    from oryx_tpu.bus.core import KeyMessage
+
+    d = tmp_path / "data"
+    data_store.save_micro_batch(d, 1000, [KeyMessage(None, "old")])
+    data_store.save_micro_batch(d, 10_000_000, [KeyMessage(None, "new")])
+    deleted = data_store.delete_old_data(d, max_age_hours=1, now_ms=10_000_000 + 3_600_000)
+    assert [p.name for p in deleted] == ["oryx-1000.data"]
+    assert [r.message for r in data_store.read_past_data(d)] == ["new"]
+    assert data_store.delete_old_data(d, max_age_hours=-1) == []

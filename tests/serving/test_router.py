@@ -1,0 +1,63 @@
+"""Router unit tests: templates, greedy params, negotiation."""
+
+import pytest
+
+from oryx_tpu.serving import web
+from oryx_tpu.serving.web import OryxServingException, Request, Response, Router, ServingContext
+
+
+def make_req(method, path, query=None):
+    return Request(method=method, path=path, params={}, query=query or {}, headers={})
+
+
+def ctx():
+    return ServingContext(None, None, None)
+
+
+def test_single_and_greedy_params():
+    r = Router()
+    r.add("GET", "/recommend/{userID}", lambda c, q: q.params["userID"])
+    r.add("GET", "/recommendToMany/{userIDs:+}", lambda c, q: q.params["userIDs"])
+    resp = r.dispatch(ctx(), make_req("GET", "/recommend/u%2F1"))
+    assert resp.body == "u/1"
+    resp = r.dispatch(ctx(), make_req("GET", "/recommendToMany/u1/u2/u3"))
+    assert resp.body == ["u1", "u2", "u3"]
+
+
+def test_specific_route_wins_over_greedy():
+    r = Router()
+    r.add("GET", "/similarity/{items:+}", lambda c, q: "greedy")
+    r.add("GET", "/similarity/{a}/{b}", lambda c, q: "pair")
+    assert r.dispatch(ctx(), make_req("GET", "/similarity/x/y")).body == "pair"
+    assert r.dispatch(ctx(), make_req("GET", "/similarity/x/y/z")).body == "greedy"
+
+
+def test_404_and_405():
+    r = Router()
+    r.add("GET", "/a", lambda c, q: 1)
+    with pytest.raises(OryxServingException) as e404:
+        r.dispatch(ctx(), make_req("GET", "/zzz"))
+    assert e404.value.status == 404
+    with pytest.raises(OryxServingException) as e405:
+        r.dispatch(ctx(), make_req("POST", "/a"))
+    assert e405.value.status == 405
+
+
+def test_query_helpers():
+    req = make_req("GET", "/x", {"howMany": ["5"], "flag": ["true"], "ids": ["a", "b"]})
+    assert req.q_int("howMany", 10) == 5
+    assert req.q_int("missing", 10) == 10
+    assert req.q_bool("flag") is True
+    assert req.q_list("ids") == ["a", "b"]
+    with pytest.raises(OryxServingException):
+        make_req("GET", "/x", {"n": ["abc"]}).q_int("n", 1)
+
+
+def test_render_csv_vs_json():
+    resp = Response(200, [["a", 1.5], ["b", 2.0]])
+    status, payload, ct, _ = web.render(resp, "text/csv")
+    assert ct == "text/csv"
+    assert payload == b"a,1.5\nb,2.0\n"
+    status, payload, ct, _ = web.render(resp, "application/json")
+    assert ct == "application/json"
+    assert payload == b'[["a", 1.5], ["b", 2.0]]'
