@@ -409,9 +409,12 @@ def _resolve_pass(node: Any, root: dict) -> tuple[bool, list[str]]:
             if not ok:
                 return _Concat(new_parts), False
             real = [p for p in new_parts if p is not _MISSING]
-            if real and all(isinstance(p, dict) for p in real):
+            # whitespace separators don't defeat object merging:
+            # `z = ${x} ${y}` over two objects merges them (HOCON)
+            non_ws = [p for p in real if not (isinstance(p, str) and p.strip() == "")]
+            if non_ws and all(isinstance(p, dict) for p in non_ws):
                 merged: dict = {}
-                for p in real:
+                for p in non_ws:
                     _deep_merge(merged, p)
                 return merged, True
             return "".join("" if p is None or p is _MISSING else str(p) for p in real), True

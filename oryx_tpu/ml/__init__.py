@@ -1,0 +1,19 @@
+"""ML tier: the batch-training harness with hyperparameter search.
+
+Rebuild of framework/oryx-ml (SURVEY.md §2.6): MLUpdate runs train/test
+splits, builds candidate models across a hyperparameter grid (in
+parallel), evaluates each, promotes the best into the versioned model
+directory, and publishes it over the update topic as MODEL or MODEL-REF.
+"""
+
+from oryx_tpu.ml.param import (  # noqa: F401
+    HyperParamValues,
+    fixed,
+    range_param,
+    around,
+    unordered,
+    from_config,
+    choose_hyper_parameter_combos,
+    choose_values_per_hyper_param,
+)
+from oryx_tpu.ml.update import MLUpdate  # noqa: F401

@@ -1,0 +1,218 @@
+"""MLUpdate: the batch-training harness.
+
+Rebuild of framework/oryx-ml/.../MLUpdate.java:59-373. Per generation:
+
+1. split new data into train/test (random by default; apps may override
+   with e.g. a time-ordered split — MLUpdate.java:338-372),
+2. enumerate hyperparameter combos (param.py),
+3. build + evaluate one candidate per combo, in parallel
+   (findBestCandidatePath, MLUpdate.java:251-288) — each candidate trains
+   via the abstract `build_model` and persists `model.pmml` under a
+   temporary candidates dir,
+4. promote the best candidate dir to `model_dir/<timestampMs>/`
+   (temp→rename, MLUpdate.java:192-210),
+5. publish ("MODEL", <pmml xml>) inline when it fits the update topic's
+   max-size, else ("MODEL-REF", <path>) (MLUpdate.java:212-241),
+6. call `publish_additional_model_data` (ALS streams its factor matrices
+   here, ALSUpdate.java:194-230).
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import math
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Iterable, Sequence
+from xml.etree.ElementTree import Element
+
+from oryx_tpu.api.batch import BatchLayerUpdate
+from oryx_tpu.bus.core import KeyMessage, TopicProducer
+from oryx_tpu.common import pmml as pmml_io, rng
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import collect_in_parallel
+from oryx_tpu.ml import param as hp
+
+log = logging.getLogger(__name__)
+
+MODEL_FILE_NAME = "model.pmml"
+
+
+class MLUpdate(BatchLayerUpdate, abc.ABC):
+    """Apps subclass this and implement get_hyper_parameter_values,
+    build_model, and evaluate."""
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.test_fraction = config.get_float("oryx.ml.eval.test-fraction")
+        candidates = config.get_int("oryx.ml.eval.candidates")
+        self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism")
+        self.threshold = config.get_optional_float("oryx.ml.eval.threshold")
+        self.max_message_size = config.get_int("oryx.update-topic.message.max-size")
+        if not 0.0 <= self.test_fraction <= 1.0:
+            raise ValueError("test-fraction must be in [0,1]")
+        if self.test_fraction == 0.0 and candidates > 1:
+            log.info("test-fraction = 0 so forcing candidates to 1")
+            candidates = 1
+        self.candidates = max(1, candidates)
+
+    # -- abstract app hooks --------------------------------------------------
+
+    def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
+        """Ranges of hyperparameters to try; order matters and must match
+        what build_model expects (MLUpdate.java:110-117)."""
+        return []
+
+    @abc.abstractmethod
+    def build_model(
+        self,
+        train_data: list[KeyMessage],
+        hyper_parameters: Sequence,
+        candidate_path: Path,
+    ) -> Element:
+        """Train and return the model as a PMML element tree; large side
+        artifacts (e.g. factor matrices) go under candidate_path."""
+
+    @abc.abstractmethod
+    def evaluate(
+        self,
+        model: Element,
+        model_parent_path: Path,
+        test_data: list[KeyMessage],
+        train_data: list[KeyMessage],
+    ) -> float:
+        """Higher is better (MLUpdate.java evaluation contract)."""
+
+    def publish_additional_model_data(
+        self,
+        pmml: Element,
+        new_data: list[KeyMessage],
+        past_data: list[KeyMessage],
+        model_parent_path: Path,
+        model_update_topic: TopicProducer | None,
+    ) -> None:
+        """Optionally stream extra model payloads after MODEL
+        (ALSUpdate.publishAdditionalModelData analogue)."""
+
+    def split_new_data_to_train_test(
+        self, new_data: list[KeyMessage]
+    ) -> tuple[list[KeyMessage], list[KeyMessage]]:
+        """Default random split by test-fraction (MLUpdate.java:360-372)."""
+        if self.test_fraction <= 0.0:
+            return new_data, []
+        if self.test_fraction >= 1.0:
+            return [], new_data
+        gen = rng.get_random()
+        mask = gen.random(len(new_data)) < self.test_fraction
+        train = [d for d, is_test in zip(new_data, mask) if not is_test]
+        test = [d for d, is_test in zip(new_data, mask) if is_test]
+        return train, test
+
+    # -- the harness ---------------------------------------------------------
+
+    def run_update(
+        self,
+        timestamp_ms: int,
+        new_data: Iterable[KeyMessage],
+        past_data: Iterable[KeyMessage],
+        model_dir: str,
+        model_update_topic: TopicProducer | None,
+    ) -> None:
+        new_data = list(new_data)
+        past_data = list(past_data)
+        if not new_data and not past_data:
+            log.info("no data at all; nothing to do")
+            return
+
+        train_new, test_new = self.split_new_data_to_train_test(new_data)
+        all_train = past_data + train_new
+
+        combos = hp.choose_hyper_parameter_combos(
+            self.get_hyper_parameter_values(),
+            self.candidates,
+            hp.choose_values_per_hyper_param(
+                len(self.get_hyper_parameter_values()), self.candidates
+            ),
+        )
+
+        candidates_root = Path(tempfile.mkdtemp(prefix="oryx-candidates-"))
+        try:
+            best = self._find_best_candidate(candidates_root, combos, all_train, test_new)
+            if best is None:
+                log.info("unable to build any model")
+                return
+            best_path, best_pmml = best
+
+            # promote to model_dir/<timestampMs>/ (temp -> rename)
+            final_dir = Path(model_dir) / str(timestamp_ms)
+            final_dir.parent.mkdir(parents=True, exist_ok=True)
+            if final_dir.exists():
+                shutil.rmtree(final_dir)
+            shutil.move(str(best_path), str(final_dir))
+
+            if model_update_topic is None:
+                log.info("not publishing model to update topic since none is configured")
+            else:
+                pmml_path = final_dir / MODEL_FILE_NAME
+                size = pmml_path.stat().st_size
+                if size <= self.max_message_size:
+                    model_update_topic.send("MODEL", pmml_path.read_text(encoding="utf-8"))
+                else:
+                    model_update_topic.send("MODEL-REF", str(pmml_path))
+                self.publish_additional_model_data(
+                    best_pmml, new_data, past_data, final_dir, model_update_topic
+                )
+        finally:
+            shutil.rmtree(candidates_root, ignore_errors=True)
+
+    def _find_best_candidate(
+        self,
+        candidates_root: Path,
+        combos: list[list],
+        all_train: list[KeyMessage],
+        test_data: list[KeyMessage],
+    ) -> tuple[Path, Element] | None:
+        def build_and_eval(i: int) -> tuple[float, Path, Element] | None:
+            candidate_path = candidates_root / str(i)
+            candidate_path.mkdir(parents=True, exist_ok=True)
+            hyper_parameters = combos[i]
+            try:
+                model = self.build_model(all_train, hyper_parameters, candidate_path)
+            except Exception:
+                log.exception("failed to build candidate %d (%s)", i, hyper_parameters)
+                return None
+            pmml_io.write_pmml(model, candidate_path / MODEL_FILE_NAME)
+            if not test_data and len(combos) == 1:
+                eval_score = math.nan  # nothing to evaluate against; only candidate wins
+            else:
+                try:
+                    eval_score = self.evaluate(
+                        model, candidate_path, test_data, all_train
+                    )
+                except Exception:
+                    log.exception("failed to evaluate candidate %d", i)
+                    return None
+            log.info("candidate %d params=%s eval=%s", i, hyper_parameters, eval_score)
+            return eval_score, candidate_path, model
+
+        results = collect_in_parallel(
+            len(combos), build_and_eval, parallelism=self.eval_parallelism
+        )
+        best: tuple[float, Path, Element] | None = None
+        for r in results:
+            if r is None:
+                continue
+            score = r[0]
+            if self.threshold is not None and not math.isnan(score) and score < self.threshold:
+                log.info("candidate %s below threshold %s; discarded", score, self.threshold)
+                continue
+            if best is None or (
+                not math.isnan(score) and (math.isnan(best[0]) or score > best[0])
+            ):
+                best = r
+        if best is None:
+            return None
+        log.info("best candidate eval=%s", best[0])
+        return best[1], best[2]

@@ -69,6 +69,10 @@ class ServingLayer:
         self.read_only = config.get_bool("oryx.serving.api.read-only")
         self.user_name = config.get_optional_string("oryx.serving.api.user-name")
         self.password = config.get_optional_string("oryx.serving.api.password")
+        if self.user_name and not self.password:
+            # auth requires BOTH set (reference.conf contract); a missing
+            # password must not silently degrade to a guessable credential
+            raise ValueError("oryx.serving.api.user-name set without password")
         self.no_init_topics = config.get_optional_bool("oryx.serving.no-init-topics") or False
         self.model_manager_class = config.get_optional_string("oryx.serving.model-manager-class")
         self.app_resources = config.get_optional_strings("oryx.serving.application-resources")
@@ -84,10 +88,9 @@ class ServingLayer:
         if self.app_resources:
             for mod in self.app_resources:
                 importlib.import_module(mod)
-        # framework resources (this module) + configured app resources
-        self.router.add_from_registry(
-            ([__name__] + list(self.app_resources)) if self.app_resources else None
-        )
+        # framework resources (this module) + configured app resources only —
+        # never whatever else happens to be imported in this interpreter
+        self.router.add_from_registry([__name__] + list(self.app_resources or []))
 
     # -- lifecycle (ModelManagerListener.contextInitialized analogue) -------
 
@@ -215,7 +218,8 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
             if self.headers.get("Content-Encoding") == "gzip":
                 body = gzip.decompress(body)
             req = Request(
-                method=method,
+                # HEAD routes like GET; the body is suppressed in _handle
+                method="GET" if method == "HEAD" else method,
                 path=path,
                 params={},
                 query=parse_qs(split.query),
@@ -235,7 +239,9 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 userpass = base64.b64decode(auth[6:]).decode("utf-8")
             except Exception:
                 return False
-            return userpass == f"{layer.user_name}:{layer.password}"
+            import hmac
+
+            return hmac.compare_digest(userpass, f"{layer.user_name}:{layer.password}")
 
         def _send_error(self, status: int, message: str) -> None:
             # plain error body (ErrorResource.java renders status + message)

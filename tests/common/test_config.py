@@ -170,3 +170,8 @@ def test_escapes_round_trip():
     assert C.from_string(cfg.serialize()).get_string("v") == "a\bb\fcé"
     with pytest.raises(C.ConfigError):
         C.from_string('v = "bad\\uZZZZ"')
+
+
+def test_object_merge_via_spaced_concat():
+    cfg = C.from_string("x = {a = 1}\ny = {b = 2}\nz = ${x} ${y}")
+    assert cfg.get("z") == {"a": 1, "b": 2}

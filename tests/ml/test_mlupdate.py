@@ -1,0 +1,118 @@
+"""MLUpdate harness tests (reference: SimpleMLUpdateIT / MockMLUpdate:
+record train/test counts, dummy PMML, assert split + promotion + publish)."""
+
+import math
+from pathlib import Path
+
+from oryx_tpu import bus
+from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.ml.update import MLUpdate
+
+
+class MockMLUpdate(MLUpdate):
+    """Trains a 'model' that records the mean of its hyperparameter."""
+
+    instances = []
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.train_counts = []
+        self.test_counts = []
+        MockMLUpdate.instances.append(self)
+
+    def get_hyper_parameter_values(self):
+        from oryx_tpu.ml import param as hp
+
+        return [hp.unordered([1, 2, 3])]
+
+    def build_model(self, train_data, hyper_parameters, candidate_path):
+        self.train_counts.append(len(train_data))
+        root = pmml_io.build_skeleton_pmml()
+        pmml_io.sub(root, "Extension", {"name": "param", "value": str(hyper_parameters[0])})
+        return root
+
+    def evaluate(self, model, model_parent_path, test_data, train_data):
+        self.test_counts.append(len(test_data))
+        # higher hyperparameter scores better
+        ext = pmml_io.find(model, "Extension")
+        return float(ext.get("value"))
+
+
+def make_config(tmp_path, candidates=3, test_fraction=0.25, max_size=16777216):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          update-topic.message.max-size = {max_size}
+          ml.eval {{
+            candidates = {candidates}
+            test-fraction = {test_fraction}
+            parallelism = 2
+          }}
+        }}
+        """
+    )
+
+
+def data(n):
+    return [KeyMessage(None, f"r{i}") for i in range(n)]
+
+
+def test_split_build_promote_publish(tmp_path):
+    cfg = make_config(tmp_path)
+    update = MockMLUpdate(cfg)
+    broker = bus.get_broker("inproc://ml-test")
+    broker.create_topic("OryxUpdate", 1)
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    with broker.producer("OryxUpdate") as producer:
+        update.run_update(12345, data(100), data(50), str(tmp_path / "model"), producer)
+
+    # all 3 candidates trained on past + train-split of new
+    assert len(update.train_counts) == 3
+    for tc, ec in zip(update.train_counts, update.test_counts):
+        assert tc + ec == 150
+        assert 100 <= tc <= 150
+
+    # best candidate (param=3) promoted
+    model_path = tmp_path / "model" / "12345" / "model.pmml"
+    assert model_path.exists()
+    promoted = pmml_io.read_pmml(model_path)
+    assert pmml_io.find(promoted, "Extension").get("value") == "3"
+
+    # MODEL published inline
+    msgs = tail.poll(timeout=1.0)
+    assert [m.key for m in msgs] == ["MODEL"]
+    assert 'value="3"' in msgs[0].message
+
+
+def test_model_ref_when_too_large(tmp_path):
+    cfg = make_config(tmp_path, candidates=1, max_size=10)  # force MODEL-REF
+    update = MockMLUpdate(cfg)
+    broker = bus.get_broker("inproc://ml-test-ref")
+    broker.create_topic("OryxUpdate", 1)
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    with broker.producer("OryxUpdate") as producer:
+        update.run_update(777, data(20), [], str(tmp_path / "model"), producer)
+    msgs = tail.poll(timeout=1.0)
+    assert [m.key for m in msgs] == ["MODEL-REF"]
+    ref_path = Path(msgs[0].message)
+    assert ref_path.exists()
+    assert pmml_io.find(pmml_io.read_pmml(ref_path), "Extension") is not None
+
+
+def test_no_data_no_model(tmp_path):
+    cfg = make_config(tmp_path, candidates=1)
+    update = MockMLUpdate(cfg)
+    update.run_update(1, [], [], str(tmp_path / "model"), None)
+    assert update.train_counts == []
+    assert not (tmp_path / "model").exists()
+
+
+def test_zero_test_fraction_forces_single_candidate(tmp_path):
+    cfg = make_config(tmp_path, candidates=5, test_fraction=0.0)
+    update = MockMLUpdate(cfg)
+    assert update.candidates == 1
+    update.run_update(2, data(10), [], str(tmp_path / "model"), None)
+    # single candidate trained on everything, NaN eval accepted
+    assert update.train_counts == [10]
+    assert (tmp_path / "model" / "2" / "model.pmml").exists()

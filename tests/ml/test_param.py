@@ -1,0 +1,86 @@
+"""Hyperparam range tests (reference: HyperParamsTest)."""
+
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.ml import param as hp
+
+
+def test_continuous_range_trials():
+    r = hp.range_param(0.0, 1.0)
+    assert r.get_trial_values(1) == [0.5]
+    assert r.get_trial_values(2) == [0.0, 1.0]
+    assert r.get_trial_values(3) == [0.0, 0.5, 1.0]
+    assert hp.range_param(2.0, 2.0).get_trial_values(5) == [2.0]
+
+
+def test_discrete_range_trials():
+    r = hp.range_param(1, 10)
+    assert r.get_trial_values(1) == [5]
+    assert r.get_trial_values(2) == [1, 10]
+    assert r.get_trial_values(4) == [1, 4, 7, 10]
+    # dense enumeration when num > span
+    assert hp.range_param(1, 3).get_trial_values(10) == [1, 2, 3]
+
+
+def test_around_trials():
+    assert hp.around(5.0, 1.0).get_trial_values(3) == [4.0, 5.0, 6.0]
+    assert hp.around(5.0, 1.0).get_trial_values(1) == [5.0]
+    assert hp.around(10, 2).get_trial_values(3) == [8, 10, 12]
+    assert hp.around(10, 2).get_trial_values(2) == [9, 11]
+
+
+def test_unordered():
+    u = hp.unordered(["a", "b", "c"])
+    assert u.get_trial_values(2) == ["a", "b"]
+    assert u.get_trial_values(5) == ["a", "b", "c"]
+
+
+def test_from_config():
+    cfg = C.from_string(
+        """
+        a = 7
+        b = 0.5
+        c = [2, 8]
+        d = [0.1, 0.9]
+        e = ["x", "y"]
+        f = "gini"
+        """
+    )
+    assert hp.from_config(cfg, "a").get_trial_values(1) == [7]
+    assert hp.from_config(cfg, "b").get_trial_values(1) == [0.5]
+    assert hp.from_config(cfg, "c").get_trial_values(2) == [2, 8]
+    assert hp.from_config(cfg, "d").get_trial_values(2) == [0.1, 0.9]
+    assert hp.from_config(cfg, "e").get_trial_values(9) == ["x", "y"]
+    assert hp.from_config(cfg, "f").get_trial_values(1) == ["gini"]
+
+
+def test_choose_values_per_hyper_param():
+    assert hp.choose_values_per_hyper_param(0, 10) == 0
+    assert hp.choose_values_per_hyper_param(1, 1) == 1
+    assert hp.choose_values_per_hyper_param(1, 3) == 3
+    assert hp.choose_values_per_hyper_param(2, 9) == 3
+    assert hp.choose_values_per_hyper_param(2, 10) == 4
+    assert hp.choose_values_per_hyper_param(3, 8) == 2
+
+
+def test_combos_full_grid_and_subset():
+    ranges = [hp.range_param(1, 3), hp.unordered(["x", "y"])]
+    combos = hp.choose_hyper_parameter_combos(ranges, 100, 2)
+    assert len(combos) == 4  # 2 * 2
+    assert sorted(map(tuple, combos)) == [(1, "x"), (1, "y"), (3, "x"), (3, "y")]
+    subset = hp.choose_hyper_parameter_combos(ranges, 2, 2)
+    assert len(subset) == 2
+    assert all(tuple(c) in {(1, "x"), (1, "y"), (3, "x"), (3, "y")} for c in subset)
+    # distinct picks
+    assert len(set(map(tuple, subset))) == 2
+
+
+def test_combos_empty():
+    assert hp.choose_hyper_parameter_combos([], 5, 3) == [[]]
+    assert hp.choose_hyper_parameter_combos([hp.fixed(1)], 5, 0) == [[]]
+
+
+def test_combos_cap():
+    with pytest.raises(ValueError):
+        hp.choose_hyper_parameter_combos([hp.fixed(1)] * 10, 1, 10)

@@ -151,3 +151,21 @@ def test_context_path():
         assert http("GET", f"{base}/ready")[0] == 404
     finally:
         layer.close()
+
+
+def test_head_routes_like_get_with_empty_body():
+    broker_loc = "inproc://serve-head"
+    layer = ServingLayer(make_config(broker_loc))
+    layer.start()
+    base = f"http://127.0.0.1:{layer.port}"
+    try:
+        status, body, _ = http("HEAD", f"{base}/ready")
+        assert status in (200, 503)
+        assert body == b""
+    finally:
+        layer.close()
+
+
+def test_username_without_password_refused():
+    with pytest.raises(ValueError):
+        ServingLayer(make_config("inproc://serve-badauth", **{"api.user-name": '"u"'}))
