@@ -1,0 +1,72 @@
+"""Headline benchmark: ALS /recommend throughput on TPU.
+
+Reproduces the reference's LoadBenchmark shape (app/oryx-app-serving/src/
+test/.../als/LoadBenchmark.java + LoadTestALSModelFactory.java:34-101):
+a synthetic model of `items` x `features` with random unit-ish factors,
+then timed top-10 recommend queries for random users. The reference's
+best published number at 50 features x 1M items is 437 qps (LSH
+sample-rate 0.3, 32-core Xeon; docs/performance.md:108-117) — that is
+the vs_baseline denominator. Here each query is ONE batched matvec +
+top_k on the TPU over the full item matrix (exact, not approximate LSH).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs (LoadTestALSModelFactory-style): ORYX_BENCH_ITEMS,
+ORYX_BENCH_FEATURES, ORYX_BENCH_USERS, ORYX_BENCH_SECONDS,
+ORYX_BENCH_BATCH (request batch size; 1 = reference-like serial requests).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    items = int(os.environ.get("ORYX_BENCH_ITEMS", 1_000_000))
+    features = int(os.environ.get("ORYX_BENCH_FEATURES", 50))
+    users = int(os.environ.get("ORYX_BENCH_USERS", 1024))
+    seconds = float(os.environ.get("ORYX_BENCH_SECONDS", 10.0))
+    batch = int(os.environ.get("ORYX_BENCH_BATCH", 16))
+    how_many = 10
+    baseline_qps = 437.0  # reference: LSH 0.3, 50 feat x 1M items
+
+    from oryx_tpu.ops import topn as topn_ops
+
+    gen = np.random.default_rng(1234)
+    y = gen.standard_normal((items, features), dtype=np.float32)
+    x = gen.standard_normal((users, features), dtype=np.float32)
+
+    uploaded = topn_ops.upload(y)
+    # warm up / compile
+    topn_ops.top_k_scores_batch(uploaded, x[:batch], how_many)
+    topn_ops.top_k_scores(uploaded, x[0], how_many)
+
+    served = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < seconds:
+        qi = (served // batch) % max(1, users // batch)
+        queries = x[qi * batch : qi * batch + batch]
+        if batch == 1:
+            topn_ops.top_k_scores(uploaded, queries[0], how_many)
+        else:
+            topn_ops.top_k_scores_batch(uploaded, queries, how_many)
+        served += batch
+    elapsed = time.perf_counter() - start
+    qps = served / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": f"ALS recommend top-{how_many} qps ({features} feat x {items} items, batch {batch})",
+                "value": round(qps, 1),
+                "unit": "recs/sec",
+                "vs_baseline": round(qps / baseline_qps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+"""App tier: the packaged end-to-end ML applications (ALS, k-means, RDF)
+plus shared schema/PMML glue — rebuild of app/oryx-app-common,
+app/oryx-app-mllib, app/oryx-app and app/oryx-app-serving
+(SURVEY.md §2.7-2.10).
+"""

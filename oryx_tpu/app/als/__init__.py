@@ -1,0 +1,4 @@
+"""ALS collaborative-filtering application: batch trainer, speed-layer
+fold-in, serving model + REST endpoints (reference app components in
+SURVEY.md §2.7-2.10 under als/).
+"""

@@ -1,0 +1,144 @@
+"""Shared incremental-ALS state and math.
+
+Rebuild of app/oryx-app-common .../als/FeatureVectors.java:36-161 (a
+concurrent id -> float32-vector store with recent-ID tracking and
+rotation reconciliation) and ALSUtils.java:24-108 (the fold-in update:
+how a user vector changes in response to one new interaction, used on the
+speed- and serving-layer hot paths).
+
+IDs are strings end to end. (The reference hashes string IDs to int32
+because Spark MLlib requires int IDs, ALSUpdate.java:305-326; the JAX
+trainer indexes rows directly so no lossy hash is needed.)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import numpy as np
+
+from oryx_tpu.common.lang import ReadWriteLock
+from oryx_tpu.common.vectormath import Solver
+
+
+class FeatureVectors:
+    """Concurrent ID -> float32 vector store (FeatureVectors.java)."""
+
+    def __init__(self) -> None:
+        self._lock = ReadWriteLock()
+        self._vectors: dict[str, np.ndarray] = {}
+        self._recent_ids: set[str] = set()
+
+    def size(self) -> int:
+        with self._lock.read():
+            return len(self._vectors)
+
+    def get_vector(self, id_: str) -> np.ndarray | None:
+        with self._lock.read():
+            return self._vectors.get(id_)
+
+    def set_vector(self, id_: str, vector: np.ndarray) -> None:
+        vector = np.asarray(vector, dtype=np.float32)
+        with self._lock.write():
+            self._vectors[id_] = vector
+            self._recent_ids.add(id_)
+
+    def remove_vector(self, id_: str) -> None:
+        with self._lock.write():
+            self._vectors.pop(id_, None)
+            self._recent_ids.discard(id_)
+
+    def add_all_ids_to(self, out: set[str]) -> None:
+        with self._lock.read():
+            out.update(self._vectors.keys())
+
+    def add_all_recent_to(self, out: set[str]) -> None:
+        with self._lock.read():
+            out.update(self._recent_ids)
+
+    def retain_recent_and_ids(self, new_model_ids: set[str]) -> None:
+        """On model rotation keep only ids in the new model OR written
+        since the last rotation, then reset recency
+        (FeatureVectors.retainRecentAndIDs:131-136 — this is what makes
+        'recent writes survive model swap' true)."""
+        with self._lock.write():
+            keep = self._recent_ids | new_model_ids
+            for id_ in [i for i in self._vectors if i not in keep]:
+                del self._vectors[id_]
+            self._recent_ids.clear()
+
+    def items(self) -> list[tuple[str, np.ndarray]]:
+        with self._lock.read():
+            return list(self._vectors.items())
+
+    def ids(self) -> list[str]:
+        with self._lock.read():
+            return list(self._vectors.keys())
+
+    def for_each(self, fn: Callable[[str, np.ndarray], None]) -> None:
+        for id_, v in self.items():
+            fn(id_, v)
+
+    def get_vtv(self) -> np.ndarray | None:
+        """V^T V over all vectors (FeatureVectors.getVTV:150-154)."""
+        with self._lock.read():
+            if not self._vectors:
+                return None
+            m = np.stack(list(self._vectors.values())).astype(np.float64)
+        return m.T @ m
+
+    def to_matrix(self) -> tuple[list[str], np.ndarray]:
+        """Packed (ids, [n, k] float32 matrix) snapshot, for device upload."""
+        with self._lock.read():
+            if not self._vectors:
+                return [], np.zeros((0, 0), dtype=np.float32)
+            ids = list(self._vectors.keys())
+            mat = np.stack([self._vectors[i] for i in ids])
+        return ids, mat
+
+
+# -- fold-in math (ALSUtils) -------------------------------------------------
+
+
+def compute_target_qui(implicit: bool, value: float, current_value: float) -> float:
+    """Target estimated interaction strength after a new interaction of
+    the given value, or NaN for "no change" (ALSUtils.computeTargetQui:
+    37-59). Implicit targets move part of the way from the current
+    estimate toward 1 (positive value) or 0 (negative), proportionally to
+    the interaction strength; explicit targets are the value itself."""
+    if not implicit:
+        return value
+    if value > 0.0 and current_value < 1.0:
+        diff = 1.0 - max(0.0, current_value)
+        return current_value + (value / (1.0 + value)) * diff
+    if value < 0.0 and current_value > 0.0:
+        diff = -min(1.0, current_value)
+        return current_value + (value / (value - 1.0)) * diff
+    return math.nan
+
+
+def compute_updated_xu(
+    solver: Solver,
+    value: float,
+    xu: np.ndarray | None,
+    yi: np.ndarray | None,
+    implicit: bool,
+) -> np.ndarray | None:
+    """New user vector after one (user, item, value) interaction, or None
+    when no update applies (ALSUtils.computeUpdatedXu:74-106). Also used
+    with roles swapped to update item vectors. Solves
+    dXu = (YtY)^-1 (dQui * Yi) and adds it to Xu."""
+    if yi is None:
+        return None
+    yi = np.asarray(yi, dtype=np.float32)
+    qui = 0.0 if xu is None else float(np.dot(np.asarray(xu, dtype=np.float64), yi))
+    # 0.5 reflects a "don't know" prior for a brand-new user
+    target_qui = compute_target_qui(implicit, value, 0.5 if xu is None else qui)
+    if math.isnan(target_qui):
+        return None
+    d_qui = target_qui - qui
+    d_xu = solver.solve_f_to_f(d_qui * yi)
+    if xu is None:
+        return d_xu
+    return np.asarray(xu, dtype=np.float32) + d_xu

@@ -1,0 +1,121 @@
+"""ALS input parsing and aggregation.
+
+Rebuild of the data-prep stages of ALSUpdate (app/oryx-app-mllib/.../als/
+ALSUpdate.java): input lines are ``user,item,value[,timestamp]`` (CSV or
+JSON array; empty value = delete, parsed as NaN, ALSUpdate.java:260-278);
+time-decay multiplies old strengths by factor^days (decayRating:292-298)
+then prunes below the zero threshold; aggregation combines repeated
+(user,item) pairs — implicit: sum with NaN poisoning (delete wins over
+the aggregate, MLFunctions.SUM_WITH_NAN), explicit: last value in
+timestamp order wins (aggregateScores:332-352) — and NaN aggregates are
+dropped (deletes).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Iterable
+
+import numpy as np
+
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common.text import parse_line
+
+
+@dataclass
+class Interaction:
+    user: str
+    item: str
+    value: float  # NaN = delete marker
+    timestamp_ms: int
+
+
+def parse_interactions(data: Iterable[KeyMessage | str]) -> list[Interaction]:
+    """Parse lines, in input order. Lines missing a timestamp get 0 so
+    pure-CSV triples still work in time-ordered contexts."""
+    out: list[Interaction] = []
+    for rec in data:
+        line = rec.message if isinstance(rec, KeyMessage) else rec
+        tokens = parse_line(line)
+        if len(tokens) < 3:
+            raise ValueError(f"bad ALS input: {line!r}")
+        value = math.nan if tokens[2] == "" else float(tokens[2])
+        ts = int(float(tokens[3])) if len(tokens) > 3 and tokens[3] != "" else 0
+        out.append(Interaction(tokens[0], tokens[1], value, ts))
+    return out
+
+
+def decay_interactions(
+    interactions: list[Interaction],
+    factor: float,
+    zero_threshold: float,
+    now_ms: int | None = None,
+) -> list[Interaction]:
+    if factor < 1.0:
+        now = int(time.time() * 1000) if now_ms is None else now_ms
+        decayed = []
+        for it in interactions:
+            if it.timestamp_ms >= now or math.isnan(it.value):
+                decayed.append(it)
+            else:
+                days = (now - it.timestamp_ms) / 86_400_000.0
+                decayed.append(
+                    Interaction(it.user, it.item, it.value * factor**days, it.timestamp_ms)
+                )
+        interactions = decayed
+    if zero_threshold > 0.0:
+        interactions = [
+            it for it in interactions if math.isnan(it.value) or it.value > zero_threshold
+        ]
+    return interactions
+
+
+def aggregate(interactions: list[Interaction], implicit: bool) -> dict[tuple[str, str], float]:
+    """Combine repeated (user,item) pairs; drop NaN aggregates (deletes)."""
+    interactions = sorted(interactions, key=lambda it: it.timestamp_ms)
+    agg: dict[tuple[str, str], float] = {}
+    for it in interactions:
+        key = (it.user, it.item)
+        if implicit:
+            prev = agg.get(key)
+            # NaN anywhere poisons the sum => delete
+            agg[key] = it.value if prev is None else prev + it.value
+        else:
+            agg[key] = it.value  # last wins
+    return {k: v for k, v in agg.items() if not math.isnan(v)}
+
+
+@dataclass
+class RatingMatrix:
+    """Indexed COO ready for the trainer."""
+
+    user_ids: list[str]
+    item_ids: list[str]
+    user_idx: np.ndarray  # int32
+    item_idx: np.ndarray  # int32
+    values: np.ndarray  # float32
+
+    @property
+    def known_items(self) -> dict[str, set[str]]:
+        known: dict[str, set[str]] = {}
+        for u, i in zip(self.user_idx, self.item_idx):
+            known.setdefault(self.user_ids[u], set()).add(self.item_ids[i])
+        return known
+
+
+def to_rating_matrix(agg: dict[tuple[str, str], float]) -> RatingMatrix:
+    user_ids = sorted({u for u, _ in agg})
+    item_ids = sorted({i for _, i in agg})
+    u_index = {u: n for n, u in enumerate(user_ids)}
+    i_index = {i: n for n, i in enumerate(item_ids)}
+    n = len(agg)
+    uu = np.empty(n, dtype=np.int32)
+    ii = np.empty(n, dtype=np.int32)
+    vv = np.empty(n, dtype=np.float32)
+    for pos, ((u, i), v) in enumerate(agg.items()):
+        uu[pos] = u_index[u]
+        ii[pos] = i_index[i]
+        vv[pos] = v
+    return RatingMatrix(user_ids, item_ids, uu, ii, vv)

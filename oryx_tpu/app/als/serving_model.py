@@ -1,0 +1,297 @@
+"""ALS serving model: in-memory factors + batched on-device top-N.
+
+Rebuild of ALSServingModel (app/oryx-app-serving/.../als/model/
+ALSServingModel.java:58-496) and its manager (ALSServingModelManager.java:
+46-176), redesigned TPU-first: where the reference shards the item matrix
+into LSH partitions scanned by a thread pool (LocalitySensitiveHash.java,
+TopNConsumer.java), this model keeps a packed device copy of Y and
+computes top-N as ONE batched matvec + lax.top_k on the accelerator — an
+exact scan that is faster than the reference's approximate LSH probe at
+millions of items (SURVEY.md §2.12 'Request parallelism'). The packed
+copy refreshes lazily when vectors change (the survey's 'periodic
+re-upload of dirty shards' strategy for incremental state vs immutable
+device arrays).
+
+State mirrored from the reference: X and Y FeatureVectors, per-user
+known-item sets, expected-ID sets driving get_fraction_loaded
+(ALSServingModel.java:461-475), a cached YtY solver invalidated on Y
+writes (:357-373), and retain-recent rotation (:382-441).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.als.common import FeatureVectors
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import ReadWriteLock
+from oryx_tpu.common.text import read_json
+from oryx_tpu.common.vectormath import Solver, get_solver
+from oryx_tpu.ops import topn as topn_ops
+
+log = logging.getLogger(__name__)
+
+
+class ALSServingModel(ServingModel):
+    def __init__(self, features: int, implicit: bool, refresh_sec: float = 0.2) -> None:
+        self.features = features
+        self.implicit = implicit
+        self.x = FeatureVectors()
+        self.y = FeatureVectors()
+        self._known_lock = ReadWriteLock()
+        self._known_items: dict[str, set[str]] = {}
+        self._expected_users: set[str] = set()
+        self._expected_items: set[str] = set()
+        self._solver_lock = threading.Lock()
+        self._yty_solver: Solver | None = None
+        # packed device copy of Y
+        self._cache_lock = threading.Lock()
+        self._y_dirty = True
+        self._y_built_at = 0.0
+        self._refresh_sec = refresh_sec
+        self._y_ids: list[str] = []
+        self._y_index: dict[str, int] = {}
+        self._y_matrix = None  # device array [n, k]
+
+    # -- vectors -------------------------------------------------------------
+
+    def get_user_vector(self, user: str) -> np.ndarray | None:
+        return self.x.get_vector(user)
+
+    def get_item_vector(self, item: str) -> np.ndarray | None:
+        return self.y.get_vector(item)
+
+    def set_user_vector(self, user: str, vector: np.ndarray) -> None:
+        self.x.set_vector(user, vector)
+        self._expected_users.discard(user)
+
+    def set_item_vector(self, item: str, vector: np.ndarray) -> None:
+        self.y.set_vector(item, vector)
+        self._expected_items.discard(item)
+        with self._solver_lock:
+            self._yty_solver = None
+        with self._cache_lock:
+            self._y_dirty = True
+
+    # -- known items (ALSServingModel.java:189-258) --------------------------
+
+    def add_known_items(self, user: str, items: Iterable[str]) -> None:
+        items = list(items)
+        if not items:
+            return
+        with self._known_lock.write():
+            self._known_items.setdefault(user, set()).update(items)
+
+    def get_known_items(self, user: str) -> set[str]:
+        with self._known_lock.read():
+            return set(self._known_items.get(user, ()))
+
+    def remove_known_item(self, user: str, item: str) -> None:
+        with self._known_lock.write():
+            s = self._known_items.get(user)
+            if s is not None:
+                s.discard(item)
+
+    def get_known_item_counts(self) -> dict[str, int]:
+        with self._known_lock.read():
+            return {u: len(s) for u, s in self._known_items.items()}
+
+    def get_item_counts(self) -> dict[str, int]:
+        """item -> number of users that know it, in one locked pass
+        (ALSServingModel.getItemCounts analogue)."""
+        counts: dict[str, int] = {}
+        with self._known_lock.read():
+            for items in self._known_items.values():
+                for item in items:
+                    counts[item] = counts.get(item, 0) + 1
+        return counts
+
+    # -- expected-ID accounting ----------------------------------------------
+
+    def set_expected(self, user_ids: Iterable[str], item_ids: Iterable[str]) -> None:
+        self._expected_users = set(user_ids) - set(self.x.ids())
+        self._expected_items = set(item_ids) - set(self.y.ids())
+
+    def get_fraction_loaded(self) -> float:
+        expected = len(self._expected_users) + len(self._expected_items)
+        loaded = self.x.size() + self.y.size()
+        if expected + loaded == 0:
+            return 1.0
+        return loaded / (loaded + expected)
+
+    # -- rotation (retainRecentAnd*: 382-441) --------------------------------
+
+    def retain_recent_and_user_ids(self, ids: set[str]) -> None:
+        self.x.retain_recent_and_ids(ids)
+
+    def retain_recent_and_item_ids(self, ids: set[str]) -> None:
+        self.y.retain_recent_and_ids(ids)
+        with self._cache_lock:
+            self._y_dirty = True
+
+    def retain_recent_and_known_items(self, user_ids: set[str]) -> None:
+        with self._known_lock.write():
+            for u in [u for u in self._known_items if u not in user_ids]:
+                del self._known_items[u]
+
+    # -- solver --------------------------------------------------------------
+
+    def get_yty_solver(self) -> Solver | None:
+        with self._solver_lock:
+            if self._yty_solver is None:
+                self._yty_solver = get_solver(self.y.get_vtv())
+            return self._yty_solver
+
+    # -- device-side scoring ---------------------------------------------------
+
+    def _ensure_y_matrix(self, force: bool = False):
+        with self._cache_lock:
+            now = time.monotonic()
+            if self._y_dirty and (force or now - self._y_built_at >= self._refresh_sec):
+                ids, mat = self.y.to_matrix()
+                self._y_ids = ids
+                self._y_index = {id_: i for i, id_ in enumerate(ids)}
+                self._y_matrix = topn_ops.upload(mat) if len(ids) else None
+                self._y_dirty = False
+                self._y_built_at = now
+            return self._y_ids, self._y_index, self._y_matrix
+
+    def top_n(
+        self,
+        query: np.ndarray,
+        how_many: int,
+        exclude: set[str] | None = None,
+        rescorer=None,
+        cosine: bool = False,
+    ) -> list[tuple[str, float]]:
+        """Top-N items by dot (or cosine) score against `query`: one
+        batched device matvec + top_k, replacing the reference's
+        LSH-partitioned thread-pool scan (ALSServingModel.topN:289-335)."""
+        ids, index, y_mat = self._ensure_y_matrix()
+        if y_mat is None:
+            return []
+        exclude = exclude or set()
+        margin = how_many + len(exclude)
+        if rescorer is not None:
+            margin = max(margin * 4, margin + 32)  # rescorer may filter many
+        # widen the candidate window until how_many survive filtering or
+        # every item has been considered (the reference streams all items,
+        # ALSServingModel.topN:289-335, so filters can never starve results)
+        while True:
+            k = min(margin, len(ids))
+            idx, scores = topn_ops.top_k_scores(y_mat, query, k, cosine=cosine)
+            out: list[tuple[str, float]] = []
+            for i, s in zip(idx, scores):
+                id_ = ids[int(i)]
+                if id_ in exclude:
+                    continue
+                score = float(s)
+                if rescorer is not None:
+                    if rescorer.is_filtered(id_):
+                        continue
+                    score = rescorer.rescore(id_, score)
+                    if np.isnan(score):
+                        continue
+                out.append((id_, score))
+                if len(out) == how_many and rescorer is None:
+                    break
+            if len(out) >= how_many or k >= len(ids):
+                break
+            margin = margin * 4
+        if rescorer is not None:
+            out.sort(key=lambda t: -t[1])
+        return out[:how_many]
+
+    def all_item_ids(self) -> list[str]:
+        return self.y.ids()
+
+    def all_user_ids(self) -> list[str]:
+        return self.x.ids()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ALSServingModel[features={self.features}, X={self.x.size()}, Y={self.y.size()}]"
+
+
+class ALSServingModelManager(AbstractServingModelManager):
+    """Consume protocol identical to the speed manager plus known-items
+    from UP payloads and rescorer loading
+    (ALSServingModelManager.java:46-176)."""
+
+    def __init__(self, config: Config) -> None:
+        super().__init__(config)
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.sample_rate = config.get_float("oryx.als.sample-rate")
+        self.rescorer_provider = _load_rescorer_providers(config)
+        self.model: ALSServingModel | None = None
+        self._consumed = 0
+
+    def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
+        for km in update_iterator:
+            key, message = km.key, km.message
+            if key == "UP":
+                if self.model is None:
+                    continue
+                update = read_json(message)
+                which, id_ = update[0], str(update[1])
+                vector = np.asarray(update[2], dtype=np.float32)
+                if which == "X":
+                    self.model.set_user_vector(id_, vector)
+                    if len(update) > 3 and not self.no_known_items:
+                        self.model.add_known_items(id_, [str(i) for i in update[3]])
+                elif which == "Y":
+                    self.model.set_item_vector(id_, vector)
+            elif key in ("MODEL", "MODEL-REF"):
+                pmml = app_pmml.read_pmml_from_update_message(key, message)
+                if pmml is None:
+                    log.warning("dropped unreadable model update")
+                    continue
+                features = int(app_pmml.get_required_extension_value(pmml, "features"))
+                implicit = app_pmml.get_required_extension_value(pmml, "implicit") == "true"
+                x_ids = set(app_pmml.get_extension_content(pmml, "XIDs") or [])
+                y_ids = set(app_pmml.get_extension_content(pmml, "YIDs") or [])
+                if (
+                    self.model is None
+                    or self.model.features != features
+                    or self.model.implicit != implicit
+                ):
+                    self.model = ALSServingModel(features, implicit)
+                    self.model.set_expected(x_ids, y_ids)
+                else:
+                    self.model.retain_recent_and_user_ids(x_ids)
+                    self.model.retain_recent_and_item_ids(y_ids)
+                    self.model.retain_recent_and_known_items(
+                        x_ids | set(self.model.all_user_ids())
+                    )
+                    self.model.set_expected(x_ids, y_ids)
+            else:
+                raise ValueError(f"bad key {key}")
+            self._consumed += 1
+            if self._consumed % 10_000 == 0:
+                log.info("%s updates consumed; model: %r", self._consumed, self.model)
+
+    def get_model(self) -> ALSServingModel | None:
+        return self.model
+
+
+def _load_rescorer_providers(config: Config):
+    """Load RescorerProvider chain from oryx.als.rescorer-provider-class
+    (ALSServingModelManager.java:141-174)."""
+    names = config.get_optional_strings("oryx.als.rescorer-provider-class")
+    if not names:
+        return None
+    from oryx_tpu.app.als.rescorer import MultiRescorerProvider
+    from oryx_tpu.common.lang import load_instance_of
+
+    providers = [load_instance_of(n) for n in names]
+    if len(providers) == 1:
+        return providers[0]
+    return MultiRescorerProvider(providers)

@@ -1,0 +1,169 @@
+"""ALS speed layer: incremental fold-in updates.
+
+Rebuild of ALSSpeedModel (app/oryx-app/.../speed/als/ALSSpeedModel.java:
+35-151) and ALSSpeedModelManager (.../ALSSpeedModelManager.java:51-217):
+the model holds X/Y FeatureVectors plus the expected-ID sets from the
+last batch MODEL (for load-fraction accounting), with cached XtX / YtY
+solvers; per micro-batch, each aggregated (user,item,value) event updates
+BOTH the user vector (against YtY) and the item vector (against XtX) via
+the ALSUtils fold-in, publishing ["X",user,vec[,knownItems]] /
+["Y",item,vec[,knownUsers]] deltas.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from oryx_tpu.api.speed import SpeedModel, SpeedModelManager
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.als import data as als_data
+from oryx_tpu.app.als.common import FeatureVectors, compute_updated_xu
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.text import join_json, read_json
+from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
+
+log = logging.getLogger(__name__)
+
+
+class ALSSpeedModel(SpeedModel):
+    def __init__(
+        self,
+        features: int,
+        implicit: bool,
+        expected_user_ids: set[str],
+        expected_item_ids: set[str],
+    ) -> None:
+        self.features = features
+        self.implicit = implicit
+        self.x = FeatureVectors()
+        self.y = FeatureVectors()
+        self._expected_users = set(expected_user_ids)
+        self._expected_items = set(expected_item_ids)
+        self._solver_lock = threading.Lock()
+        self._xtx_solver: Solver | None = None
+        self._yty_solver: Solver | None = None
+
+    def set_user_vector(self, user: str, vector: np.ndarray) -> None:
+        self.x.set_vector(user, vector)
+        self._expected_users.discard(user)
+        with self._solver_lock:
+            self._xtx_solver = None
+
+    def set_item_vector(self, item: str, vector: np.ndarray) -> None:
+        self.y.set_vector(item, vector)
+        self._expected_items.discard(item)
+        with self._solver_lock:
+            self._yty_solver = None
+
+    def get_xtx_solver(self) -> Solver | None:
+        with self._solver_lock:
+            if self._xtx_solver is None:
+                self._xtx_solver = get_solver(self.x.get_vtv())
+            return self._xtx_solver
+
+    def get_yty_solver(self) -> Solver | None:
+        with self._solver_lock:
+            if self._yty_solver is None:
+                self._yty_solver = get_solver(self.y.get_vtv())
+            return self._yty_solver
+
+    def retain_recent_and_ids(self, user_ids: set[str], item_ids: set[str]) -> None:
+        self.x.retain_recent_and_ids(user_ids)
+        self.y.retain_recent_and_ids(item_ids)
+
+    def get_fraction_loaded(self) -> float:
+        """Loaded fraction vs expected IDs (ALSSpeedModel.java:128-142)."""
+        expected = len(self._expected_users) + len(self._expected_items)
+        loaded = self.x.size() + self.y.size()
+        if expected + loaded == 0:
+            return 1.0
+        return loaded / (loaded + expected)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ALSSpeedModel[features={self.features}, X={self.x.size()}, Y={self.y.size()}]"
+
+
+class ALSSpeedModelManager(SpeedModelManager):
+    def __init__(self, config: Config) -> None:
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.model: ALSSpeedModel | None = None
+
+    # -- update-topic consumption (ALSSpeedModelManager.consume:74-126) ------
+
+    def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
+        for km in update_iterator:
+            key, message = km.key, km.message
+            if key == "UP":
+                if self.model is None:
+                    continue  # no model to interpret against yet
+                update = read_json(message)
+                which, id_ = update[0], str(update[1])
+                vector = np.asarray(update[2], dtype=np.float32)
+                if which == "X":
+                    self.model.set_user_vector(id_, vector)
+                elif which == "Y":
+                    self.model.set_item_vector(id_, vector)
+            elif key in ("MODEL", "MODEL-REF"):
+                pmml = app_pmml.read_pmml_from_update_message(key, message)
+                if pmml is None:
+                    log.warning("dropped unreadable model update")
+                    continue
+                features = int(app_pmml.get_required_extension_value(pmml, "features"))
+                implicit = app_pmml.get_required_extension_value(pmml, "implicit") == "true"
+                x_ids = set(app_pmml.get_extension_content(pmml, "XIDs") or [])
+                y_ids = set(app_pmml.get_extension_content(pmml, "YIDs") or [])
+                if (
+                    self.model is None
+                    or self.model.features != features
+                    or self.model.implicit != implicit
+                ):
+                    self.model = ALSSpeedModel(features, implicit, x_ids, y_ids)
+                else:
+                    # same config: rotate, keeping recent writes + new model IDs
+                    self.model.retain_recent_and_ids(x_ids, y_ids)
+            else:
+                raise ValueError(f"bad key {key}")
+
+    # -- micro-batch deltas (ALSSpeedModelManager.buildUpdates:135-205) ------
+
+    def build_updates(self, new_data: Iterable[KeyMessage]) -> Iterable[str]:
+        model = self.model
+        if model is None:
+            return []
+        interactions = als_data.parse_interactions(new_data)
+        agg = als_data.aggregate(interactions, self.implicit)
+        if not agg:
+            return []
+        try:
+            yty = model.get_yty_solver()
+            xtx = model.get_xtx_solver()
+        except SingularMatrixSolverException as e:
+            log.warning("model too degenerate to fold in updates: %s", e)
+            return []
+        if yty is None or xtx is None:
+            return []
+        out: list[str] = []
+        for (user, item), value in agg.items():
+            xu = model.x.get_vector(user)
+            yi = model.y.get_vector(item)
+            new_xu = compute_updated_xu(yty, value, xu, yi, self.implicit)
+            new_yi = compute_updated_xu(xtx, value, yi, xu, self.implicit)
+            if new_xu is not None:
+                out.append(self._to_update_json("X", user, new_xu, item))
+            if new_yi is not None:
+                out.append(self._to_update_json("Y", item, new_yi, user))
+        return out
+
+    def _to_update_json(self, matrix: str, id_: str, vector: np.ndarray, other_id: str) -> str:
+        if self.no_known_items:
+            return join_json([matrix, id_, vector.tolist()])
+        return join_json([matrix, id_, vector.tolist(), [other_id]])
+
+    def close(self) -> None:
+        pass

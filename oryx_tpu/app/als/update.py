@@ -1,0 +1,240 @@
+"""ALS batch trainer: the MLUpdate implementation.
+
+Rebuild of ALSUpdate (app/oryx-app-mllib/.../als/ALSUpdate.java:65-506)
+with the MLlib hot loop replaced by the JAX kernel in oryx_tpu.ops.als:
+
+- build_model: parse -> decay -> aggregate -> indexed COO -> train_als on
+  the device mesh; factors exported as gzip JSON-lines shards under X/
+  and Y/ in the candidate dir (mfModelToPMML/saveFeaturesRDD:359-426
+  artifact shape), PMML skeleton carries features/lambda/alpha/implicit
+  and the expected-ID lists (XIDs/YIDs extensions) consumers use for
+  load-fraction accounting and rotation.
+- evaluate: implicit -> mean per-user AUC; explicit -> negated RMSE
+  (ALSUpdate.evaluate:156-177).
+- publish_additional_model_data: streams every Y row then every X row
+  (with known items) to the update topic as "UP" messages
+  (ALSUpdate.java:194-230; Y first, matching the comment at
+  ALSSpeedModelManager.java:78-85).
+- time-ordered train/test split (splitNewDataToTrainTest:237-254).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import logging
+from pathlib import Path
+from typing import Iterable, Sequence
+from xml.etree.ElementTree import Element
+
+import numpy as np
+
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.als import data as als_data
+from oryx_tpu.bus.core import KeyMessage, TopicProducer
+from oryx_tpu.common import pmml as pmml_io, rng
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.text import join_json
+from oryx_tpu.ml import param as hp
+from oryx_tpu.ml.update import MLUpdate
+from oryx_tpu.ops import als as als_ops
+from oryx_tpu.parallel.mesh import get_mesh
+
+log = logging.getLogger(__name__)
+
+
+def _mesh_from_config(config: Config):
+    spec = config.get("oryx.batch.compute.mesh", None)
+    import jax
+
+    if spec is None:
+        if len(jax.devices()) > 1:
+            return get_mesh()
+        return None
+    return get_mesh(spec)
+
+
+class ALSUpdate(MLUpdate):
+    def __init__(self, config: Config) -> None:
+        super().__init__(config)
+        self.iterations = config.get_int("oryx.als.iterations")
+        self.implicit = config.get_bool("oryx.als.implicit")
+        self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.decay_factor = config.get_float("oryx.als.decay.factor")
+        self.decay_zero_threshold = config.get_float("oryx.als.decay.zero-threshold")
+        if not 0.0 < self.decay_factor <= 1.0:
+            raise ValueError("decay factor must be in (0,1]")
+        self._config = config
+
+    def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
+        c = self._config
+        return [
+            hp.from_config(c, "oryx.als.hyperparams.features"),
+            hp.from_config(c, "oryx.als.hyperparams.lambda"),
+            hp.from_config(c, "oryx.als.hyperparams.alpha"),
+        ]
+
+    # -- training ------------------------------------------------------------
+
+    def _prepare(self, data: Iterable[KeyMessage]) -> als_data.RatingMatrix:
+        interactions = als_data.parse_interactions(data)
+        interactions = als_data.decay_interactions(
+            interactions, self.decay_factor, self.decay_zero_threshold
+        )
+        agg = als_data.aggregate(interactions, self.implicit)
+        return als_data.to_rating_matrix(agg)
+
+    def build_model(
+        self,
+        train_data: list[KeyMessage],
+        hyper_parameters: Sequence,
+        candidate_path: Path,
+    ) -> Element:
+        features, lam, alpha = (
+            int(hyper_parameters[0]),
+            float(hyper_parameters[1]),
+            float(hyper_parameters[2]),
+        )
+        if features <= 0 or lam < 0 or alpha <= 0:
+            raise ValueError(f"bad hyperparams {hyper_parameters}")
+        rm = self._prepare(train_data)
+        if not rm.user_ids or not rm.item_ids:
+            raise ValueError("no (user, item) interactions to train on")
+        model = als_ops.train_als(
+            rm.user_idx,
+            rm.item_idx,
+            rm.values,
+            len(rm.user_ids),
+            len(rm.item_ids),
+            features=features,
+            lam=lam,
+            alpha=alpha,
+            implicit=self.implicit,
+            iterations=self.iterations,
+            mesh=_mesh_from_config(self._config),
+        )
+        _save_features(candidate_path / "X", rm.user_ids, model.x)
+        _save_features(candidate_path / "Y", rm.item_ids, model.y)
+        return self._model_to_pmml(features, lam, alpha, rm)
+
+    def _model_to_pmml(
+        self, features: int, lam: float, alpha: float, rm: als_data.RatingMatrix
+    ) -> Element:
+        root = pmml_io.build_skeleton_pmml()
+        app_pmml.add_extension(root, "X", "X/")
+        app_pmml.add_extension(root, "Y", "Y/")
+        app_pmml.add_extension(root, "features", features)
+        app_pmml.add_extension(root, "lambda", lam)
+        app_pmml.add_extension(root, "implicit", "true" if self.implicit else "false")
+        if self.implicit:
+            app_pmml.add_extension(root, "alpha", alpha)
+        app_pmml.add_extension_content(root, "XIDs", rm.user_ids)
+        app_pmml.add_extension_content(root, "YIDs", rm.item_ids)
+        return root
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(
+        self,
+        model: Element,
+        model_parent_path: Path,
+        test_data: list[KeyMessage],
+        train_data: list[KeyMessage],
+    ) -> float:
+        ids_x, x = _load_features(model_parent_path / "X")
+        ids_y, y = _load_features(model_parent_path / "Y")
+        rm_test = self._prepare(test_data)
+        u_index = {u: i for i, u in enumerate(ids_x)}
+        i_index = {i_: i for i, i_ in enumerate(ids_y)}
+        uu, ii, vv = [], [], []
+        for u_i, i_i, v in zip(rm_test.user_idx, rm_test.item_idx, rm_test.values):
+            u, it = rm_test.user_ids[u_i], rm_test.item_ids[i_i]
+            if u in u_index and it in i_index:
+                uu.append(u_index[u])
+                ii.append(i_index[it])
+                vv.append(v)
+        if not uu:
+            return float("nan")
+        uu = np.asarray(uu, dtype=np.int32)
+        ii = np.asarray(ii, dtype=np.int32)
+        vv = np.asarray(vv, dtype=np.float32)
+        if self.implicit:
+            return als_ops.mean_auc(x, y, uu, ii, rng.get_random())
+        return -als_ops.rmse(x, y, uu, ii, vv)
+
+    # -- publish -------------------------------------------------------------
+
+    def publish_additional_model_data(
+        self,
+        pmml: Element,
+        new_data: list[KeyMessage],
+        past_data: list[KeyMessage],
+        model_parent_path: Path,
+        model_update_topic: TopicProducer | None,
+    ) -> None:
+        if model_update_topic is None:
+            return
+        ids_y, y = _load_features(model_parent_path / "Y")
+        # Y first: item vectors must exist before user fold-ins make sense
+        for id_, vec in zip(ids_y, y):
+            model_update_topic.send("UP", join_json(["Y", id_, vec.tolist()]))
+        ids_x, x = _load_features(model_parent_path / "X")
+        known: dict[str, set[str]] = {}
+        if not self.no_known_items:
+            rm = self._prepare(list(new_data) + list(past_data))
+            known = rm.known_items
+        for id_, vec in zip(ids_x, x):
+            if self.no_known_items:
+                model_update_topic.send("UP", join_json(["X", id_, vec.tolist()]))
+            else:
+                model_update_topic.send(
+                    "UP", join_json(["X", id_, vec.tolist(), sorted(known.get(id_, ()))])
+                )
+
+    # -- split ---------------------------------------------------------------
+
+    def split_new_data_to_train_test(
+        self, new_data: list[KeyMessage]
+    ) -> tuple[list[KeyMessage], list[KeyMessage]]:
+        """Time-ordered split: the newest test-fraction is the test set
+        (ALSUpdate.splitNewDataToTrainTest:237-254)."""
+        if self.test_fraction <= 0.0:
+            return list(new_data), []
+        if self.test_fraction >= 1.0:
+            return [], list(new_data)
+        def ts_of(rec: KeyMessage) -> int:
+            from oryx_tpu.common.text import parse_line
+
+            tokens = parse_line(rec.message)
+            return int(float(tokens[3])) if len(tokens) > 3 and tokens[3] != "" else 0
+
+        ordered = sorted(new_data, key=ts_of)
+        split = int(round(len(ordered) * (1.0 - self.test_fraction)))
+        return ordered[:split], ordered[split:]
+
+
+# -- factor-matrix artifacts -------------------------------------------------
+
+
+def _save_features(dir_path: Path, ids: list[str], matrix: np.ndarray) -> None:
+    """Gzip JSON-lines shards of [id, [floats]] (saveFeaturesRDD:415-426)."""
+    dir_path.mkdir(parents=True, exist_ok=True)
+    with gzip.open(dir_path / "part-00000.json.gz", "wt", encoding="utf-8") as f:
+        for id_, row in zip(ids, matrix):
+            f.write(json.dumps([id_, [float(v) for v in row]]) + "\n")
+
+
+def _load_features(dir_path: Path) -> tuple[list[str], np.ndarray]:
+    ids: list[str] = []
+    rows: list[list[float]] = []
+    for part in sorted(Path(dir_path).glob("part-*.json.gz")):
+        with gzip.open(part, "rt", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    id_, vec = json.loads(line)
+                    ids.append(id_)
+                    rows.append(vec)
+    if not ids:
+        return [], np.zeros((0, 0), dtype=np.float32)
+    return ids, np.asarray(rows, dtype=np.float32)

@@ -1,0 +1,137 @@
+"""App-tier PMML glue.
+
+Rebuild of AppPMMLUtils (app/oryx-app-common/.../pmml/AppPMMLUtils.java:
+59-285): PMML Extension get/set (the ALS model's pointers live in
+extensions), DataDictionary/MiningSchema construction from an InputSchema,
+and resolution of update-topic model messages — "MODEL" carries inline
+PMML, "MODEL-REF" carries a path to read it from
+(readPMMLFromUpdateKeyMessage, AppPMMLUtils.java:256-285).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from xml.etree.ElementTree import Element
+
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+
+
+# -- extensions -------------------------------------------------------------
+
+
+def add_extension(root: Element, name: str, value) -> None:
+    pmml_io.sub(root, "Extension", {"name": name, "value": str(value)})
+
+
+def add_extension_content(root: Element, name: str, content: list) -> None:
+    """Extension whose content is a space-joined token list
+    (AppPMMLUtils.addExtensionContent). Tokens with spaces are quoted."""
+    if not content:
+        return
+    from oryx_tpu.common.text import join_delimited
+
+    e = pmml_io.sub(root, "Extension", {"name": name})
+    e.text = join_delimited([str(x) for x in content], " ")
+
+
+def get_extension_value(root: Element, name: str) -> str | None:
+    for ext in pmml_io.findall(root, "Extension"):
+        if ext.get("name") == name:
+            return ext.get("value")
+    return None
+
+
+def get_extension_content(root: Element, name: str) -> list[str] | None:
+    from oryx_tpu.common.text import parse_delimited
+
+    for ext in pmml_io.findall(root, "Extension"):
+        if ext.get("name") == name and ext.get("value") is None:
+            return parse_delimited(ext.text or "", " ")
+    return None
+
+
+def get_required_extension_value(root: Element, name: str) -> str:
+    v = get_extension_value(root, name)
+    if v is None:
+        raise ValueError(f"missing PMML extension {name}")
+    return v
+
+
+# -- schema -> PMML ---------------------------------------------------------
+
+
+def build_data_dictionary(
+    root: Element, schema: InputSchema, encodings: CategoricalValueEncodings | None = None
+) -> Element:
+    """DataDictionary from schema (AppPMMLUtils.buildDataDictionary:128-166)."""
+    dd = pmml_io.sub(root, "DataDictionary")
+    n = 0
+    for i, name in enumerate(schema.feature_names):
+        if not schema.is_active(i):
+            continue
+        n += 1
+        if schema.is_numeric(i):
+            pmml_io.sub(dd, "DataField", {"name": name, "optype": "continuous", "dataType": "double"})
+        else:
+            df = pmml_io.sub(dd, "DataField", {"name": name, "optype": "categorical", "dataType": "string"})
+            if encodings is not None:
+                for v, _ in sorted(
+                    encodings.value_to_index_map(i).items(), key=lambda kv: kv[1]
+                ):
+                    pmml_io.sub(df, "Value", {"value": v})
+    dd.set("numberOfFields", str(n))
+    return dd
+
+
+def build_mining_schema(
+    parent: Element, schema: InputSchema, importances: list[float] | None = None
+) -> Element:
+    """MiningSchema with target marked predicted, others active, with
+    optional per-predictor importances (AppPMMLUtils.buildMiningSchema:
+    168-206)."""
+    ms = pmml_io.sub(parent, "MiningSchema")
+    for i, name in enumerate(schema.feature_names):
+        if not schema.is_active(i):
+            continue
+        attrs = {"name": name}
+        if schema.is_target(i):
+            attrs["usageType"] = "predicted"
+        else:
+            attrs["usageType"] = "active"
+            if importances is not None:
+                p = schema.feature_to_predictor_index(i)
+                attrs["importance"] = repr(float(importances[p]))
+        pmml_io.sub(ms, "MiningField", attrs)
+    return ms
+
+
+def build_categorical_encodings(pmml_root: Element, schema: InputSchema) -> CategoricalValueEncodings:
+    """Recover encodings from DataDictionary Values
+    (AppPMMLUtils.buildCategoricalValueEncodings:208-229)."""
+    distinct: dict[int, list[str]] = {}
+    dd = pmml_io.find(pmml_root, "DataDictionary")
+    if dd is not None:
+        for df in pmml_io.findall(dd, "DataField"):
+            values = [v.get("value") for v in pmml_io.findall(df, "Value")]
+            if values:
+                feat = schema.feature_names.index(df.get("name"))
+                distinct[feat] = values
+    return CategoricalValueEncodings(distinct)
+
+
+# -- update-topic model resolution ------------------------------------------
+
+
+def read_pmml_from_update_message(key: str, message: str) -> Element | None:
+    """Resolve a MODEL / MODEL-REF update message to a PMML tree, or None
+    for other keys (AppPMMLUtils.readPMMLFromUpdateKeyMessage:256-285).
+    A MODEL-REF whose path has vanished returns None (logged by caller)."""
+    if key == "MODEL":
+        return pmml_io.from_string(message)
+    if key == "MODEL-REF":
+        path = Path(message)
+        if not path.exists():
+            return None
+        return pmml_io.read_pmml(path)
+    return None

@@ -1,0 +1,5 @@
+"""TPU compute kernels: the numerical engines the reference outsources to
+Spark MLlib / Commons Math (SURVEY.md intro). ALS normal-equation sweeps,
+k-means Lloyd iterations, forest training, top-N scoring — all as
+JAX/XLA programs over a device mesh.
+"""

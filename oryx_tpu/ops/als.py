@@ -1,0 +1,266 @@
+"""ALS matrix factorization on TPU.
+
+The TPU-native replacement for Spark MLlib's ALS (the hot loop of the
+reference's ALSUpdate, app/oryx-app-mllib/.../als/ALSUpdate.java:116-124):
+alternating normal-equation sweeps solved as batched k x k systems on
+device.
+
+Design (TPU-first, not a port):
+- Ratings arrive as COO (user_idx, item_idx, value). Host-side they are
+  grouped per-row and padded to a rectangle [N, D] of neighbor indices +
+  values + mask — fixed shapes so XLA compiles one program per sweep.
+- One half-sweep solves all users at once:
+    implicit (Hu/Koren/Volinsky, MLlib semantics):
+        c_ui = 1 + alpha*|r|, p_ui = 1 if r > 0 else 0
+        A_u = YtY + sum_i (c-1) y_i y_i^T + lambda*I ;  b_u = sum_i c*p*y_i
+    explicit (ALS-WR weighted-lambda):
+        A_u = sum_i y_i y_i^T + lambda*n_u*I        ;  b_u = sum_i r y_i
+  built with gathers + einsum (MXU work) and solved with batched
+  jnp.linalg.solve. Users are processed in fixed-size chunks via lax.map
+  to bound the [chunk, D, k] gather workspace in HBM.
+- Sharding: neighbor structures are sharded over rows (users for the X
+  half-sweep, items for the Y half-sweep) on the mesh's 'data' axis;
+  factor matrices live replicated, so YtY needs no collective and the
+  per-row gather is local. XLA inserts the all-gather of the updated
+  factors between half-sweeps. This mirrors how the reference's MLlib
+  block-partitions the rating matrix (SURVEY.md §2.12) but with the
+  collectives compiled by XLA instead of hand-rolled shuffles.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oryx_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
+
+
+@dataclass
+class NeighborBlock:
+    """Padded per-row neighbor structure for one side of the factorization."""
+
+    idx: np.ndarray  # [N, D] int32 indices into the other side's factors
+    val: np.ndarray  # [N, D] float32 rating values (0 where padded)
+    mask: np.ndarray  # [N, D] float32 1/0 validity
+
+    @property
+    def num_rows(self) -> int:
+        return self.idx.shape[0]
+
+
+def build_neighbor_block(
+    row_idx: np.ndarray,
+    col_idx: np.ndarray,
+    values: np.ndarray,
+    num_rows: int,
+    pad_rows_to: int = 1,
+) -> NeighborBlock:
+    """Group COO entries by row and pad to [N, Dmax] rectangles."""
+    order = np.argsort(row_idx, kind="stable")
+    r, c, v = row_idx[order], col_idx[order], values[order]
+    counts = np.bincount(r, minlength=num_rows)
+    dmax = max(1, int(counts.max()) if counts.size else 1)
+    n = pad_to_multiple(max(num_rows, 1), pad_rows_to)
+    idx = np.zeros((n, dmax), dtype=np.int32)
+    val = np.zeros((n, dmax), dtype=np.float32)
+    mask = np.zeros((n, dmax), dtype=np.float32)
+    # vectorized scatter: position of each entry within its row
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    pos = np.arange(len(r)) - starts[r]
+    idx[r, pos] = c
+    val[r, pos] = v
+    mask[r, pos] = 1.0
+    return NeighborBlock(idx, val, mask)
+
+
+def _solve_half_sweep(
+    other: jnp.ndarray,  # [M, k] factors of the other side
+    idx: jnp.ndarray,  # [N, D]
+    val: jnp.ndarray,  # [N, D]
+    mask: jnp.ndarray,  # [N, D]
+    lam: float,
+    alpha: float,
+    implicit: bool,
+    chunk: int,
+) -> jnp.ndarray:
+    k = other.shape[1]
+    eye = jnp.eye(k, dtype=jnp.float32)
+    yty = other.T @ other if implicit else None  # [k, k], free of the chunk loop
+
+    def solve_chunk(args):
+        cidx, cval, cmask = args  # [C, D]
+        v = other[cidx] * cmask[..., None]  # [C, D, k]
+        if implicit:
+            conf_m1 = alpha * jnp.abs(cval) * cmask  # c - 1
+            a = (
+                yty[None]
+                + jnp.einsum("cdk,cd,cdl->ckl", v, conf_m1, v)
+                + lam * eye[None]
+            )
+            p = (cval > 0).astype(jnp.float32) * cmask
+            b = jnp.einsum("cdk,cd->ck", v, (1.0 + alpha * jnp.abs(cval)) * p)
+        else:
+            n_u = cmask.sum(axis=1)  # ratings per row (ALS-WR lambda scaling)
+            a = (
+                jnp.einsum("cdk,cdl->ckl", v, v)
+                + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
+            )
+            b = jnp.einsum("cdk,cd->ck", v, cval * cmask)
+        return jnp.linalg.solve(a, b[..., None])[..., 0]
+
+    n = idx.shape[0]
+    if n <= chunk:
+        return solve_chunk((idx, val, mask))
+    # bound HBM: process rows in fixed-size chunks sequentially
+    num_chunks = n // chunk
+    main = jax.lax.map(
+        solve_chunk,
+        (
+            idx[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
+            val[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
+            mask[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
+        ),
+    ).reshape(num_chunks * chunk, k)
+    rem = n - num_chunks * chunk
+    if rem:
+        tail = solve_chunk((idx[-rem:], val[-rem:], mask[-rem:]))
+        return jnp.concatenate([main, tail], axis=0)
+    return main
+
+
+@dataclass
+class ALSModel:
+    """Factorization result: row-major float32 factor matrices."""
+
+    x: np.ndarray  # [num_users, k]
+    y: np.ndarray  # [num_items, k]
+
+
+def train_als(
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    values: np.ndarray,
+    num_users: int,
+    num_items: int,
+    features: int,
+    lam: float,
+    alpha: float = 1.0,
+    implicit: bool = True,
+    iterations: int = 10,
+    mesh: Optional[Mesh] = None,
+    seed: int | None = None,
+    chunk: int = 4096,
+) -> ALSModel:
+    """Full ALS training run.
+
+    COO inputs are int32/float32 numpy arrays. With `mesh`, neighbor
+    structures are row-sharded over the 'data' axis and factors replicated;
+    single-device otherwise.
+    """
+    from oryx_tpu.common import rng as rng_mod
+
+    num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    users = build_neighbor_block(user_idx, item_idx, values, num_users, num_shards)
+    items = build_neighbor_block(item_idx, user_idx, values, num_items, num_shards)
+
+    key = jax.random.key(rng_mod.next_seed() if seed is None else seed)
+    # MLlib-style init: small random normal factors
+    y0 = 0.1 * jax.random.normal(key, (items.num_rows, features), dtype=jnp.float32)
+
+    sweep = functools.partial(
+        _solve_half_sweep, lam=lam, alpha=alpha, implicit=implicit, chunk=chunk
+    )
+
+    def run(u_idx_, u_val_, u_mask_, i_idx_, i_val_, i_mask_, y_init):
+        x = jnp.zeros((u_idx_.shape[0], features), dtype=jnp.float32)
+        y = y_init
+
+        def body(_, carry):
+            x_, y_ = carry
+            x_ = sweep(y_, u_idx_, u_val_, u_mask_)
+            y_ = sweep(x_, i_idx_, i_val_, i_mask_)
+            return x_, y_
+
+        return jax.lax.fori_loop(0, iterations, body, (x, y))
+
+    if mesh is not None:
+        row_sharded = NamedSharding(mesh, P(DATA_AXIS, None))
+        repl = NamedSharding(mesh, P())
+        u_args = [jax.device_put(a, row_sharded) for a in (users.idx, users.val, users.mask)]
+        i_args = [jax.device_put(a, row_sharded) for a in (items.idx, items.val, items.mask)]
+        y0 = jax.device_put(np.asarray(y0), repl)
+        run_c = jax.jit(
+            run,
+            in_shardings=(row_sharded,) * 3 + (row_sharded,) * 3 + (repl,),
+            out_shardings=(row_sharded, row_sharded),
+        )
+        x, y = run_c(*u_args, *i_args, y0)
+    else:
+        run_c = jax.jit(run)
+        x, y = run_c(users.idx, users.val, users.mask, items.idx, items.val, items.mask, y0)
+
+    x = np.asarray(x)[:num_users]
+    y = np.asarray(y)[:num_items]
+    return ALSModel(x=x, y=y)
+
+
+# -- evaluation --------------------------------------------------------------
+
+
+def predict_pairs(x: np.ndarray, y: np.ndarray, user_idx: np.ndarray, item_idx: np.ndarray) -> np.ndarray:
+    """Predicted strengths for (user, item) pairs (on device, batched)."""
+
+    @jax.jit
+    def _pred(xa, ya, ui, ii):
+        return jnp.sum(xa[ui] * ya[ii], axis=-1)
+
+    return np.asarray(_pred(x, y, user_idx, item_idx))
+
+
+def rmse(x: np.ndarray, y: np.ndarray, user_idx, item_idx, values) -> float:
+    """Root mean squared error over test pairs (Evaluation.rmse analogue,
+    app/oryx-app-mllib/.../als/Evaluation.java:49-63)."""
+    if len(values) == 0:
+        return float("nan")
+    pred = predict_pairs(x, y, user_idx, item_idx)
+    return float(np.sqrt(np.mean((pred - values) ** 2)))
+
+
+def mean_auc(
+    x: np.ndarray,
+    y: np.ndarray,
+    user_idx: np.ndarray,
+    item_idx: np.ndarray,
+    rng: np.random.Generator,
+) -> float:
+    """Mean per-user AUC with about as many sampled negatives as positives
+    per user (Evaluation.areaUnderCurve, Evaluation.java:70-136)."""
+    if len(user_idx) == 0:
+        return float("nan")
+    all_items = np.unique(item_idx)
+    order = np.argsort(user_idx, kind="stable")
+    uu, ii = user_idx[order], item_idx[order]
+    uniq_users = np.unique(uu)
+    starts = np.searchsorted(uu, uniq_users, side="left")
+    ends = np.searchsorted(uu, uniq_users, side="right")
+    aucs = []
+    for u, s, e in zip(uniq_users, starts, ends):
+        pos = ii[s:e]
+        pos_set = set(pos.tolist())
+        num_pos = len(pos)
+        # sample negatives: bounded tries like the reference (numItems tries)
+        cand = rng.choice(all_items, size=min(len(all_items), 4 * num_pos))
+        neg = np.asarray([c for c in cand if c not in pos_set][:num_pos], dtype=np.int64)
+        if len(neg) == 0:
+            continue
+        pos_scores = y[pos] @ x[u]
+        neg_scores = y[neg] @ x[u]
+        correct = (pos_scores[:, None] > neg_scores[None, :]).sum()
+        aucs.append(correct / (len(pos_scores) * len(neg_scores)))
+    return float(np.mean(aucs)) if aucs else float("nan")

@@ -1,0 +1,64 @@
+"""Top-N scoring: one batched matvec + top_k on device.
+
+Replaces the reference's per-request thread-pool scan over LSH partitions
+(ALSServingModel.topN / TopNConsumer.java, VectorMath.dot in the hot
+loop): dot scores for ALL items are one [n, k] @ [k] matvec on the MXU,
+cosine scores normalize by cached row norms, and jax.lax.top_k returns
+the best candidates. Queries can also be batched [b, k] for concurrent
+requests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def upload(matrix: np.ndarray):
+    """Move a packed [n, k] float32 matrix to device, with cached norms."""
+    mat = jnp.asarray(matrix, dtype=jnp.float32)
+    norms = jnp.linalg.norm(mat, axis=1)
+    return mat, norms
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _dot_topk(mat, query, k):
+    scores = mat @ query
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnums=3)
+def _cosine_topk(mat, norms, query, k):
+    qn = jnp.linalg.norm(query)
+    scores = (mat @ query) / jnp.maximum(norms * qn, 1e-12)
+    return jax.lax.top_k(scores, k)
+
+
+def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
+    """(indices, scores) of the k best items for one query vector."""
+    mat, norms = uploaded
+    k = max(1, min(int(k), mat.shape[0]))
+    q = jnp.asarray(query, dtype=jnp.float32)
+    if cosine:
+        s, i = _cosine_topk(mat, norms, q, k)
+    else:
+        s, i = _dot_topk(mat, q, k)
+    return np.asarray(i), np.asarray(s)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _dot_topk_batch(mat, queries, k):
+    scores = queries @ mat.T  # [b, n]
+    return jax.lax.top_k(scores, k)
+
+
+def top_k_scores_batch(uploaded, queries: np.ndarray, k: int):
+    """Batched top-k for [b, k] query vectors (concurrent requests)."""
+    mat, _ = uploaded
+    k = max(1, min(int(k), mat.shape[0]))
+    q = jnp.asarray(queries, dtype=jnp.float32)
+    s, i = _dot_topk_batch(mat, q, k)
+    return np.asarray(i), np.asarray(s)

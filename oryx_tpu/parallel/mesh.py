@@ -1,0 +1,52 @@
+"""Mesh construction and sharding specs.
+
+The framework's standard mesh has one axis, ``data``, over which examples
+(users, points, ratings) are sharded; factor/parameter matrices are either
+replicated or row-sharded over the same axis. Multi-axis meshes (e.g.
+{data, model}) are supported by config: oryx.batch.compute.mesh is an
+object of axis-name -> size.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def get_mesh(spec: Mapping[str, int] | None = None, devices=None) -> Mesh:
+    """Build a Mesh. Default: all devices on one 'data' axis."""
+    devices = jax.devices() if devices is None else devices
+    if not spec:
+        return Mesh(np.asarray(devices), (DATA_AXIS,))
+    names = tuple(spec.keys())
+    sizes = tuple(int(s) for s in spec.values())
+    want = math.prod(sizes)
+    if want > len(devices):
+        raise ValueError(f"mesh {dict(spec)} needs {want} devices, have {len(devices)}")
+    arr = np.asarray(devices[:want]).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def shard_rows(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """First array dim sharded over `axis`, rest replicated."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 over `axis` for an ndim-dim array."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest m >= n with m % multiple == 0 (shard-evenly helper)."""
+    return ((n + multiple - 1) // multiple) * multiple

@@ -17,8 +17,6 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 from urllib.parse import parse_qs, unquote
 
-from oryx_tpu.api.serving import HasCSV
-
 __all__ = [
     "OryxServingException",
     "Request",
@@ -230,7 +228,7 @@ def _invoke(fn: Callable, ctx: ServingContext, req: Request) -> Any:
 def _csv_line(item: Any) -> str:
     from oryx_tpu.common import text as text_utils
 
-    if isinstance(item, HasCSV):
+    if callable(getattr(item, "to_csv", None)):  # HasCSV, structurally
         return item.to_csv()
     if isinstance(item, (list, tuple)):
         return text_utils.join_csv(list(item))

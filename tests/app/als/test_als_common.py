@@ -1,0 +1,111 @@
+"""ALS shared-math tests (reference: ALSUtilsTest, FeatureVectorsTest)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als import data as als_data
+from oryx_tpu.app.als.common import FeatureVectors, compute_target_qui, compute_updated_xu
+from oryx_tpu.common.vectormath import Solver
+
+
+def test_compute_target_qui_explicit_is_value():
+    assert compute_target_qui(False, 3.5, 0.2) == 3.5
+
+
+def test_compute_target_qui_implicit_moves_toward_one():
+    t = compute_target_qui(True, 1.0, 0.0)
+    assert t == pytest.approx(0.5)  # 0 + (1/2) * 1
+    t2 = compute_target_qui(True, 1.0, t)
+    assert t < t2 < 1.0
+    # already >= 1: no change
+    assert math.isnan(compute_target_qui(True, 1.0, 1.0))
+
+
+def test_compute_target_qui_implicit_negative_moves_toward_zero():
+    t = compute_target_qui(True, -1.0, 1.0)
+    assert t == pytest.approx(0.5)  # 1 + (-1/-2) * -1
+    assert math.isnan(compute_target_qui(True, -1.0, 0.0))
+
+
+def test_compute_updated_xu_hand_computed():
+    # Y^T Y for Y = identity-ish gives simple solver
+    yty = np.array([[2.0, 0.0], [0.0, 2.0]])
+    solver = Solver(yty)
+    yi = np.array([1.0, 0.0], dtype=np.float32)
+    # new user, implicit, value=1: target = 0.5 + (1/2)*0.5 = 0.75; dQui=0.75
+    xu = compute_updated_xu(solver, 1.0, None, yi, True)
+    np.testing.assert_allclose(xu, [0.375, 0.0], atol=1e-6)  # (yty)^-1 * 0.75*yi
+    # explicit existing user: target = value
+    xu2 = compute_updated_xu(solver, 2.0, np.array([1.0, 1.0], dtype=np.float32), yi, False)
+    # Qui = 1.0, dQui = 1.0, dXu = [0.5, 0]
+    np.testing.assert_allclose(xu2, [1.5, 1.0], atol=1e-6)
+
+
+def test_compute_updated_xu_no_item_vector():
+    solver = Solver(np.eye(2))
+    assert compute_updated_xu(solver, 1.0, None, None, True) is None
+
+
+def test_feature_vectors_rotation_keeps_recent():
+    fv = FeatureVectors()
+    fv.set_vector("a", [1, 2])
+    fv.set_vector("b", [3, 4])
+    # rotation: new model has only "b"; "a" was not recently written after
+    fv.retain_recent_and_ids({"b"})
+    # both survive: a and b were both recent since last rotation
+    assert set(fv.ids()) == {"a", "b"}
+    # next rotation without new writes: only model ids survive
+    fv.retain_recent_and_ids({"b"})
+    assert set(fv.ids()) == {"b"}
+    # recent write survives rotation that drops it from the model
+    fv.set_vector("c", [5, 6])
+    fv.retain_recent_and_ids({"b"})
+    assert set(fv.ids()) == {"b", "c"}
+
+
+def test_feature_vectors_vtv():
+    fv = FeatureVectors()
+    fv.set_vector("a", [1.0, 2.0])
+    fv.set_vector("b", [3.0, 4.0])
+    np.testing.assert_allclose(fv.get_vtv(), [[10.0, 14.0], [14.0, 20.0]])
+    ids, mat = fv.to_matrix()
+    assert set(ids) == {"a", "b"}
+    assert mat.shape == (2, 2)
+
+
+def test_parse_and_aggregate_implicit_sum_and_delete():
+    lines = [
+        "u1,i1,1.0,100",
+        "u1,i1,2.5,200",
+        "u2,i1,1.0,100",
+        "u2,i1,,300",  # delete marker
+        '["u3","i2",4.0,50]',
+    ]
+    inter = als_data.parse_interactions(lines)
+    agg = als_data.aggregate(inter, implicit=True)
+    assert agg == {("u1", "i1"): pytest.approx(3.5), ("u3", "i2"): pytest.approx(4.0)}
+
+
+def test_aggregate_explicit_last_wins():
+    lines = ["u1,i1,5.0,100", "u1,i1,2.0,300", "u1,i1,3.0,200"]
+    agg = als_data.aggregate(als_data.parse_interactions(lines), implicit=False)
+    assert agg == {("u1", "i1"): pytest.approx(2.0)}  # ts=300 last
+
+
+def test_decay():
+    day_ms = 86_400_000
+    inter = als_data.parse_interactions([f"u,i,8.0,0"])
+    out = als_data.decay_interactions(inter, factor=0.5, zero_threshold=0.0, now_ms=3 * day_ms)
+    assert out[0].value == pytest.approx(1.0)  # 8 * 0.5^3
+    out2 = als_data.decay_interactions(inter, factor=0.5, zero_threshold=1.5, now_ms=3 * day_ms)
+    assert out2 == []
+
+
+def test_to_rating_matrix_and_known_items():
+    agg = {("u1", "i1"): 1.0, ("u1", "i2"): 2.0, ("u2", "i1"): 3.0}
+    rm = als_data.to_rating_matrix(agg)
+    assert rm.user_ids == ["u1", "u2"]
+    assert rm.item_ids == ["i1", "i2"]
+    assert rm.known_items == {"u1": {"i1", "i2"}, "u2": {"i1"}}

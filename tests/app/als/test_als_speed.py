@@ -1,0 +1,125 @@
+"""ALS speed layer tests: exact fold-in vectors against hand-built
+matrices (reference: ALSSpeedIT.java:41-107 / MockALSModelUpdateGenerator
+pattern)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.common import compute_updated_xu
+from oryx_tpu.app.als.speed import ALSSpeedModel, ALSSpeedModelManager
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.common.vectormath import Solver
+from oryx_tpu.app import pmml as app_pmml
+
+
+def make_manager(implicit=True, no_known=False):
+    cfg = C.get_default().with_overlay(
+        f"oryx.als.implicit = {str(implicit).lower()}\n"
+        f"oryx.als.no-known-items = {str(no_known).lower()}"
+    )
+    return ALSSpeedModelManager(cfg)
+
+
+def model_message(features=2, implicit=True, x_ids=("U1", "U2"), y_ids=("I1", "I2")):
+    root = pmml_io.build_skeleton_pmml()
+    app_pmml.add_extension(root, "features", features)
+    app_pmml.add_extension(root, "implicit", "true" if implicit else "false")
+    app_pmml.add_extension_content(root, "XIDs", list(x_ids))
+    app_pmml.add_extension_content(root, "YIDs", list(y_ids))
+    return pmml_io.to_string(root)
+
+
+def feed(manager, messages):
+    manager.consume(iter(messages))
+
+
+def test_consume_model_then_vectors_and_fraction():
+    mgr = make_manager()
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    assert mgr.model is not None
+    assert mgr.model.get_fraction_loaded() == 0.0
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I1",[0.5,0.5]]'),
+    ])
+    assert mgr.model.get_fraction_loaded() == pytest.approx(0.5)
+    np.testing.assert_allclose(mgr.model.x.get_vector("U1"), [1.0, 0.0])
+    feed(mgr, [
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.7,0.3]]'),
+    ])
+    assert mgr.model.get_fraction_loaded() == 1.0
+
+
+def test_model_rotation_same_config_retains_recent():
+    mgr = make_manager()
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [KeyMessage("UP", '["X","U9",[1.0,1.0]]')])
+    first_model = mgr.model
+    feed(mgr, [KeyMessage("MODEL", model_message(x_ids=("U1",), y_ids=("I1",)))])
+    assert mgr.model is first_model  # same features/implicit: retained
+    assert set(mgr.model.x.ids()) == {"U9"}  # recent write kept
+
+
+def test_model_rotation_new_features_resets():
+    mgr = make_manager()
+    feed(mgr, [KeyMessage("MODEL", model_message(features=2))])
+    first = mgr.model
+    feed(mgr, [KeyMessage("MODEL", model_message(features=3))])
+    assert mgr.model is not first
+    assert mgr.model.features == 3
+
+
+def test_build_updates_exact_fold_in():
+    mgr = make_manager(implicit=True)
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    # hand-built orthogonal factors
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
+    assert len(updates) == 2
+    parsed = {json.loads(u)[0]: json.loads(u) for u in updates}
+    # verify against direct ALSUtils computation
+    yty = Solver(mgr.model.y.get_vtv())
+    expect_xu = compute_updated_xu(
+        yty, 3.0, np.array([1.0, 0.0], dtype=np.float32),
+        np.array([0.0, 1.0], dtype=np.float32), True)
+    np.testing.assert_allclose(parsed["X"][2], expect_xu, rtol=1e-5)
+    assert parsed["X"][1] == "U1"
+    assert parsed["X"][3] == ["I2"]  # known item carried in the delta
+    xtx = Solver(np.eye(2))
+    expect_yi = compute_updated_xu(
+        xtx, 3.0, np.array([0.0, 1.0], dtype=np.float32),
+        np.array([1.0, 0.0], dtype=np.float32), True)
+    np.testing.assert_allclose(parsed["Y"][2], expect_yi, rtol=1e-5)
+    assert parsed["Y"][3] == ["U1"]
+
+
+def test_build_updates_no_model_or_degenerate():
+    mgr = make_manager()
+    assert list(mgr.build_updates([KeyMessage(None, "a,b,1.0,1")])) == []
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    # only one vector each: V^T V singular -> no updates, no crash
+    feed(mgr, [KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+               KeyMessage("UP", '["Y","I1",[1.0,0.0]]')])
+    assert list(mgr.build_updates([KeyMessage(None, "U1,I1,1.0,1")])) == []
+
+
+def test_no_known_items_update_format():
+    mgr = make_manager(no_known=True)
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,1.0,1")]))
+    assert all(len(json.loads(u)) == 3 for u in updates)

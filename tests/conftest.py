@@ -8,10 +8,19 @@ mesh/sharding tests exercise real multi-device code paths without TPUs.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU regardless of the ambient platform (e.g. a TPU plugin): tests
+# exercise sharding on 8 virtual devices, benches use the real chip. A
+# site-installed TPU plugin may import jax and pin jax_platforms at
+# interpreter startup, so the env var alone is not enough — override the
+# live config too, before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
