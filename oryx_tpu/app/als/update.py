@@ -38,20 +38,12 @@ from oryx_tpu.common.text import join_json
 from oryx_tpu.ml import param as hp
 from oryx_tpu.ml.update import MLUpdate
 from oryx_tpu.ops import als as als_ops
-from oryx_tpu.parallel.mesh import get_mesh
+from oryx_tpu.parallel.mesh import mesh_from_config
 
 log = logging.getLogger(__name__)
 
 
-def _mesh_from_config(config: Config):
-    spec = config.get("oryx.batch.compute.mesh", None)
-    import jax
 
-    if spec is None:
-        if len(jax.devices()) > 1:
-            return get_mesh()
-        return None
-    return get_mesh(spec)
 
 
 class ALSUpdate(MLUpdate):
@@ -111,7 +103,7 @@ class ALSUpdate(MLUpdate):
             alpha=alpha,
             implicit=self.implicit,
             iterations=self.iterations,
-            mesh=_mesh_from_config(self._config),
+            mesh=mesh_from_config(self._config),
         )
         _save_features(candidate_path / "X", rm.user_ids, model.x)
         _save_features(candidate_path / "Y", rm.item_ids, model.y)

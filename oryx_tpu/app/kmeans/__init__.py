@@ -1,0 +1,4 @@
+"""K-means clustering application: batch trainer, speed-layer centroid
+drift, serving model + REST endpoints (reference kmeans components in
+SURVEY.md §2.7-2.10).
+"""

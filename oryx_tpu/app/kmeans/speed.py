@@ -1,0 +1,111 @@
+"""K-means speed layer: centroid drift from new points.
+
+Rebuild of KMeansSpeedModel (app/oryx-app/.../speed/kmeans/
+KMeansSpeedModel.java:31-63) and KMeansSpeedModelManager (.../
+KMeansSpeedModelManager.java:47-127): assign each new point to its
+nearest cluster, reduce per cluster to (sum, count), move each centroid
+by weighted running mean, emit ``[clusterID, [center], count]`` UP
+messages (KMeansSpeedModelManager.java:85-125).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from oryx_tpu.api.speed import SpeedModel, SpeedModelManager
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.kmeans import common as km
+from oryx_tpu.app.schema import InputSchema
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.text import join_json, parse_line, read_json
+
+log = logging.getLogger(__name__)
+
+
+class KMeansSpeedModel(SpeedModel):
+    """In-memory clusters; always fully loaded once a model arrives."""
+
+    def __init__(self, clusters: list[km.ClusterInfo]) -> None:
+        self._lock = threading.Lock()
+        self._clusters = {c.id: c for c in clusters}
+
+    def get_cluster(self, cluster_id: int) -> km.ClusterInfo | None:
+        with self._lock:
+            return self._clusters.get(cluster_id)
+
+    def clusters(self) -> list[km.ClusterInfo]:
+        with self._lock:
+            return list(self._clusters.values())
+
+    def set_cluster(self, cluster: km.ClusterInfo) -> None:
+        with self._lock:
+            self._clusters[cluster.id] = cluster
+
+    def update(self, cluster_id: int, point_sum: np.ndarray, count: int) -> None:
+        with self._lock:
+            c = self._clusters.get(cluster_id)
+            if c is not None:
+                c.update(point_sum, count)
+
+    def get_fraction_loaded(self) -> float:
+        return 1.0
+
+
+class KMeansSpeedModelManager(SpeedModelManager):
+    def __init__(self, config: Config) -> None:
+        self.schema = InputSchema(config)
+        km.check_numeric_only(self.schema)
+        self.model: KMeansSpeedModel | None = None
+
+    def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
+        for kmsg in update_iterator:
+            key, message = kmsg.key, kmsg.message
+            if key == "UP":
+                if self.model is None:
+                    continue
+                cluster_id, center, count = read_json(message)
+                self.model.set_cluster(
+                    km.ClusterInfo(int(cluster_id), np.asarray(center, np.float64), int(count))
+                )
+            elif key in ("MODEL", "MODEL-REF"):
+                pmml = app_pmml.read_pmml_from_update_message(key, message)
+                if pmml is None:
+                    log.warning("dropped unreadable model update")
+                    continue
+                self.model = KMeansSpeedModel(km.pmml_to_clusters(pmml))
+            else:
+                raise ValueError(f"bad key {key}")
+
+    def build_updates(self, new_data: Iterable[KeyMessage]) -> Iterable[str]:
+        model = self.model
+        if model is None:
+            return []
+        clusters = model.clusters()
+        if not clusters:
+            return []
+        # accumulate (sum, count) per nearest cluster
+        sums: dict[int, np.ndarray] = {}
+        counts: dict[int, int] = {}
+        for rec in new_data:
+            point = km.features_from_tokens(parse_line(rec.message), self.schema)
+            nearest, _ = km.closest_cluster(clusters, point)
+            if nearest.id in sums:
+                sums[nearest.id] += point
+                counts[nearest.id] += 1
+            else:
+                sums[nearest.id] = point.copy()
+                counts[nearest.id] = 1
+        out = []
+        for cid, s in sums.items():
+            model.update(cid, s, counts[cid])
+            updated = model.get_cluster(cid)
+            out.append(join_json([cid, [float(v) for v in updated.center], updated.count]))
+        return out
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,4 @@
+"""Random decision forest application: histogram-based TPU training,
+portable forest inference, leaf-stat speed updates, prediction serving
+(reference rdf components in SURVEY.md §2.7-2.10).
+"""

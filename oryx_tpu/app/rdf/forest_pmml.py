@@ -1,0 +1,187 @@
+"""Forest <-> PMML MiningModel conversion.
+
+Rebuild of RDFPMMLUtils (app/oryx-app-common/.../rdf/RDFPMMLUtils.java)
+and the PMML-emitting half of RDFUpdate.rdfModelToPMML: a MiningModel
+with a Segmentation of one TreeModel per tree; Nodes carry id (the
+"r"/"-"/"+" path scheme of oryx_tpu.app.rdf.tree), recordCount, score,
+and ScoreDistribution for classification; predicates are SimplePredicate
+(numeric) or SimpleSetPredicate (categorical).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from xml.etree.ElementTree import Element
+
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.rdf import tree as T
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.common import pmml as pmml_io
+
+
+def forest_to_pmml(
+    forest: T.DecisionForest,
+    schema: InputSchema,
+    encodings: CategoricalValueEncodings,
+) -> Element:
+    root = pmml_io.build_skeleton_pmml()
+    if forest.feature_importances is not None:
+        app_pmml.add_extension_content(
+            root, "importances", [repr(float(v)) for v in forest.feature_importances]
+        )
+    app_pmml.build_data_dictionary(root, schema, encodings)
+    classification = schema.target_feature is not None and schema.is_categorical(
+        schema.target_feature
+    )
+    function = "classification" if classification else "regression"
+    mm = pmml_io.sub(
+        root, "MiningModel", {"modelName": "randomDecisionForest", "functionName": function}
+    )
+    app_pmml.build_mining_schema(
+        mm,
+        schema,
+        list(forest.feature_importances) if forest.feature_importances is not None else None,
+    )
+    seg = pmml_io.sub(
+        mm,
+        "Segmentation",
+        {"multipleModelMethod": "weightedAverage" if not classification else "weightedMajorityVote"},
+    )
+    for i, (tree, weight) in enumerate(zip(forest.trees, forest.weights)):
+        s = pmml_io.sub(seg, "Segment", {"id": str(i), "weight": repr(float(weight))})
+        pmml_io.sub(s, "True")
+        tm = pmml_io.sub(
+            s, "TreeModel", {"functionName": function, "splitCharacteristic": "binarySplit"}
+        )
+        app_pmml.build_mining_schema(tm, schema)
+        _write_node(tm, tree.root, None, schema, encodings, classification)
+    return root
+
+
+def _write_node(parent, node, predicate_writer, schema, encodings, classification) -> None:
+    attrs = {"id": node.id}
+    if node.is_terminal():
+        pred = node.prediction
+        if classification:
+            tfi = schema.target_feature_index
+            attrs["score"] = encodings.value_for(tfi, pred.most_probable_index)
+            attrs["recordCount"] = repr(float(pred.count))
+        else:
+            attrs["score"] = repr(float(pred.prediction))
+            attrs["recordCount"] = repr(float(pred.count))
+    else:
+        attrs["recordCount"] = repr(float(node.record_count))
+    el = pmml_io.sub(parent, "Node", attrs)
+    if predicate_writer is None:
+        pmml_io.sub(el, "True")
+    else:
+        predicate_writer(el)
+    if node.is_terminal():
+        if classification:
+            tfi = schema.target_feature_index
+            for ci, cnt in enumerate(node.prediction.counts):
+                pmml_io.sub(
+                    el,
+                    "ScoreDistribution",
+                    {"value": encodings.value_for(tfi, ci), "recordCount": repr(float(cnt))},
+                )
+        return
+    d = node.decision
+    feature_index = schema.predictor_to_feature_index(d.feature)
+    name = schema.feature_names[feature_index]
+    if isinstance(d, T.NumericDecision):
+        def neg(el2, name=name, d=d):
+            pmml_io.sub(el2, "SimplePredicate", {"field": name, "operator": "lessThan", "value": repr(d.threshold)})
+
+        def pos(el2, name=name, d=d):
+            pmml_io.sub(el2, "SimplePredicate", {"field": name, "operator": "greaterOrEqual", "value": repr(d.threshold)})
+    else:
+        pos_values = [encodings.value_for(feature_index, c) for c in sorted(d.category_ids)]
+
+        def neg(el2, name=name, vals=pos_values):
+            sp = pmml_io.sub(el2, "SimpleSetPredicate", {"field": name, "booleanOperator": "isNotIn"})
+            arr = pmml_io.sub(sp, "Array", {"n": str(len(vals)), "type": "string"})
+            arr.text = " ".join(_quote(v) for v in vals)
+
+        def pos(el2, name=name, vals=pos_values):
+            sp = pmml_io.sub(el2, "SimpleSetPredicate", {"field": name, "booleanOperator": "isIn"})
+            arr = pmml_io.sub(sp, "Array", {"n": str(len(vals)), "type": "string"})
+            arr.text = " ".join(_quote(v) for v in vals)
+
+    _write_node(el, node.negative, neg, schema, encodings, classification)
+    _write_node(el, node.positive, pos, schema, encodings, classification)
+
+
+def _quote(v: str) -> str:
+    return f'"{v}"' if (" " in v or not v) else v
+
+
+def _unquote_array(text: str) -> list[str]:
+    import re
+
+    return [m.group(1) or m.group(2) for m in re.finditer(r'"([^"]*)"|(\S+)', text or "")]
+
+
+def pmml_to_forest(
+    root: Element, schema: InputSchema
+) -> tuple[T.DecisionForest, CategoricalValueEncodings]:
+    """Inverse of forest_to_pmml (RDFPMMLUtils.read)."""
+    encodings = app_pmml.build_categorical_encodings(root, schema)
+    mm = pmml_io.find(root, "MiningModel")
+    if mm is None:
+        raise ValueError("no MiningModel in PMML")
+    classification = mm.get("functionName") == "classification"
+    tfi = schema.target_feature_index
+    num_classes = encodings.category_count(tfi) if classification else 0
+    trees, weights = [], []
+    seg = pmml_io.find(mm, "Segmentation")
+    importances = app_pmml.get_extension_content(root, "importances")
+    for s in pmml_io.findall(seg, "Segment"):
+        weights.append(float(s.get("weight", "1")))
+        tm = pmml_io.find(s, "TreeModel")
+        node_el = pmml_io.find(tm, "Node")
+        trees.append(T.DecisionTree(_read_node(node_el, schema, encodings, classification, num_classes)))
+    fi = np.asarray([float(v) for v in importances]) if importances else None
+    return T.DecisionForest(trees, weights, fi), encodings
+
+
+def _read_node(el, schema, encodings, classification, num_classes):
+    children = pmml_io.findall(el, "Node")
+    node_id = el.get("id")
+    if not children:
+        rc = float(el.get("recordCount", "0"))
+        if classification:
+            counts = np.zeros(num_classes)
+            for sd in pmml_io.findall(el, "ScoreDistribution"):
+                tfi = schema.target_feature_index
+                counts[encodings.index_for(tfi, sd.get("value"))] = float(sd.get("recordCount"))
+            return T.TerminalNode(node_id, T.CategoricalPrediction(counts), int(rc))
+        return T.TerminalNode(
+            node_id, T.NumericPrediction(float(el.get("score", "0")), int(rc)), int(rc)
+        )
+    assert len(children) == 2, "binary trees expected"
+    neg_el, pos_el = children
+    # the positive child carries the defining predicate
+    decision = _read_predicate(pos_el, schema, encodings)
+    negative = _read_node(neg_el, schema, encodings, classification, num_classes)
+    positive = _read_node(pos_el, schema, encodings, classification, num_classes)
+    return T.DecisionNode(
+        node_id, decision, negative, positive, int(float(el.get("recordCount", "0")))
+    )
+
+
+def _read_predicate(el, schema, encodings):
+    sp = pmml_io.find(el, "SimplePredicate")
+    if sp is not None:
+        feature_index = schema.feature_names.index(sp.get("field"))
+        return T.NumericDecision(
+            schema.feature_to_predictor_index(feature_index), float(sp.get("value"))
+        )
+    ssp = pmml_io.find(el, "SimpleSetPredicate")
+    if ssp is None:
+        raise ValueError("node missing predicate")
+    feature_index = schema.feature_names.index(ssp.get("field"))
+    arr = pmml_io.find(ssp, "Array")
+    values = _unquote_array(arr.text)
+    ids = frozenset(encodings.index_for(feature_index, v) for v in values)
+    return T.CategoricalDecision(schema.feature_to_predictor_index(feature_index), ids)

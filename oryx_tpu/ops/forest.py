@@ -1,0 +1,287 @@
+"""Random-forest training on TPU: histogram-based level-wise growth.
+
+The TPU-native replacement for Spark MLlib's RandomForest.trainClassifier/
+trainRegressor used by the reference's RDFUpdate (app/oryx-app-mllib/...
+/rdf/RDFUpdate.java:143-165). Decision-tree induction is branchy and
+pointer-chasing in its classic form; the TPU formulation (XGBoost-style,
+SURVEY.md §7 step 5) grows all nodes of one depth at once:
+
+- inputs are pre-binned feature matrices ([n, p] small-int bin ids, the
+  binning/bin-edge mapping lives in the app tier),
+- one level = ONE fused pass: a lax.scan over features of segment-sum
+  histograms [nodes*bins, stats], then cumulative sums over bins give
+  every candidate split's left/right statistics, impurity gains are
+  evaluated for all (node, feature, bin) candidates simultaneously, and
+  argmax picks each node's split,
+- per-node feature subsampling (mtry) is a random mask over the gain
+  tensor, bootstrap resampling is Poisson(1) example weights,
+- trees come out as flat heap arrays (node i's children at 2i+1/2i+2)
+  that the app tier converts to portable DecisionTree objects.
+
+Example rows shard over the mesh 'data' axis; the histogram segment-sums
+reduce across shards (XLA inserts the psum). Stats channels: per-class
+weighted counts for classification, (w, w*y, w*y^2) for regression.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclass
+class ForestArrays:
+    """Flat heap-layout forest. -1 split_feature = leaf."""
+
+    split_feature: np.ndarray  # [T, max_nodes] int32
+    split_bin: np.ndarray  # [T, max_nodes] int32 (negative branch: bin <= split_bin)
+    node_stats: np.ndarray  # [T, max_nodes, S] per-node class counts / (w, wy, wyy)
+    node_counts: np.ndarray  # [T, max_nodes] weighted example counts
+    gains: np.ndarray  # [T, max_nodes] impurity decrease of each split
+    num_classes: int | None  # None = regression
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+
+def _impurity(stats: jnp.ndarray, total: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """stats [..., S], total [...] -> impurity [...]."""
+    if kind == "variance":
+        w, wy, wyy = stats[..., 0], stats[..., 1], stats[..., 2]
+        mean = wy / jnp.maximum(w, 1e-12)
+        return jnp.maximum(wyy / jnp.maximum(w, 1e-12) - mean * mean, 0.0)
+    p = stats / jnp.maximum(total[..., None], 1e-12)
+    if kind == "gini":
+        return 1.0 - jnp.sum(p * p, axis=-1)
+    # entropy in nats (reference: min-info-gain-nats)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))
+def _grow_level(
+    binned,  # [n, p] int32
+    stats_chan,  # [n, S] float32 per-example stat channels (w-weighted)
+    node_of,  # [n] int32 heap index or -1 (inactive)
+    feat_mask,  # [L, p] float32 1/0 mtry mask for this level
+    level_start: int,  # heap index of first node at this depth (2^d - 1)
+    num_level_nodes: int,  # L = 2^d
+    num_bins: int,  # B
+    impurity: str,
+    min_node_size,  # float32
+    min_info_gain,  # float32
+    is_last_level: bool,
+):
+    """Returns (split_feature [L], split_bin [L], gain [L], node_tot [L,S],
+    new_node_of [n])."""
+    n, p = binned.shape
+    s = stats_chan.shape[1]
+    pos = node_of - level_start  # position within level; <0 or >=L = inactive
+    active = (pos >= 0) & (pos < num_level_nodes)
+    pos_c = jnp.where(active, pos, 0)
+    w_stats = jnp.where(active[:, None], stats_chan, 0.0)
+
+    def hist_one_feature(carry, f):
+        seg = pos_c * num_bins + binned[:, f]
+        h = jax.ops.segment_sum(w_stats, seg, num_segments=num_level_nodes * num_bins)
+        return carry, h.reshape(num_level_nodes, num_bins, s)
+
+    _, hists = jax.lax.scan(hist_one_feature, 0, jnp.arange(p))  # [p, L, B, S]
+
+    node_tot = hists[0].sum(axis=1)  # [L, S] (same for every feature)
+
+    # weighted example count: regression carries it in channel 0; for
+    # classification it is the sum of the per-class channels
+    def _count(stats):
+        if impurity == "variance":
+            return stats[..., 0]
+        return stats.sum(axis=-1)
+
+    left = jnp.cumsum(hists, axis=2)  # [p, L, B, S] stats for bin <= b
+    right = node_tot[None, :, None, :] - left
+    tot_cnt = _count(node_tot)  # [L]
+    l_cnt = _count(left)
+    r_cnt = _count(right)
+
+    parent_imp = _impurity(node_tot, tot_cnt, impurity)  # [L]
+    l_imp = _impurity(left, l_cnt, impurity)
+    r_imp = _impurity(right, r_cnt, impurity)
+    tot_safe = jnp.maximum(tot_cnt, 1e-12)
+    gain = parent_imp[None, :, None] - (l_cnt * l_imp + r_cnt * r_imp) / tot_safe[None, :, None]
+
+    valid = (l_cnt >= min_node_size) & (r_cnt >= min_node_size)
+    # last candidate bin (B-1) sends everything left: never a real split
+    valid = valid & (jnp.arange(num_bins)[None, None, :] < num_bins - 1)
+    gain_all = jnp.where(valid, gain, -jnp.inf)
+    gain_masked = jnp.where(feat_mask.T[:, :, None] > 0, gain_all, -jnp.inf)
+
+    def best_of(g):
+        flat = g.transpose(1, 0, 2).reshape(num_level_nodes, p * num_bins)  # [L, p*B]
+        best = jnp.argmax(flat, axis=1)
+        return best, jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+
+    # prefer the mtry-sampled features; when none of them admits a valid
+    # split, keep looking among all features (sklearn max_features
+    # semantics: the search widens until a valid partition is found)
+    best_m, gain_m = best_of(gain_masked)
+    best_a, gain_a = best_of(gain_all)
+    use_masked = gain_m > min_info_gain
+    best = jnp.where(use_masked, best_m, best_a)
+    best_gain = jnp.where(use_masked, gain_m, gain_a)
+    best_feat = (best // num_bins).astype(jnp.int32)
+    best_bin = (best % num_bins).astype(jnp.int32)
+
+    do_split = (best_gain > min_info_gain) & jnp.isfinite(best_gain)
+    if is_last_level:
+        do_split = jnp.zeros_like(do_split)
+    split_feature = jnp.where(do_split, best_feat, -1)
+    split_bin = jnp.where(do_split, best_bin, -1)
+
+    # route examples: children heap indices; leaves freeze at -1
+    node_heap = pos_c + level_start
+    ex_feat = split_feature[pos_c]
+    ex_bin = split_bin[pos_c]
+    ex_split = do_split[pos_c] & active
+    goes_pos = binned[jnp.arange(n), jnp.maximum(ex_feat, 0)] > ex_bin
+    child = 2 * node_heap + 1 + goes_pos.astype(jnp.int32)
+    new_node_of = jnp.where(ex_split, child, jnp.where(active, -node_heap - 2, node_of))
+    # inactive-but-was-active encode as -(heap+2) so final leaf is recoverable
+    return split_feature, split_bin, jnp.where(do_split, best_gain, 0.0), node_tot, new_node_of
+
+
+def train_forest(
+    binned: np.ndarray,
+    targets: np.ndarray,
+    num_bins: int,
+    num_classes: int | None,
+    num_trees: int = 20,
+    max_depth: int = 8,
+    min_node_size: float = 1.0,
+    min_info_gain: float = 0.0,
+    impurity: str = "entropy",
+    mtry: int | None = None,
+    seed: int | None = None,
+    exclude_features: set[int] | None = None,
+) -> ForestArrays:
+    """Train `num_trees` trees over pre-binned features. Columns in
+    `exclude_features` (e.g. the target's predictor slot) are never
+    sampled for splitting."""
+    from oryx_tpu.common import rng as rng_mod
+
+    binned = np.asarray(binned, dtype=np.int32)
+    n, p = binned.shape
+    allowed = np.asarray(
+        sorted(set(range(p)) - (exclude_features or set())), dtype=np.int64
+    )
+    if len(allowed) == 0:
+        raise ValueError("no usable features")
+    if num_classes is None:
+        y = np.asarray(targets, dtype=np.float32)
+        stats_base = np.stack([np.ones(n, np.float32), y, y * y], axis=1)
+        imp_kind = "variance"
+    else:
+        y = np.asarray(targets, dtype=np.int32)
+        stats_base = np.eye(num_classes, dtype=np.float32)[y]
+        imp_kind = impurity
+    pa = len(allowed)
+    if mtry is None:
+        mtry = max(1, int(np.sqrt(pa)) if num_classes is not None else max(1, pa // 3))
+
+    max_nodes = 2 ** (max_depth + 1) - 1
+    gen = np.random.default_rng(rng_mod.next_seed() if seed is None else seed)
+
+    t_feat = np.full((num_trees, max_nodes), -1, dtype=np.int32)
+    t_bin = np.full((num_trees, max_nodes), -1, dtype=np.int32)
+    t_stats = np.zeros((num_trees, max_nodes, stats_base.shape[1]), dtype=np.float64)
+    t_counts = np.zeros((num_trees, max_nodes), dtype=np.float64)
+    t_gains = np.zeros((num_trees, max_nodes), dtype=np.float64)
+
+    binned_dev = jnp.asarray(binned)  # uploaded once, reused every level/tree
+    for t in range(num_trees):
+        w = gen.poisson(1.0, n).astype(np.float32) if num_trees > 1 else np.ones(n, np.float32)
+        stats_chan = jnp.asarray(stats_base * w[:, None])
+        node_of = np.where(w > 0, 0, -1).astype(np.int32)
+        node_of_dev = jnp.asarray(node_of)
+        for depth in range(max_depth + 1):
+            level_start = 2**depth - 1
+            num_level = 2**depth
+            feat_mask = np.zeros((num_level, p), dtype=np.float32)
+            for l in range(num_level):
+                feat_mask[l, gen.choice(allowed, size=min(mtry, pa), replace=False)] = 1.0
+            sf, sb, gains, node_tot, node_of_dev = _grow_level(
+                binned_dev,
+                stats_chan,
+                node_of_dev,
+                jnp.asarray(feat_mask),
+                level_start,
+                num_level,
+                num_bins,
+                imp_kind,
+                np.float32(min_node_size),
+                np.float32(min_info_gain),
+                depth == max_depth,
+            )
+            sl = slice(level_start, level_start + num_level)
+            t_feat[t, sl] = np.asarray(sf)
+            t_bin[t, sl] = np.asarray(sb)
+            t_stats[t, sl] = np.asarray(node_tot)
+            t_counts[t, sl] = np.asarray(node_tot)[:, 0] if num_classes is None else np.asarray(node_tot).sum(axis=1)
+            t_gains[t, sl] = np.asarray(gains)
+            if np.all(np.asarray(sf) < 0):
+                break
+    if num_classes is not None:
+        # classification count channel: stats ARE the per-class counts
+        pass
+    return ForestArrays(t_feat, t_bin, t_stats, t_counts, t_gains, num_classes)
+
+
+def feature_importances(forest: ForestArrays, num_features: int) -> np.ndarray:
+    """Total weighted impurity decrease per feature, normalized to max 1
+    (DecisionForest feature-importance semantics)."""
+    imp = np.zeros(num_features)
+    feat = forest.split_feature
+    weight = forest.node_counts * forest.gains
+    for t in range(forest.num_trees):
+        mask = feat[t] >= 0
+        np.add.at(imp, feat[t][mask], weight[t][mask])
+    m = imp.max()
+    return imp / m if m > 0 else imp
+
+
+def predict_forest_binned(forest: ForestArrays, binned: np.ndarray) -> np.ndarray:
+    """Vectorized inference over the flat heap arrays (device-friendly):
+    returns [n, C] summed class counts or [n, 2] (sum, count) pooled."""
+    binned = jnp.asarray(binned, dtype=jnp.int32)
+    sf = jnp.asarray(forest.split_feature)
+    sb = jnp.asarray(forest.split_bin)
+    stats = jnp.asarray(forest.node_stats, dtype=jnp.float32)
+    max_depth = int(np.log2(forest.split_feature.shape[1] + 1)) - 1
+
+    @jax.jit
+    def run(x):
+        n = x.shape[0]
+
+        def one_tree(carry, ti):
+            node = jnp.zeros(n, dtype=jnp.int32)
+
+            def step(_, node_):
+                f = sf[ti][node_]
+                b = sb[ti][node_]
+                is_split = f >= 0
+                goes_pos = x[jnp.arange(n), jnp.maximum(f, 0)] > b
+                child = 2 * node_ + 1 + goes_pos.astype(jnp.int32)
+                return jnp.where(is_split, child, node_)
+
+            node = jax.lax.fori_loop(0, max_depth + 1, step, node)
+            return carry + stats[ti][node], None
+
+        acc, _ = jax.lax.scan(one_tree, jnp.zeros((n, stats.shape[2])), jnp.arange(sf.shape[0]))
+        return acc
+
+    return np.asarray(run(binned))

@@ -1,0 +1,251 @@
+"""K-means on TPU: Lloyd iterations and internal evaluation metrics.
+
+The TPU-native replacement for Spark MLlib's KMeans.train used by the
+reference's KMeansUpdate (app/oryx-app-mllib/.../kmeans/KMeansUpdate.java:
+116-117): one Lloyd iteration is a distance matmul ([n,d] @ [d,k] on the
+MXU), an argmin, and segment-sum reductions — points row-sharded over the
+mesh's 'data' axis, centers replicated, XLA reducing partial sums across
+shards. Initialization: "random" or "k-means||" (Bahmani et al.;
+MLlib's default init, oversample then weighted k-means++ on candidates).
+
+Also the four internal clustering quality metrics the reference computes
+as Spark map-reduces (SumSquaredError/DaviesBouldinIndex/DunnIndex/
+SilhouetteCoefficient.java, SURVEY.md §2.8), vectorized.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from oryx_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
+
+
+@functools.partial(jax.jit, static_argnums=3)
+def _lloyd_run(points, centers0, mask, iterations):
+    """points [n, d], centers0 [k, d], mask [n] bool (False = padding row)."""
+
+    def assign(points_, centers_, mask_):
+        d2 = (
+            jnp.sum(points_ * points_, axis=1, keepdims=True)
+            - 2.0 * points_ @ centers_.T
+            + jnp.sum(centers_ * centers_, axis=1)[None, :]
+        )
+        a = jnp.argmin(d2, axis=1)
+        mind2 = jnp.min(d2, axis=1)
+        return a, jnp.where(mask_, mind2, 0.0)
+
+    def body(_, centers_):
+        a, _d = assign(points, centers_, mask)
+        k = centers_.shape[0]
+        w = mask.astype(points.dtype)
+        sums = jax.ops.segment_sum(points * w[:, None], a, num_segments=k)
+        counts = jax.ops.segment_sum(w, a, num_segments=k)
+        new_centers = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers_
+        )
+        return new_centers
+
+    centers = jax.lax.fori_loop(0, iterations, body, centers0)
+    a, d2 = assign(points, centers, mask)
+    w = mask.astype(points.dtype)
+    counts = jax.ops.segment_sum(w, a, num_segments=centers.shape[0])
+    return centers, counts, jnp.sum(d2)
+
+
+def train_kmeans(
+    points: np.ndarray,
+    k: int,
+    iterations: int = 30,
+    init: str = "k-means||",
+    mesh: Optional[Mesh] = None,
+    seed: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """Returns (centers [k,d], counts [k], cost). Padded internally so the
+    point rows shard evenly over the mesh."""
+    from oryx_tpu.common import rng as rng_mod
+
+    points = np.asarray(points, dtype=np.float32)
+    n, d = points.shape
+    if n == 0:
+        raise ValueError("no points")
+    k = min(k, n)
+    gen = np.random.default_rng(rng_mod.next_seed() if seed is None else seed)
+    if init == "random":
+        centers0 = points[gen.choice(n, size=k, replace=False)]
+    else:
+        centers0 = _kmeans_parallel_init(points, k, gen)
+
+    num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    n_pad = pad_to_multiple(n, num_shards)
+    if n_pad != n:
+        points = np.concatenate([points, np.zeros((n_pad - n, d), dtype=np.float32)])
+    mask = np.arange(n_pad) < n  # explicit: origin points are real data
+
+    if mesh is not None:
+        rows = NamedSharding(mesh, P(DATA_AXIS, None))
+        row1 = NamedSharding(mesh, P(DATA_AXIS))
+        repl = NamedSharding(mesh, P())
+        points_dev = jax.device_put(points, rows)
+        mask_dev = jax.device_put(mask, row1)
+        centers_dev = jax.device_put(centers0.astype(np.float32), repl)
+        centers, counts, cost = _lloyd_run(points_dev, centers_dev, mask_dev, iterations)
+    else:
+        centers, counts, cost = _lloyd_run(points, centers0.astype(np.float32), mask, iterations)
+    return np.asarray(centers), np.asarray(counts), float(cost)
+
+
+def _kmeans_parallel_init(points: np.ndarray, k: int, gen: np.random.Generator, rounds: int = 2):
+    """k-means|| oversampling init then weighted k-means++ over candidates."""
+    n = points.shape[0]
+    centers = [points[gen.integers(n)]]
+    oversample = 2 * k
+    for _ in range(rounds):
+        c = np.stack(centers)
+        d2 = _min_sq_dists(points, c)
+        total = d2.sum()
+        if total <= 0:
+            break
+        probs = np.minimum(oversample * d2 / total, 1.0)
+        picked = np.nonzero(gen.random(n) < probs)[0]
+        centers.extend(points[i] for i in picked)
+    cand = np.stack(centers)
+    if len(cand) <= k:
+        # oversampling came up short: top up with random points (keeping
+        # the sampled candidates first; duplicates are harmless — Lloyd
+        # leaves an empty cluster's center in place)
+        extra = points[gen.choice(n, size=k, replace=n < k)]
+        return np.concatenate([cand, extra])[:k]
+    # weight candidates by how many points they attract, then k-means++
+    assign = np.argmin(_sq_dist_matrix(points, cand), axis=1)
+    weights = np.bincount(assign, minlength=len(cand)).astype(np.float64)
+    return _weighted_kmeans_pp(cand, weights, k, gen)
+
+
+def _weighted_kmeans_pp(cand: np.ndarray, weights: np.ndarray, k: int, gen) -> np.ndarray:
+    chosen = [int(gen.choice(len(cand), p=weights / weights.sum()))]
+    for _ in range(k - 1):
+        d2 = _min_sq_dists(cand, cand[chosen])
+        score = d2 * weights
+        total = score.sum()
+        if total <= 0:
+            remaining = [i for i in range(len(cand)) if i not in chosen]
+            chosen.append(int(gen.choice(remaining)))
+            continue
+        chosen.append(int(gen.choice(len(cand), p=score / total)))
+    return cand[chosen]
+
+
+def _sq_dist_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return (
+        np.sum(a * a, axis=1, keepdims=True)
+        - 2.0 * a @ b.T
+        + np.sum(b * b, axis=1)[None, :]
+    )
+
+
+def _min_sq_dists(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return np.maximum(_sq_dist_matrix(a, b).min(axis=1), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Assignment + internal evaluation metrics
+# ---------------------------------------------------------------------------
+
+
+def assign_clusters(points: np.ndarray, centers: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(cluster ids, distances) for each point (Euclidean)."""
+    d2 = _sq_dist_matrix(np.asarray(points, np.float64), np.asarray(centers, np.float64))
+    a = np.argmin(d2, axis=1)
+    return a, np.sqrt(np.maximum(d2[np.arange(len(a)), a], 0.0))
+
+
+def sum_squared_error(points: np.ndarray, centers: np.ndarray) -> float:
+    """SSE: lower is better (SumSquaredError.java)."""
+    _, dist = assign_clusters(points, centers)
+    return float(np.sum(dist**2))
+
+
+def _cluster_mean_dists(points: np.ndarray, centers: np.ndarray) -> np.ndarray:
+    a, dist = assign_clusters(points, centers)
+    k = centers.shape[0]
+    sums = np.bincount(a, weights=dist, minlength=k)
+    counts = np.maximum(np.bincount(a, minlength=k), 1)
+    return sums / counts
+
+
+def davies_bouldin_index(points: np.ndarray, centers: np.ndarray) -> float:
+    """Mean over clusters i of max_j != i (S_i + S_j) / d(c_i, c_j);
+    lower is better (DaviesBouldinIndex.java)."""
+    s = _cluster_mean_dists(points, centers)
+    k = centers.shape[0]
+    if k < 2:
+        return 0.0
+    cd = np.sqrt(np.maximum(_sq_dist_matrix(centers.astype(np.float64), centers.astype(np.float64)), 0))
+    ratios = (s[:, None] + s[None, :]) / np.where(cd > 0, cd, np.inf)
+    np.fill_diagonal(ratios, 0.0)
+    return float(np.mean(ratios.max(axis=1)))
+
+
+def dunn_index(points: np.ndarray, centers: np.ndarray) -> float:
+    """Min centroid separation / max mean intra-cluster distance; higher
+    is better (DunnIndex.java)."""
+    s = _cluster_mean_dists(points, centers)
+    k = centers.shape[0]
+    if k < 2:
+        return 0.0
+    cd = np.sqrt(np.maximum(_sq_dist_matrix(centers.astype(np.float64), centers.astype(np.float64)), 0))
+    cd[np.eye(k, dtype=bool)] = np.inf
+    max_intra = s.max()
+    if max_intra <= 0:
+        return 0.0
+    return float(cd.min() / max_intra)
+
+
+def silhouette_coefficient(
+    points: np.ndarray, centers: np.ndarray, max_sample: int = 100_000, gen=None
+) -> float:
+    """Mean silhouette over a sample; singleton clusters contribute 0
+    (SilhouetteCoefficient.java, MAX_SAMPLE_SIZE=100000)."""
+    points = np.asarray(points, dtype=np.float64)
+    if gen is None:
+        from oryx_tpu.common import rng as rng_mod
+
+        gen = rng_mod.get_random()
+    if len(points) > max_sample:
+        points = points[gen.choice(len(points), size=max_sample, replace=False)]
+    a, _ = assign_clusters(points, centers)
+    k = centers.shape[0]
+    total = 0.0
+    count = len(points)
+    if count == 0:
+        return 0.0
+    # pairwise distances point -> mean distance to each cluster's points
+    by_cluster = [points[a == c] for c in range(k)]
+    sizes = np.asarray([len(p) for p in by_cluster])
+    for c in range(k):
+        pts = by_cluster[c]
+        if len(pts) <= 1:
+            continue  # contributes 0
+        # mean distance from each point in c to all points of each cluster
+        dists = [
+            np.sqrt(np.maximum(_sq_dist_matrix(pts, by_cluster[o]), 0)) if sizes[o] else None
+            for o in range(k)
+        ]
+        intra = (dists[c].sum(axis=1)) / (sizes[c] - 1)  # exclude self (d=0)
+        inter = np.full(len(pts), np.inf)
+        for o in range(k):
+            if o == c or not sizes[o]:
+                continue
+            inter = np.minimum(inter, dists[o].mean(axis=1))
+        valid = np.isfinite(inter)
+        s = np.where(
+            valid, (inter - intra) / np.maximum(np.maximum(intra, inter), 1e-300), 0.0
+        )
+        total += float(s.sum())
+    return total / count

@@ -50,3 +50,15 @@ def data_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedSharding
 def pad_to_multiple(n: int, multiple: int) -> int:
     """Smallest m >= n with m % multiple == 0 (shard-evenly helper)."""
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def mesh_from_config(config) -> Mesh | None:
+    """Mesh per oryx.batch.compute.mesh: explicit axis spec, or all local
+    devices on one 'data' axis when several are present, else None
+    (single device: skip sharding machinery entirely)."""
+    spec = config.get("oryx.batch.compute.mesh", None)
+    if spec is None:
+        if len(jax.devices()) > 1:
+            return get_mesh()
+        return None
+    return get_mesh(spec)

@@ -1,0 +1,217 @@
+"""K-means tests: kernel quality, PMML round-trip, speed drift, serving
+endpoints, full-pipeline IT (reference: KMeansUpdateIT, KMeansSpeedIT,
+AssignTest/DistanceToNearestTest patterns)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.app.kmeans import common as km
+from oryx_tpu.app.kmeans.speed import KMeansSpeedModelManager
+from oryx_tpu.app.kmeans.update import KMeansUpdate
+from oryx_tpu.app.schema import InputSchema
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.ops import kmeans as km_ops
+
+
+def gaussians(n_per=50, centers=((0, 0), (10, 10), (0, 10)), seed=4, std=0.5):
+    gen = np.random.default_rng(seed)
+    pts = np.concatenate(
+        [c + std * gen.standard_normal((n_per, 2)) for c in np.asarray(centers, float)]
+    )
+    gen.shuffle(pts)
+    return pts
+
+
+def schema_config(extra=""):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-schema {{
+            feature-names = ["x", "y"]
+            numeric-features = ["x", "y"]
+          }}
+          kmeans {{ hyperparams.k = 3\n iterations = 15\n runs = 2 }}
+          ml.eval {{ candidates = 1, test-fraction = 0 }}
+          {extra}
+        }}
+        """
+    )
+
+
+def test_lloyd_recovers_gaussian_centers():
+    pts = gaussians()
+    centers, counts, cost = km_ops.train_kmeans(pts, 3, iterations=20, seed=1)
+    assert counts.sum() == len(pts)
+    # each true center has a learned center within 0.5
+    for true in [(0, 0), (10, 10), (0, 10)]:
+        d = np.linalg.norm(centers - np.asarray(true), axis=1).min()
+        assert d < 0.5, (true, centers)
+
+
+def test_sharded_kmeans_matches_single():
+    from oryx_tpu.parallel.mesh import get_mesh
+
+    pts = gaussians(n_per=40)
+    c1, n1, cost1 = km_ops.train_kmeans(pts, 3, iterations=10, seed=42)
+    c2, n2, cost2 = km_ops.train_kmeans(pts, 3, iterations=10, seed=42, mesh=get_mesh())
+    assert cost2 == pytest.approx(cost1, rel=1e-4)
+
+
+def test_eval_metrics_prefer_true_k():
+    pts = gaussians()
+    good_centers, _, _ = km_ops.train_kmeans(pts, 3, iterations=20, seed=2)
+    bad_centers, _, _ = km_ops.train_kmeans(pts, 2, iterations=20, seed=2)
+    assert km_ops.sum_squared_error(pts, good_centers) < km_ops.sum_squared_error(pts, bad_centers)
+    assert km_ops.silhouette_coefficient(pts, good_centers) > km_ops.silhouette_coefficient(pts, bad_centers)
+    assert km_ops.davies_bouldin_index(pts, good_centers) < km_ops.davies_bouldin_index(pts, bad_centers)
+    assert km_ops.dunn_index(pts, good_centers) > 0
+
+
+def test_cluster_info_update_running_mean():
+    c = km.ClusterInfo(0, np.array([1.0, 1.0]), 2)
+    c.update(np.array([4.0, 4.0]), 2)  # two points summing to (4,4)
+    np.testing.assert_allclose(c.center, [1.5, 1.5])
+    assert c.count == 4
+
+
+def test_pmml_round_trip():
+    cfg = schema_config()
+    schema = InputSchema(cfg)
+    clusters = [
+        km.ClusterInfo(0, np.array([0.5, 1.5]), 10),
+        km.ClusterInfo(1, np.array([9.5, 10.5]), 20),
+    ]
+    root = km.clusters_to_pmml(clusters, schema)
+    again = km.pmml_to_clusters(pmml_io.from_string(pmml_io.to_string(root)))
+    assert [c.id for c in again] == [0, 1]
+    assert [c.count for c in again] == [10, 20]
+    np.testing.assert_allclose(again[0].center, [0.5, 1.5])
+
+
+def test_batch_update_trains_and_evaluates(tmp_path):
+    cfg = schema_config()
+    update = KMeansUpdate(cfg)
+    data = [KeyMessage(None, f"{x},{y}") for x, y in gaussians(n_per=30)]
+    pmml = update.build_model(data, [3], tmp_path)
+    clusters = km.pmml_to_clusters(pmml)
+    assert len(clusters) == 3
+    assert sum(c.count for c in clusters) == 90
+    score = update.evaluate(pmml, tmp_path, [], data)
+    assert -1.0 <= score <= 1.0  # silhouette default
+
+
+def test_rejects_categorical_schema():
+    cfg = C.get_default().with_overlay(
+        """
+        oryx.input-schema {
+          feature-names = ["x", "y"]
+          categorical-features = ["y"]
+        }
+        """
+    )
+    with pytest.raises(ValueError):
+        KMeansUpdate(cfg)
+
+
+def test_speed_manager_drift_and_updates():
+    cfg = schema_config()
+    mgr = KMeansSpeedModelManager(cfg)
+    schema = InputSchema(cfg)
+    clusters = [
+        km.ClusterInfo(0, np.array([0.0, 0.0]), 4),
+        km.ClusterInfo(1, np.array([10.0, 10.0]), 4),
+    ]
+    model_msg = pmml_io.to_string(km.clusters_to_pmml(clusters, schema))
+    mgr.consume(iter([KeyMessage("MODEL", model_msg)]))
+    ups = list(mgr.build_updates([
+        KeyMessage(None, "1.0,1.0"),
+        KeyMessage(None, "1.0,0.0"),
+        KeyMessage(None, "9.0,11.0"),
+    ]))
+    assert len(ups) == 2
+    by_id = {json.loads(u)[0]: json.loads(u) for u in ups}
+    # cluster 0 absorbed (1,1)+(1,0): center = (0*4 + 2, 0*4 + 1)/6
+    np.testing.assert_allclose(by_id[0][1], [2 / 6, 1 / 6], atol=1e-9)
+    assert by_id[0][2] == 6
+    np.testing.assert_allclose(by_id[1][1], [(40 + 9) / 5, (40 + 11) / 5])
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_kmeans_full_pipeline(tmp_path):
+    from oryx_tpu.lambda_.batch import BatchLayer
+    from oryx_tpu.lambda_.speed import SpeedLayer
+    from oryx_tpu.serving.layer import ServingLayer
+
+    broker_loc = "inproc://kmeans-e2e"
+    cfg = schema_config(
+        f"""
+        id = "KMeansE2E"
+        input-topic.broker = "{broker_loc}"
+        update-topic.broker = "{broker_loc}"
+        batch {{
+          streaming.generation-interval-sec = 3600
+          update-class = "oryx_tpu.app.kmeans.update:KMeansUpdate"
+          storage {{ data-dir = "{tmp_path}/data/"
+                    model-dir = "{tmp_path}/model/" }}
+        }}
+        speed {{
+          streaming.generation-interval-sec = 3600
+          model-manager-class = "oryx_tpu.app.kmeans.speed:KMeansSpeedModelManager"
+        }}
+        serving {{
+          api.port = 0
+          model-manager-class = "oryx_tpu.app.kmeans.serving:KMeansServingModelManager"
+          application-resources = "oryx_tpu.app.kmeans.serving"
+        }}
+        """
+    )
+    batch = BatchLayer(cfg)
+    batch.prepare()
+    speed = SpeedLayer(cfg)
+    speed.start()
+    serving = ServingLayer(cfg)
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    try:
+        lines = "\n".join(f"{x},{y}" for x, y in gaussians(n_per=25))
+        status, _ = http("POST", f"{base}/add", lines.encode())
+        assert status == 204
+        batch.run_one_generation(timestamp_ms=777)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if http("GET", f"{base}/ready")[0] == 200:
+                break
+            time.sleep(0.05)
+        status, body = http("GET", f"{base}/assign/0.2,0.1")
+        assert status == 200
+        c_origin = json.loads(body)
+        status, body = http("GET", f"{base}/assign/9.9,10.2")
+        c_far = json.loads(body)
+        assert c_origin != c_far
+        status, body = http("GET", f"{base}/distanceToNearest/0.0,0.0")
+        assert status == 200
+        assert json.loads(body) < 2.0
+        # speed drift: new points near origin shift that centroid
+        status, _ = http("POST", f"{base}/add", b"0.1,0.1\n0.2,0.2\n")
+        assert status == 204
+        sent = speed.run_one_batch()
+        assert sent >= 1
+    finally:
+        serving.close()
+        speed.close()
+        batch.close()

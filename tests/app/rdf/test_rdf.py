@@ -1,0 +1,280 @@
+"""RDF tests: tree family, trainer quality, PMML round-trip, speed leaf
+updates, serving endpoints (reference: DecisionTreeTest/DecisionForestTest,
+RDFUpdateIT, RDFSpeedIT, PredictTest patterns)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.rdf import encode, forest_pmml, tree as T
+from oryx_tpu.app.rdf.speed import RDFSpeedModelManager
+from oryx_tpu.app.rdf.update import RDFUpdate
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.ops import forest as forest_ops
+
+
+# ---------------------------------------------------------------------------
+# tree family (reference: rdf/tree tests)
+# ---------------------------------------------------------------------------
+
+
+def hand_tree():
+    #        r: f0 >= 2.0 ?
+    #   r-: leaf A            r+: f1 in {1} ?
+    #                    r+-: leaf B    r++: leaf C
+    leaf_a = T.TerminalNode("r-", T.CategoricalPrediction([10, 0]))
+    leaf_b = T.TerminalNode("r+-", T.CategoricalPrediction([2, 6]))
+    leaf_c = T.TerminalNode("r++", T.CategoricalPrediction([0, 8]))
+    inner = T.DecisionNode("r+", T.CategoricalDecision(1, frozenset({1})), leaf_b, leaf_c, 16)
+    root = T.DecisionNode("r", T.NumericDecision(0, 2.0), leaf_a, inner, 26)
+    return T.DecisionTree(root)
+
+
+def test_tree_traversal_and_find_by_id():
+    tree = hand_tree()
+    assert tree.find_terminal([1.0, 0]).id == "r-"
+    assert tree.find_terminal([3.0, 1]).id == "r++"
+    assert tree.find_terminal([3.0, 0]).id == "r+-"
+    assert tree.find_by_id("r+").id == "r+"
+    assert tree.find_by_id("r+-").id == "r+-"
+    assert tree.find_by_id("r").id == "r"
+
+
+def test_terminal_update_and_vote():
+    tree = hand_tree()
+    leaf = tree.find_by_id("r-")
+    leaf.update(1, 5)
+    assert leaf.prediction.counts.tolist() == [10, 5]
+    forest = T.DecisionForest([tree, hand_tree()], [2.0, 1.0])
+    pred = forest.predict([1.0, 0])
+    assert pred.most_probable_index == 0
+
+
+def test_numeric_prediction_running_mean():
+    p = T.NumericPrediction(2.0, 2)
+    p.update(5.0, 1)
+    assert p.prediction == pytest.approx(3.0)
+    assert p.count == 3
+
+
+# ---------------------------------------------------------------------------
+# trainer
+# ---------------------------------------------------------------------------
+
+
+def test_forest_learns_xor():
+    gen = np.random.default_rng(0)
+    n = 600
+    x = gen.integers(0, 2, (n, 2)).astype(np.float64)
+    y = (x[:, 0].astype(int) ^ x[:, 1].astype(int)).astype(np.int32)
+    binned = x.astype(np.int32)
+    arrays = forest_ops.train_forest(
+        binned, y, num_bins=2, num_classes=2, num_trees=5, max_depth=3, mtry=2, seed=3
+    )
+    out = forest_ops.predict_forest_binned(arrays, binned)
+    acc = (np.argmax(out, axis=1) == y).mean()
+    assert acc > 0.95, acc
+
+
+def test_forest_regression():
+    gen = np.random.default_rng(1)
+    n = 500
+    x = gen.random((n, 3))
+    y = (3.0 * (x[:, 0] > 0.5) + 2.0 * x[:, 1]).astype(np.float32)
+    # bin by 10 quantiles per feature
+    binned = np.floor(x * 10).astype(np.int32)
+    arrays = forest_ops.train_forest(
+        binned, y, num_bins=10, num_classes=None, num_trees=10, max_depth=5, mtry=3, seed=5
+    )
+    out = forest_ops.predict_forest_binned(arrays, binned)
+    pred = out[:, 1] / np.maximum(out[:, 0], 1e-9)
+    rmse = np.sqrt(np.mean((pred - y) ** 2))
+    assert rmse < 0.5, rmse
+
+
+# ---------------------------------------------------------------------------
+# full app: schema'd training + PMML + eval
+# ---------------------------------------------------------------------------
+
+
+def rdf_config(target="label", categorical='["color", "label"]', extra=""):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-schema {{
+            feature-names = ["size", "color", "label"]
+            categorical-features = {categorical}
+            target-feature = "{target}"
+          }}
+          rdf {{ num-trees = 5\n hyperparams.max-depth = 4 }}
+          ml.eval {{ candidates = 1, test-fraction = 0 }}
+          {extra}
+        }}
+        """
+    )
+
+
+def classification_data(n=400, seed=2):
+    # label = big iff size > 5 or color == red
+    gen = np.random.default_rng(seed)
+    recs = []
+    for _ in range(n):
+        size = round(float(gen.random() * 10), 3)
+        color = gen.choice(["red", "green", "blue"])
+        label = "big" if (size > 5 or color == "red") else "small"
+        recs.append(KeyMessage(None, f"{size},{color},{label}"))
+    return recs
+
+
+def test_rdf_update_train_eval_pmml_round_trip(tmp_path):
+    cfg = rdf_config()
+    update = RDFUpdate(cfg)
+    data = classification_data()
+    pmml = update.build_model(data, [20, 4, "entropy"], tmp_path)
+    acc = update.evaluate(pmml, tmp_path, data[:100], data)
+    assert acc > 0.9, acc
+    # round trip through XML text preserves behavior
+    text = pmml_io.to_string(pmml)
+    forest2, enc2 = forest_pmml.pmml_to_forest(pmml_io.from_string(text), update.schema)
+    features, targets = encode.parse_examples(data[:50], update.schema, enc2)
+    agree = sum(
+        forest2.predict(row).most_probable_index == int(t)
+        for row, t in zip(features, targets)
+    )
+    assert agree >= 45
+
+
+def test_rdf_regression_update(tmp_path):
+    cfg = rdf_config(target="size", categorical='["color"]')
+    update = RDFUpdate(cfg)
+    gen = np.random.default_rng(3)
+    data = []
+    for _ in range(300):
+        color = gen.choice(["red", "green"])
+        base = 8.0 if color == "red" else 2.0
+        size = round(base + float(gen.standard_normal() * 0.3), 3)
+        data.append(KeyMessage(None, f"{size},{color},ignored"))
+    # 'label' feature inactive? make it ignored via schema: here it's numeric noise
+    cfg2 = C.get_default().with_overlay(
+        """
+        oryx {
+          input-schema {
+            feature-names = ["size", "color", "label"]
+            categorical-features = ["color", "label"]
+            target-feature = "size"
+            ignored-features = ["label"]
+          }
+          rdf { num-trees = 5\n hyperparams.max-depth = 3 }
+          ml.eval { candidates = 1, test-fraction = 0 }
+        }
+        """
+    )
+    update = RDFUpdate(cfg2)
+    pmml = update.build_model(data, [10, 3, "variance"], tmp_path)
+    score = update.evaluate(pmml, tmp_path, data[:50], data)
+    assert score > -1.0  # rmse < 1.0
+
+
+def test_feature_importance_identifies_signal(tmp_path):
+    cfg = rdf_config()
+    update = RDFUpdate(cfg)
+    pmml = update.build_model(classification_data(), [20, 4, "gini"], tmp_path)
+    forest, _ = forest_pmml.pmml_to_forest(pmml, update.schema)
+    assert forest.feature_importances is not None
+    # size (predictor 0) must dominate or match color; target gets ~0
+    fi = forest.feature_importances
+    assert fi[0] > 0.1
+    tfi_pred = update.schema.feature_to_predictor_index(2)
+    assert fi[tfi_pred] == pytest.approx(0.0)
+
+
+# ---------------------------------------------------------------------------
+# speed + serving
+# ---------------------------------------------------------------------------
+
+
+def test_speed_emits_leaf_updates(tmp_path):
+    cfg = rdf_config()
+    update = RDFUpdate(cfg)
+    data = classification_data()
+    pmml = update.build_model(data, [20, 4, "entropy"], tmp_path)
+    mgr = RDFSpeedModelManager(cfg)
+    mgr.consume(iter([KeyMessage("MODEL", pmml_io.to_string(pmml))]))
+    ups = list(mgr.build_updates([KeyMessage(None, "9.0,red,big"), KeyMessage(None, "9.1,red,big")]))
+    assert ups
+    for u in ups:
+        tree_id, node_id, counts = json.loads(u)
+        assert isinstance(tree_id, int) and node_id.startswith("r")
+        assert counts.get("big") in (1, 2)
+
+
+def test_serving_end_to_end(tmp_path):
+    from oryx_tpu import bus
+    from oryx_tpu.serving.layer import ServingLayer
+
+    broker_loc = "inproc://rdf-serve"
+    broker = bus.get_broker(broker_loc)
+    cfg = rdf_config(
+        extra=f"""
+        input-topic.broker = "{broker_loc}"
+        update-topic.broker = "{broker_loc}"
+        serving {{
+          api.port = 0
+          model-manager-class = "oryx_tpu.app.rdf.serving:RDFServingModelManager"
+          application-resources = "oryx_tpu.app.rdf.serving"
+        }}
+        """
+    )
+    update = RDFUpdate(cfg)
+    pmml = update.build_model(classification_data(), [20, 4, "entropy"], tmp_path)
+    layer = ServingLayer(cfg)
+    layer.start()
+    base = f"http://127.0.0.1:{layer.port}"
+
+    def http(method, url, body=None):
+        req = urllib.request.Request(url, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    try:
+        with broker.producer("OryxUpdate") as p:
+            p.send("MODEL", pmml_io.to_string(pmml))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if http("GET", f"{base}/ready")[0] == 200:
+                break
+            time.sleep(0.05)
+        status, body = http("GET", f"{base}/predict/9.5,red,")
+        assert status == 200
+        assert json.loads(body) == "big"
+        status, body = http("GET", f"{base}/predict/1.0,blue,")
+        assert json.loads(body) == "small"
+        status, body = http("POST", f"{base}/predict", b"9.5,red,\n1.0,blue,\n")
+        assert json.loads(body) == ["big", "small"]
+        status, body = http("GET", f"{base}/classificationDistribution/9.5,red,")
+        dist = json.loads(body)
+        assert dist["big"] > 0.8
+        status, body = http("GET", f"{base}/feature/importance")
+        fi = json.loads(body)
+        assert set(fi) == {"size", "color"}
+        # /train queues input
+        tail = broker.consumer("OryxInput", from_beginning=True)
+        assert http("POST", f"{base}/train", b"3.3,green,small\n")[0] == 204
+        assert [m.message for m in tail.poll(timeout=2.0)] == ["3.3,green,small"]
+        # speed-layer style leaf update via UP message shifts distribution
+        with broker.producer("OryxUpdate") as p:
+            p.send("UP", json.dumps([0, "r-", {"small": 50}]))
+        time.sleep(0.3)  # allow consume
+        status, body = http("GET", f"{base}/predict/1.0,blue,")
+        assert status == 200
+    finally:
+        layer.close()
