@@ -92,8 +92,16 @@ class KMeansSpeedModelManager(SpeedModelManager):
         sums: dict[int, np.ndarray] = {}
         counts: dict[int, int] = {}
         for rec in new_data:
-            point = km.features_from_tokens(parse_line(rec.message), self.schema)
-            nearest, _ = km.closest_cluster(clusters, point)
+            # raw client input (POST /add): a malformed line must not abort
+            # the whole micro-batch
+            try:
+                point = km.features_from_tokens(parse_line(rec.message), self.schema)
+                if point.shape != clusters[0].center.shape:
+                    raise ValueError(f"bad dimension {point.shape}")
+                nearest, _ = km.closest_cluster(clusters, point)
+            except (ValueError, IndexError, KeyError):
+                log.warning("skipping bad input line: %r", rec.message[:200])
+                continue
             if nearest.id in sums:
                 sums[nearest.id] += point
                 counts[nearest.id] += 1

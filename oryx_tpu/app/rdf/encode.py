@@ -42,16 +42,18 @@ def parse_examples(
     skip_unknown: bool = False,
 ) -> tuple[np.ndarray, np.ndarray]:
     """(features [n, P] float64, targets [n]) with categorical features and
-    categorical targets as encoded ids. With skip_unknown, records holding
-    a categorical value absent from `encodings` (e.g. a test-split value
-    never seen in training) are dropped instead of raising."""
+    categorical targets as encoded ids. With skip_unknown, malformed records
+    — a categorical value absent from `encodings` (e.g. a test-split value
+    never seen in training), a non-numeric token, or a short line — are
+    dropped instead of raising (the speed layer feeds this raw client input
+    from POST /train, so bad lines must not abort a micro-batch)."""
     rows, targets = [], []
     tfi = schema.target_feature_index
     for rec in data:
-        tokens = parse_line(rec.message if hasattr(rec, "message") else rec)
         row = np.empty(schema.num_predictors)
         target = None
         try:
+            tokens = parse_line(rec.message if hasattr(rec, "message") else rec)
             for i in range(schema.num_features):
                 if not schema.is_active(i):
                     continue
@@ -64,7 +66,7 @@ def parse_examples(
                 if i == tfi:
                     target = v
                 row[schema.feature_to_predictor_index(i)] = v
-        except KeyError:
+        except (KeyError, ValueError, IndexError):
             if skip_unknown:
                 continue
             raise

@@ -119,7 +119,12 @@ def _quote(v: str) -> str:
 def _unquote_array(text: str) -> list[str]:
     import re
 
-    return [m.group(1) or m.group(2) for m in re.finditer(r'"([^"]*)"|(\S+)', text or "")]
+    # group(1) may legitimately be '' (a quoted empty-string category), so
+    # test against None rather than truthiness
+    return [
+        m.group(1) if m.group(1) is not None else m.group(2)
+        for m in re.finditer(r'"([^"]*)"|(\S+)', text or "")
+    ]
 
 
 def pmml_to_forest(

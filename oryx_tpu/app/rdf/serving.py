@@ -12,7 +12,6 @@ and the endpoints: GET/POST /predict (classreg/Predict.java:51), POST
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Iterator
 
 import numpy as np
@@ -24,6 +23,7 @@ from oryx_tpu.app.schema import InputSchema
 from oryx_tpu.app.serving_common import check_not_read_only, get_ready_model, send_input
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import ReadWriteLock
 from oryx_tpu.common.text import parse_line, read_json
 from oryx_tpu.serving.web import OryxServingException, Request, Response, ServingContext, resource
 
@@ -36,7 +36,10 @@ class RDFServingModel(ServingModel):
         self.encodings = encodings
         self.schema = schema
         self.classification = schema.is_categorical(schema.target_feature)
-        self._lock = threading.Lock()
+        # traversal is read-mostly: concurrent /predict share the read side,
+        # only leaf updates take the write side (reference RDFServingModel
+        # guards the forest with an AutoReadWriteLock the same way)
+        self._lock = ReadWriteLock()
 
     def get_fraction_loaded(self) -> float:
         return 1.0
@@ -68,11 +71,11 @@ class RDFServingModel(ServingModel):
         return row
 
     def predict(self, datum: str):
-        with self._lock:
+        with self._lock.read():
             return self.forest.predict(self._features_from(datum))
 
     def update_leaf(self, tree_id: int, node_id: str, payload) -> None:
-        with self._lock:
+        with self._lock.write():
             if tree_id >= len(self.forest.trees):
                 return
             node = self.forest.trees[tree_id].find_by_id(node_id)

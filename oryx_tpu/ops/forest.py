@@ -18,9 +18,11 @@ SURVEY.md §7 step 5) grows all nodes of one depth at once:
 - trees come out as flat heap arrays (node i's children at 2i+1/2i+2)
   that the app tier converts to portable DecisionTree objects.
 
-Example rows shard over the mesh 'data' axis; the histogram segment-sums
-reduce across shards (XLA inserts the psum). Stats channels: per-class
-weighted counts for classification, (w, w*y, w*y^2) for regression.
+Stats channels: per-class weighted counts for classification,
+(w, w*y, w*y^2) for regression. Training currently runs on the default
+device; the level pass is a single fused program, so sharding example
+rows over a mesh 'data' axis (histogram partial-sums psum-reduced across
+shards) is a drop-in extension once single-chip profiles demand it.
 """
 
 from __future__ import annotations
@@ -31,8 +33,6 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-from oryx_tpu.parallel.mesh import DATA_AXIS
 
 
 @dataclass
@@ -235,9 +235,6 @@ def train_forest(
             t_gains[t, sl] = np.asarray(gains)
             if np.all(np.asarray(sf) < 0):
                 break
-    if num_classes is not None:
-        # classification count channel: stats ARE the per-class counts
-        pass
     return ForestArrays(t_feat, t_bin, t_stats, t_counts, t_gains, num_classes)
 
 

@@ -225,27 +225,32 @@ def silhouette_coefficient(
     count = len(points)
     if count == 0:
         return 0.0
-    # pairwise distances point -> mean distance to each cluster's points
+    # Mean distance from each point to each cluster's points, computed in
+    # row blocks so peak memory is O(block x largest-cluster) rather than
+    # O(|cluster| x sample) — at the 100k default sample a dense per-pair
+    # matrix would be tens of GB.
     by_cluster = [points[a == c] for c in range(k)]
     sizes = np.asarray([len(p) for p in by_cluster])
+    block = 256
     for c in range(k):
         pts = by_cluster[c]
         if len(pts) <= 1:
             continue  # contributes 0
-        # mean distance from each point in c to all points of each cluster
-        dists = [
-            np.sqrt(np.maximum(_sq_dist_matrix(pts, by_cluster[o]), 0)) if sizes[o] else None
-            for o in range(k)
-        ]
-        intra = (dists[c].sum(axis=1)) / (sizes[c] - 1)  # exclude self (d=0)
-        inter = np.full(len(pts), np.inf)
-        for o in range(k):
-            if o == c or not sizes[o]:
-                continue
-            inter = np.minimum(inter, dists[o].mean(axis=1))
-        valid = np.isfinite(inter)
-        s = np.where(
-            valid, (inter - intra) / np.maximum(np.maximum(intra, inter), 1e-300), 0.0
-        )
-        total += float(s.sum())
+        for start in range(0, len(pts), block):
+            blk = pts[start : start + block]
+            intra = np.zeros(len(blk))
+            inter = np.full(len(blk), np.inf)
+            for o in range(k):
+                if not sizes[o]:
+                    continue
+                d = np.sqrt(np.maximum(_sq_dist_matrix(blk, by_cluster[o]), 0))
+                if o == c:
+                    intra = d.sum(axis=1) / (sizes[c] - 1)  # exclude self (d=0)
+                else:
+                    inter = np.minimum(inter, d.mean(axis=1))
+            valid = np.isfinite(inter)
+            s = np.where(
+                valid, (inter - intra) / np.maximum(np.maximum(intra, inter), 1e-300), 0.0
+            )
+            total += float(s.sum())
     return total / count
