@@ -1,0 +1,5 @@
+"""python -m oryx_tpu — see oryx_tpu.cli."""
+
+from oryx_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,270 @@
+"""Command-line launcher — the oryx-run.sh equivalent.
+
+Rebuilds the operator surface of deploy/bin/oryx-run.sh:18-371 and the three
+deploy Mains (deploy/oryx-batch/.../Main.java:31-37 etc.) as one Python entry
+point:
+
+    python -m oryx_tpu batch   --conf oryx.conf
+    python -m oryx_tpu speed   --conf oryx.conf
+    python -m oryx_tpu serving --conf oryx.conf
+    python -m oryx_tpu bus-setup --conf oryx.conf     (kafka-setup analogue)
+    python -m oryx_tpu bus-tail  --conf oryx.conf     (kafka-tail analogue)
+    python -m oryx_tpu bus-input --conf oryx.conf --input-file data.csv
+    python -m oryx_tpu config    --conf oryx.conf     (ConfigToProperties)
+
+Where the reference wires user code with --app-jar, user app code here is a
+Python import path named in config; --app-dir prepends directories to
+sys.path so an app package outside the working dir resolves.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import logging
+import os
+import signal
+import sys
+
+from oryx_tpu.common import config as config_utils
+from oryx_tpu.common.config import Config
+from oryx_tpu.common.lang import close_at_shutdown
+
+log = logging.getLogger(__name__)
+
+COMMANDS = ("batch", "speed", "serving", "bus-setup", "bus-tail", "bus-input", "config")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="oryx_tpu",
+        description="TPU-native lambda-architecture ML framework launcher",
+    )
+    p.add_argument("command", choices=COMMANDS, help="which layer or utility to run")
+    p.add_argument(
+        "--conf",
+        default=None,
+        help="configuration file (HOCON); defaults to ./oryx.conf when present",
+    )
+    p.add_argument(
+        "--app-dir",
+        action="append",
+        default=[],
+        help="directory added to sys.path so config-named app classes import "
+        "(the --app-jar analogue); repeatable",
+    )
+    p.add_argument(
+        "--set",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="config override, e.g. --set oryx.serving.api.port=9090; repeatable",
+    )
+    p.add_argument("--input-file", default=None, help="bus-input: file to send line-by-line")
+    p.add_argument(
+        "--from-beginning",
+        action="store_true",
+        help="bus-tail: start from offset 0 instead of latest",
+    )
+    p.add_argument("--log-level", default="INFO", help="python logging level")
+    return p
+
+
+def load_config(conf: str | None, overrides: list[str]) -> Config:
+    """Layered config: packaged defaults <- --conf file <- --set overrides
+    (ConfigUtils.getDefault + -Dconfig.file semantics, oryx-run.sh:146-147)."""
+    if conf is None and os.path.exists("oryx.conf"):
+        conf = "oryx.conf"
+    if conf is not None:
+        if not os.path.exists(conf):
+            raise SystemExit(f"Config file {conf} does not exist")
+        os.environ["ORYX_CONF"] = conf
+    cfg = config_utils.get_default()
+    if overrides:
+        lines = []
+        for kv in overrides:
+            if "=" not in kv:
+                raise SystemExit(f"bad --set {kv!r}: expected KEY=VALUE")
+            key, _, value = kv.partition("=")
+            lines.append(f"{key} = {value}")
+        cfg = cfg.with_overlay("\n".join(lines))
+    return cfg
+
+
+def _install_signal_handlers(layer) -> None:
+    def handler(signum, frame):  # noqa: ARG001
+        log.info("signal %s: shutting down", signum)
+        layer.close()
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, handler)
+        except ValueError:  # pragma: no cover - non-main thread
+            pass
+
+
+def run_batch(cfg: Config) -> None:
+    """deploy/oryx-batch Main.java:31-37 analogue."""
+    from oryx_tpu.lambda_.batch import BatchLayer
+
+    layer = BatchLayer(cfg)
+    close_at_shutdown(layer)
+    _install_signal_handlers(layer)
+    layer.start()
+    layer.await_termination()
+
+
+def run_speed(cfg: Config) -> None:
+    from oryx_tpu.lambda_.speed import SpeedLayer
+
+    layer = SpeedLayer(cfg)
+    close_at_shutdown(layer)
+    _install_signal_handlers(layer)
+    layer.start()
+    layer.await_termination()
+
+
+def run_serving(cfg: Config) -> None:
+    from oryx_tpu.serving.layer import ServingLayer
+
+    layer = ServingLayer(cfg)
+    close_at_shutdown(layer)
+    _install_signal_handlers(layer)
+    layer.start()
+    layer.await_termination()
+
+
+def run_bus_setup(cfg: Config) -> None:
+    """kafka-setup analogue (oryx-run.sh:319-351): create input topic with
+    N partitions and the single-partition update topic, then report."""
+    from oryx_tpu.bus import core as bus
+
+    input_broker = cfg.get_string("oryx.input-topic.broker")
+    input_topic = cfg.get_string("oryx.input-topic.message.topic")
+    input_parts = cfg.get_optional_int("oryx.input-topic.message.partitions") or 1
+    bus.maybe_create_topic(input_broker, input_topic, input_parts)
+    print(f"created (or found) input topic {input_topic} "
+          f"({input_parts} partitions) on {input_broker}")
+
+    update_broker = cfg.get_optional_string("oryx.update-topic.broker")
+    update_topic = cfg.get_optional_string("oryx.update-topic.message.topic")
+    if update_broker and update_topic:
+        update_parts = cfg.get_optional_int("oryx.update-topic.message.partitions") or 1
+        max_size = cfg.get_optional_int("oryx.update-topic.message.max-size")
+        bus.maybe_create_topic(
+            update_broker, update_topic, update_parts,
+            {"max-size": max_size} if max_size else None,
+        )
+        print(f"created (or found) update topic {update_topic} "
+              f"({update_parts} partitions) on {update_broker}")
+
+
+def run_bus_tail(cfg: Config, from_beginning: bool = False, out=None, stop_after: int | None = None) -> None:
+    """kafka-tail analogue: follow input + update topics, one line per
+    message as '<topic>\t<key>\t<message>'."""
+    from oryx_tpu.bus.core import get_broker
+
+    out = out or sys.stdout
+    pairs = [(cfg.get_string("oryx.input-topic.broker"),
+              cfg.get_string("oryx.input-topic.message.topic"))]
+    ub = cfg.get_optional_string("oryx.update-topic.broker")
+    ut = cfg.get_optional_string("oryx.update-topic.message.topic")
+    if ub and ut:
+        pairs.append((ub, ut))
+    consumers = [
+        (topic, get_broker(loc).consumer(topic, from_beginning=from_beginning))
+        for loc, topic in pairs
+    ]
+    printed = 0
+    try:
+        while True:
+            idle = True
+            for topic, consumer in consumers:
+                for rec in consumer.poll(timeout=0.2):
+                    print(f"{topic}\t{rec.key}\t{rec.message}", file=out)
+                    idle = False
+                    printed += 1
+                    if stop_after is not None and printed >= stop_after:
+                        return
+            if idle:
+                out.flush()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        for _, consumer in consumers:
+            consumer.close()
+
+
+def run_bus_input(cfg: Config, input_file: str | None) -> int:
+    """kafka-input analogue: push lines to the input topic, keyed by a hex
+    hash of the line so they spread over partitions (the serving layer's
+    sendInput idiom, AbstractOryxResource.java:65-69)."""
+    from oryx_tpu.bus.core import get_broker
+
+    broker = get_broker(cfg.get_string("oryx.input-topic.broker"))
+    topic = cfg.get_string("oryx.input-topic.message.topic")
+    parts = cfg.get_optional_int("oryx.input-topic.message.partitions") or 1
+    broker.create_topic(topic, parts)
+
+    if input_file:
+        if not os.path.exists(input_file):
+            raise SystemExit(f"Input file {input_file} does not exist")
+        f = open(input_file, "r", encoding="utf-8")
+    else:
+        f = sys.stdin
+    sent = 0
+    try:
+        with broker.producer(topic) as producer:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                key = hashlib.md5(line.encode("utf-8")).hexdigest()
+                producer.send(key, line)
+                sent += 1
+    finally:
+        if f is not sys.stdin:
+            f.close()
+    print(f"sent {sent} messages to {topic}")
+    return sent
+
+
+def run_config_dump(cfg: Config, out=None) -> None:
+    """ConfigToProperties analogue: dump the resolved oryx.* tree as
+    key=value lines for shell consumption (used at oryx-run.sh:87)."""
+    out = out or sys.stdout
+    props = cfg.get_config("oryx").to_properties(prefix="oryx")
+    for key in sorted(props):
+        print(f"{key}={props[key]}", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)-5s %(name)s: %(message)s",
+    )
+    for d in args.app_dir:
+        sys.path.insert(0, os.path.abspath(d))
+
+    cfg = load_config(args.conf, args.set)
+
+    if args.command == "batch":
+        run_batch(cfg)
+    elif args.command == "speed":
+        run_speed(cfg)
+    elif args.command == "serving":
+        run_serving(cfg)
+    elif args.command == "bus-setup":
+        run_bus_setup(cfg)
+    elif args.command == "bus-tail":
+        run_bus_tail(cfg, from_beginning=args.from_beginning)
+    elif args.command == "bus-input":
+        run_bus_input(cfg, args.input_file)
+    elif args.command == "config":
+        run_config_dump(cfg)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -1,0 +1,167 @@
+"""CLI launcher tests (oryx-run.sh analogue, oryx_tpu/cli.py)."""
+
+import io
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from oryx_tpu import cli
+from oryx_tpu.common import config as config_utils
+
+
+@pytest.fixture(autouse=True)
+def _clear_oryx_conf(monkeypatch):
+    monkeypatch.delenv("ORYX_CONF", raising=False)
+
+
+def _write_conf(tmp_path, extra: str = "") -> str:
+    bus = f"file:{tmp_path}/bus"
+    conf = tmp_path / "oryx.conf"
+    conf.write_text(
+        f"""
+        oryx {{
+          id = "CLITest"
+          input-topic.broker = "{bus}"
+          update-topic.broker = "{bus}"
+          {extra}
+        }}
+        """
+    )
+    return str(conf)
+
+
+def test_load_config_layers_file_and_sets(tmp_path):
+    conf = _write_conf(tmp_path)
+    cfg = cli.load_config(conf, ["oryx.serving.api.port=9191"])
+    assert cfg.get_string("oryx.id") == "CLITest"
+    assert cfg.get_int("oryx.serving.api.port") == 9191
+    # packaged defaults still visible underneath
+    assert cfg.get_int("oryx.update-topic.message.max-size") == 16777216
+
+
+def test_load_config_missing_file_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        cli.load_config(str(tmp_path / "nope.conf"), [])
+
+
+def test_bad_set_errors(tmp_path):
+    conf = _write_conf(tmp_path)
+    with pytest.raises(SystemExit):
+        cli.load_config(conf, ["oryx.no-equals-sign"])
+
+
+def test_bus_setup_creates_topics(tmp_path, capsys):
+    conf = _write_conf(tmp_path)
+    cfg = cli.load_config(conf, [])
+    cli.run_bus_setup(cfg)
+    out = capsys.readouterr().out
+    assert "OryxInput" in out and "OryxUpdate" in out
+
+    from oryx_tpu.bus.core import get_broker
+
+    broker = get_broker(cfg.get_string("oryx.input-topic.broker"))
+    assert broker.topic_exists("OryxInput")
+    assert broker.topic_exists("OryxUpdate")
+
+
+def test_bus_input_and_tail_roundtrip(tmp_path):
+    conf = _write_conf(tmp_path)
+    cfg = cli.load_config(conf, [])
+    data = tmp_path / "in.csv"
+    data.write_text("u1,i1,1\nu2,i2,2\n\nu3,i3,3\n")
+    sent = cli.run_bus_input(cfg, str(data))
+    assert sent == 3
+
+    out = io.StringIO()
+    cli.run_bus_tail(cfg, from_beginning=True, out=out, stop_after=3)
+    lines = [l for l in out.getvalue().splitlines() if l]
+    assert len(lines) == 3
+    assert all(l.startswith("OryxInput\t") for l in lines)
+    # keys spread lines over partitions, so compare as a set
+    assert {l.rsplit("\t", 1)[1] for l in lines} == {"u1,i1,1", "u2,i2,2", "u3,i3,3"}
+
+
+def test_config_dump_properties(tmp_path, capsys):
+    conf = _write_conf(tmp_path)
+    cfg = cli.load_config(conf, [])
+    cli.run_config_dump(cfg)
+    out = capsys.readouterr().out
+    assert "oryx.id=CLITest" in out
+    assert "oryx.update-topic.message.max-size=16777216" in out
+
+
+def test_serving_via_cli_main(tmp_path):
+    """`python -m oryx_tpu serving` end-to-end: starts, answers, shuts down."""
+    conf = _write_conf(
+        tmp_path,
+        extra="""
+          serving {
+            model-manager-class = "oryx_tpu.example.serving:ExampleServingModelManager"
+            application-resources = "oryx_tpu.example.serving"
+            api.port = 0
+          }
+        """,
+    )
+    from oryx_tpu.bus.core import get_broker
+    from oryx_tpu.serving.layer import ServingLayer
+
+    cfg = cli.load_config(conf, [])
+    # seed a model so /ready can flip to 200 once consumed
+    broker = get_broker(cfg.get_string("oryx.update-topic.broker"))
+    broker.create_topic("OryxUpdate", 1)
+    with broker.producer("OryxUpdate") as p:
+        p.send("UP", "hello,3")
+
+    layer = ServingLayer(cfg)
+    t = threading.Thread(target=lambda: (layer.start(), layer.await_termination()), daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while layer.port == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert layer.port != 0
+
+    status = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{layer.port}/ready") as resp:
+                status = resp.status
+                break
+        except urllib.error.HTTPError as e:
+            status = e.code  # 503 until the seeded update is consumed
+            time.sleep(0.1)
+    assert status == 200
+    layer.close()
+    t.join(timeout=5)
+
+
+@pytest.mark.parametrize(
+    "conf_file",
+    [
+        "conf/als-example.conf",
+        "conf/kmeans-example.conf",
+        "conf/rdf-example.conf",
+        "conf/wordcount-example.conf",
+    ],
+)
+def test_example_confs_parse_and_name_real_classes(conf_file, monkeypatch):
+    """Every shipped example conf must parse against packaged defaults and
+    name importable update/manager classes (als-example.conf parity)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("ORYX_CONF", os.path.join(repo_root, conf_file))
+    cfg = config_utils.get_default()
+
+    from oryx_tpu.common.lang import load_class
+
+    for key in (
+        "oryx.batch.update-class",
+        "oryx.speed.model-manager-class",
+        "oryx.serving.model-manager-class",
+    ):
+        name = cfg.get_optional_string(key)
+        assert name, f"{conf_file}: {key} unset"
+        assert load_class(name) is not None
+    assert cfg.get_optional_strings("oryx.serving.application-resources")
+    assert cfg.get_string("oryx.input-topic.broker").startswith("file:")
