@@ -371,7 +371,7 @@ def popular_representative_items(ctx: ServingContext, req: Request):
     picks one item per LSH partition; random projections give the same
     'spread across item space' without LSH state)."""
     model = _model(ctx)
-    ids, _, uploaded = model._ensure_y_matrix()
+    ids, _, uploaded, _y_host, _parts = model._ensure_y_matrix()
     if not ids:
         return []
     from oryx_tpu.common import rng as rng_mod

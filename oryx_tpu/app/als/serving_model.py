@@ -29,23 +29,39 @@ import numpy as np
 
 from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
 from oryx_tpu.app import pmml as app_pmml
-from oryx_tpu.app.als.common import FeatureVectors
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import ReadWriteLock
 from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
+from oryx_tpu.native.store import make_feature_vectors
 from oryx_tpu.ops import topn as topn_ops
 
 log = logging.getLogger(__name__)
 
 
 class ALSServingModel(ServingModel):
-    def __init__(self, features: int, implicit: bool, refresh_sec: float = 0.2) -> None:
+    def __init__(
+        self,
+        features: int,
+        implicit: bool,
+        refresh_sec: float = 0.2,
+        sample_rate: float = 1.0,
+    ) -> None:
         self.features = features
         self.implicit = implicit
-        self.x = FeatureVectors()
-        self.y = FeatureVectors()
+        # LSH candidate pruning is opt-in (sample-rate < 1): the exact
+        # device matvec is the TPU fast path, LSH the CPU-parity fallback
+        # (ALSServingModel.java:58-124 partitions Y this way always)
+        self.lsh = None
+        if sample_rate < 1.0:
+            import os
+
+            from oryx_tpu.app.als.lsh import LocalitySensitiveHash
+
+            self.lsh = LocalitySensitiveHash(sample_rate, features, os.cpu_count() or 1)
+        self.x = make_feature_vectors()
+        self.y = make_feature_vectors()
         self._known_lock = ReadWriteLock()
         self._known_items: dict[str, set[str]] = {}
         self._expected_users: set[str] = set()
@@ -60,6 +76,8 @@ class ALSServingModel(ServingModel):
         self._y_ids: list[str] = []
         self._y_index: dict[str, int] = {}
         self._y_matrix = None  # device array [n, k]
+        self._y_host: np.ndarray | None = None  # host copy, LSH path only
+        self._y_partitions: np.ndarray | None = None  # LSH partition per row
 
     # -- vectors -------------------------------------------------------------
 
@@ -160,9 +178,23 @@ class ALSServingModel(ServingModel):
                 self._y_ids = ids
                 self._y_index = {id_: i for i, id_ in enumerate(ids)}
                 self._y_matrix = topn_ops.upload(mat) if len(ids) else None
+                if self.lsh is not None:
+                    self._y_host = mat
+                    self._y_partitions = (
+                        self.lsh.partitions_for(mat) if len(ids) else None
+                    )
                 self._y_dirty = False
                 self._y_built_at = now
-            return self._y_ids, self._y_index, self._y_matrix
+            # host/partition arrays are returned under the lock so one
+            # request sees one consistent (ids, matrix, partitions) snapshot
+            # even if a rebuild swaps them mid-flight
+            return (
+                self._y_ids,
+                self._y_index,
+                self._y_matrix,
+                self._y_host,
+                self._y_partitions,
+            )
 
     def top_n(
         self,
@@ -175,9 +207,19 @@ class ALSServingModel(ServingModel):
         """Top-N items by dot (or cosine) score against `query`: one
         batched device matvec + top_k, replacing the reference's
         LSH-partitioned thread-pool scan (ALSServingModel.topN:289-335)."""
-        ids, index, y_mat = self._ensure_y_matrix()
+        ids, index, y_mat, y_host, y_partitions = self._ensure_y_matrix()
         if y_mat is None:
             return []
+        # LSH pruning (sample-rate < 1): only rows whose partition falls in
+        # the query's Hamming ball are scored, on host (the approximate
+        # CPU-parity path; exact device scan otherwise)
+        lsh_rows: np.ndarray | None = None
+        if self.lsh is not None and y_partitions is not None:
+            cand = self.lsh.candidate_indices(query)
+            lsh_rows = np.flatnonzero(np.isin(y_partitions, cand))
+            if len(lsh_rows) == 0:
+                lsh_rows = None  # degenerate: fall back to the exact scan
+        num_candidates = len(lsh_rows) if lsh_rows is not None else len(ids)
         exclude = exclude or set()
         margin = how_many + len(exclude)
         if rescorer is not None:
@@ -186,8 +228,11 @@ class ALSServingModel(ServingModel):
         # every item has been considered (the reference streams all items,
         # ALSServingModel.topN:289-335, so filters can never starve results)
         while True:
-            k = min(margin, len(ids))
-            idx, scores = topn_ops.top_k_scores(y_mat, query, k, cosine=cosine)
+            k = min(margin, num_candidates)
+            if lsh_rows is not None:
+                idx, scores = _host_top_k(y_host, lsh_rows, query, k, cosine=cosine)
+            else:
+                idx, scores = topn_ops.top_k_scores(y_mat, query, k, cosine=cosine)
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
                 id_ = ids[int(i)]
@@ -203,7 +248,7 @@ class ALSServingModel(ServingModel):
                 out.append((id_, score))
                 if len(out) == how_many and rescorer is None:
                     break
-            if len(out) >= how_many or k >= len(ids):
+            if len(out) >= how_many or k >= num_candidates:
                 break
             margin = margin * 4
         if rescorer is not None:
@@ -218,6 +263,28 @@ class ALSServingModel(ServingModel):
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"ALSServingModel[features={self.features}, X={self.x.size()}, Y={self.y.size()}]"
+
+
+def _host_top_k(
+    y_host: np.ndarray,
+    rows: np.ndarray,
+    query: np.ndarray,
+    k: int,
+    cosine: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Partial top-k over an LSH-pruned row subset, on host: the scored
+    candidate set is already ~sample-rate of the items, so numpy argpartition
+    beats a device round-trip at these sizes."""
+    sub = y_host[rows]
+    scores = sub @ np.asarray(query, dtype=np.float32)
+    if cosine:
+        qn = float(np.linalg.norm(query))
+        norms = np.linalg.norm(sub, axis=1)
+        scores = scores / np.maximum(norms * qn, 1e-12)
+    k = max(1, min(int(k), len(rows)))
+    part = np.argpartition(-scores, k - 1)[:k]
+    order = part[np.argsort(-scores[part])]
+    return rows[order], scores[order]
 
 
 class ALSServingModelManager(AbstractServingModelManager):
@@ -263,7 +330,9 @@ class ALSServingModelManager(AbstractServingModelManager):
                     or self.model.features != features
                     or self.model.implicit != implicit
                 ):
-                    self.model = ALSServingModel(features, implicit)
+                    self.model = ALSServingModel(
+                        features, implicit, sample_rate=self.sample_rate
+                    )
                     self.model.set_expected(x_ids, y_ids)
                 else:
                     self.model.retain_recent_and_user_ids(x_ids)

@@ -21,11 +21,12 @@ import numpy as np
 from oryx_tpu.api.speed import SpeedModel, SpeedModelManager
 from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
-from oryx_tpu.app.als.common import FeatureVectors, compute_updated_xu
+from oryx_tpu.app.als.common import compute_updated_xu
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.text import join_json, read_json
 from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
+from oryx_tpu.native.store import make_feature_vectors
 
 log = logging.getLogger(__name__)
 
@@ -40,8 +41,8 @@ class ALSSpeedModel(SpeedModel):
     ) -> None:
         self.features = features
         self.implicit = implicit
-        self.x = FeatureVectors()
-        self.y = FeatureVectors()
+        self.x = make_feature_vectors()
+        self.y = make_feature_vectors()
         self._expected_users = set(expected_user_ids)
         self._expected_items = set(expected_item_ids)
         self._solver_lock = threading.Lock()

@@ -1,0 +1,106 @@
+"""Native (C++) components: build-on-demand via g++, bound with ctypes.
+
+The reference outsources its hot CPU paths to the JVM's concurrent
+collections; here the serving/speed vector store is real C++ (SURVEY.md:
+"the serving layer's concurrent hash-partitioned vector store gets a C++
+implementation bound into Python, not a Python stand-in"). The shared
+library is compiled once into this package's _build/ directory and reused;
+set ORYX_NATIVE=0 to force the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["feature_store.cpp"]
+_LOCK = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def native_enabled() -> bool:
+    return os.environ.get("ORYX_NATIVE", "1") != "0"
+
+
+def _build_library() -> str | None:
+    """Compile the native sources to one .so, keyed by source hash so edits
+    rebuild and repeat imports reuse."""
+    h = hashlib.sha256()
+    paths = [os.path.join(_HERE, s) for s in _SOURCES]
+    for path in paths:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    build_dir = os.path.join(_HERE, "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"liboryx_native_{h.hexdigest()[:16]}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        "-o", so_path, *paths, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"")
+        log.warning(
+            "native build failed (%s); falling back to pure Python: %s",
+            e, (err or b"").decode("utf-8", "replace")[:500],
+        )
+        return None
+    return so_path
+
+
+def get_library() -> ctypes.CDLL | None:
+    """The loaded native library, or None (disabled or build failure —
+    callers fall back to Python implementations)."""
+    global _lib, _lib_failed
+    if not native_enabled():
+        return None
+    with _LOCK:
+        if _lib is not None or _lib_failed:
+            return _lib
+        so_path = _build_library()
+        if so_path is None:
+            _lib_failed = True
+            return None
+        lib = ctypes.CDLL(so_path)
+        _declare(lib)
+        _lib = lib
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    lib.fs_create.restype = c.c_void_p
+    lib.fs_create.argtypes = [c.c_int64, c.c_int64]
+    lib.fs_destroy.argtypes = [c.c_void_p]
+    lib.fs_dim.restype = c.c_int64
+    lib.fs_dim.argtypes = [c.c_void_p]
+    lib.fs_set.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.POINTER(c.c_float)]
+    lib.fs_get.restype = c.c_int
+    lib.fs_get.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.POINTER(c.c_float)]
+    lib.fs_remove.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.fs_size.restype = c.c_int64
+    lib.fs_size.argtypes = [c.c_void_p]
+    lib.fs_recent_count.restype = c.c_int64
+    lib.fs_recent_count.argtypes = [c.c_void_p]
+    lib.fs_pack.restype = c.c_int64
+    lib.fs_pack.argtypes = [
+        c.c_void_p, c.POINTER(c.c_float), c.c_int64, c.c_char_p, c.c_int64,
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int,
+    ]
+    lib.fs_ids.restype = c.c_int64
+    lib.fs_ids.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int64, c.POINTER(c.c_int64), c.c_int,
+    ]
+    lib.fs_vtv.argtypes = [c.c_void_p, c.POINTER(c.c_double)]
+    lib.fs_retain.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]

@@ -1,0 +1,300 @@
+// Concurrent hash-partitioned ID -> float32-vector store.
+//
+// The native serving/speed-layer hot-path state: the C++ counterpart of the
+// reference's FeatureVectors (app/oryx-app-common/.../als/FeatureVectors
+// .java:36-161 — a ConcurrentHashMap guarded by an AutoReadWriteLock) and of
+// the hash-partitioned vector store inside ALSServingModel.java:58-124.
+// Per SURVEY.md: "any remaining CPU-side hot path that genuinely needs it
+// (e.g. the serving layer's concurrent hash-partitioned vector store) gets a
+// C++ implementation bound into Python". Vectors live in per-shard
+// contiguous slabs so packing a snapshot for device upload is a straight
+// memcpy sweep, and readers take per-shard shared locks so lookups/scans run
+// in parallel with writes to other shards (ctypes releases the GIL around
+// every call).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Shard {
+  mutable std::shared_mutex mu;
+  std::unordered_map<std::string, int64_t> index;  // id -> slot
+  std::vector<std::string> slot_ids;               // slot -> id ("" = free)
+  std::vector<float> slab;                         // slot-major vector data
+  std::vector<int64_t> free_slots;
+  std::unordered_set<std::string> recent;
+};
+
+struct Store {
+  int64_t dim;
+  int64_t num_shards;
+  std::vector<Shard> shards;
+
+  Shard& shard_for(const std::string& id) {
+    return shards[std::hash<std::string>{}(id) % num_shards];
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fs_create(int64_t dim, int64_t num_shards) {
+  if (dim <= 0 || num_shards <= 0) return nullptr;
+  auto* s = new Store();
+  s->dim = dim;
+  s->num_shards = num_shards;
+  s->shards = std::vector<Shard>(num_shards);
+  return s;
+}
+
+void fs_destroy(void* p) { delete static_cast<Store*>(p); }
+
+int64_t fs_dim(void* p) { return static_cast<Store*>(p)->dim; }
+
+void fs_set(void* p, const char* id, int64_t id_len, const float* vec) {
+  auto* s = static_cast<Store*>(p);
+  std::string key(id, id_len);
+  Shard& sh = s->shard_for(key);
+  std::unique_lock lock(sh.mu);
+  auto it = sh.index.find(key);
+  int64_t slot;
+  if (it != sh.index.end()) {
+    slot = it->second;
+  } else if (!sh.free_slots.empty()) {
+    slot = sh.free_slots.back();
+    sh.free_slots.pop_back();
+    sh.slot_ids[slot] = key;
+    sh.index.emplace(key, slot);
+  } else {
+    slot = static_cast<int64_t>(sh.slot_ids.size());
+    sh.slot_ids.push_back(key);
+    sh.slab.resize(sh.slab.size() + s->dim);
+    sh.index.emplace(key, slot);
+  }
+  std::memcpy(sh.slab.data() + slot * s->dim, vec, s->dim * sizeof(float));
+  sh.recent.insert(key);
+}
+
+int fs_get(void* p, const char* id, int64_t id_len, float* out) {
+  auto* s = static_cast<Store*>(p);
+  std::string key(id, id_len);
+  Shard& sh = s->shard_for(key);
+  std::shared_lock lock(sh.mu);
+  auto it = sh.index.find(key);
+  if (it == sh.index.end()) return 0;
+  std::memcpy(out, sh.slab.data() + it->second * s->dim, s->dim * sizeof(float));
+  return 1;
+}
+
+void fs_remove(void* p, const char* id, int64_t id_len) {
+  auto* s = static_cast<Store*>(p);
+  std::string key(id, id_len);
+  Shard& sh = s->shard_for(key);
+  std::unique_lock lock(sh.mu);
+  auto it = sh.index.find(key);
+  if (it == sh.index.end()) {
+    sh.recent.erase(key);
+    return;
+  }
+  int64_t slot = it->second;
+  sh.index.erase(it);
+  sh.slot_ids[slot].clear();
+  sh.free_slots.push_back(slot);
+  sh.recent.erase(key);
+}
+
+int64_t fs_size(void* p) {
+  auto* s = static_cast<Store*>(p);
+  int64_t n = 0;
+  for (auto& sh : s->shards) {
+    std::shared_lock lock(sh.mu);
+    n += static_cast<int64_t>(sh.index.size());
+  }
+  return n;
+}
+
+int64_t fs_recent_count(void* p) {
+  auto* s = static_cast<Store*>(p);
+  int64_t n = 0;
+  for (auto& sh : s->shards) {
+    std::shared_lock lock(sh.mu);
+    n += static_cast<int64_t>(sh.recent.size());
+  }
+  return n;
+}
+
+// IDs cross the ABI as a length-prefixed stream: [u32 len][bytes]... — ids
+// are arbitrary strings off the wire (JSON), so a newline/NUL-delimited
+// protocol would corrupt the id<->row mapping for ids containing the
+// delimiter.
+static char* write_id(char* out, const std::string& id) {
+  uint32_t len = static_cast<uint32_t>(id.size());
+  std::memcpy(out, &len, sizeof(len));
+  out += sizeof(len);
+  std::memcpy(out, id.data(), id.size());
+  return out + id.size();
+}
+
+static int64_t id_stream_size(const std::string& id) {
+  return static_cast<int64_t>(sizeof(uint32_t) + id.size());
+}
+
+// Pack a consistent snapshot: all shard locks are held (shared) for the
+// duration. Returns n on success, -1 when a buffer is too small (caller
+// re-sizes from *mat_needed / *ids_needed and retries), with the needed
+// capacities always reported.
+int64_t fs_pack(void* p, float* mat_out, int64_t mat_cap, char* ids_out,
+                int64_t ids_cap, int64_t* mat_needed, int64_t* ids_needed,
+                int recent_only) {
+  auto* s = static_cast<Store*>(p);
+  std::vector<std::shared_lock<std::shared_mutex>> locks;
+  locks.reserve(s->shards.size());
+  for (auto& sh : s->shards) locks.emplace_back(sh.mu);
+
+  int64_t n = 0, ids_len = 0;
+  for (auto& sh : s->shards) {
+    if (recent_only) {
+      for (const auto& id : sh.recent) {
+        if (sh.index.count(id)) {
+          n++;
+          ids_len += id_stream_size(id);
+        }
+      }
+    } else {
+      n += static_cast<int64_t>(sh.index.size());
+      for (const auto& kv : sh.index) ids_len += id_stream_size(kv.first);
+    }
+  }
+  *mat_needed = n * s->dim;
+  *ids_needed = ids_len;
+  if (n * s->dim > mat_cap || ids_len > ids_cap) return -1;
+
+  int64_t row = 0;
+  char* idp = ids_out;
+  for (auto& sh : s->shards) {
+    if (recent_only) {
+      for (const auto& id : sh.recent) {
+        auto it = sh.index.find(id);
+        if (it == sh.index.end()) continue;
+        std::memcpy(mat_out + row * s->dim, sh.slab.data() + it->second * s->dim,
+                    s->dim * sizeof(float));
+        idp = write_id(idp, id);
+        row++;
+      }
+    } else {
+      for (const auto& kv : sh.index) {
+        std::memcpy(mat_out + row * s->dim, sh.slab.data() + kv.second * s->dim,
+                    s->dim * sizeof(float));
+        idp = write_id(idp, kv.first);
+        row++;
+      }
+    }
+  }
+  return row;
+}
+
+// IDs only, without copying vector data (the /user/allIDs-style calls and
+// rotation bookkeeping need just the key set).
+int64_t fs_ids(void* p, char* ids_out, int64_t ids_cap, int64_t* ids_needed,
+               int recent_only) {
+  auto* s = static_cast<Store*>(p);
+  std::vector<std::shared_lock<std::shared_mutex>> locks;
+  locks.reserve(s->shards.size());
+  for (auto& sh : s->shards) locks.emplace_back(sh.mu);
+
+  int64_t n = 0, ids_len = 0;
+  for (auto& sh : s->shards) {
+    if (recent_only) {
+      for (const auto& id : sh.recent) {
+        if (sh.index.count(id)) {
+          n++;
+          ids_len += id_stream_size(id);
+        }
+      }
+    } else {
+      n += static_cast<int64_t>(sh.index.size());
+      for (const auto& kv : sh.index) ids_len += id_stream_size(kv.first);
+    }
+  }
+  *ids_needed = ids_len;
+  if (ids_len > ids_cap) return -1;
+
+  char* idp = ids_out;
+  for (auto& sh : s->shards) {
+    if (recent_only) {
+      for (const auto& id : sh.recent) {
+        if (sh.index.count(id)) idp = write_id(idp, id);
+      }
+    } else {
+      for (const auto& kv : sh.index) idp = write_id(idp, kv.first);
+    }
+  }
+  return n;
+}
+
+// V^T V over all vectors, accumulated in double (FeatureVectors.getVTV).
+void fs_vtv(void* p, double* out) {
+  auto* s = static_cast<Store*>(p);
+  const int64_t k = s->dim;
+  std::memset(out, 0, k * k * sizeof(double));
+  for (auto& sh : s->shards) {
+    std::shared_lock lock(sh.mu);
+    for (const auto& kv : sh.index) {
+      const float* v = sh.slab.data() + kv.second * k;
+      for (int64_t i = 0; i < k; i++) {
+        const double vi = v[i];
+        double* row = out + i * k;
+        for (int64_t j = i; j < k; j++) row[j] += vi * v[j];
+      }
+    }
+  }
+  for (int64_t i = 0; i < k; i++)
+    for (int64_t j = 0; j < i; j++) out[i * k + j] = out[j * k + i];
+}
+
+// Rotation reconciliation (FeatureVectors.retainRecentAndIDs:131-136): keep
+// ids present in the new model (length-prefixed `keep` stream) OR written
+// since the last rotation, then reset recency.
+void fs_retain(void* p, const char* keep, int64_t keep_len) {
+  auto* s = static_cast<Store*>(p);
+  std::unordered_set<std::string> keep_set;
+  const char* q = keep;
+  const char* end = keep + keep_len;
+  while (q + sizeof(uint32_t) <= end) {
+    uint32_t len;
+    std::memcpy(&len, q, sizeof(len));
+    q += sizeof(len);
+    if (q + len > end) break;  // truncated stream: ignore the tail
+    keep_set.emplace(q, len);
+    q += len;
+  }
+  for (auto& sh : s->shards) {
+    std::unique_lock lock(sh.mu);
+    std::vector<std::string> drop;
+    for (const auto& kv : sh.index) {
+      if (!keep_set.count(kv.first) && !sh.recent.count(kv.first)) {
+        drop.push_back(kv.first);
+      }
+    }
+    for (const auto& id : drop) {
+      auto it = sh.index.find(id);
+      sh.slot_ids[it->second].clear();
+      sh.free_slots.push_back(it->second);
+      sh.index.erase(it);
+    }
+    sh.recent.clear();
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,182 @@
+"""ctypes wrapper for the C++ concurrent feature-vector store.
+
+API-compatible with the pure-Python FeatureVectors
+(oryx_tpu.app.als.common) — same method surface, same rotation semantics
+(FeatureVectors.java:36-161). The native store fixes the vector dimension
+on first write; ctypes releases the GIL for every call, so concurrent
+readers/writers on different shards genuinely run in parallel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+import threading
+from typing import Callable, Iterable
+
+import numpy as np
+
+from oryx_tpu.native import get_library
+
+
+def _decode_ids(buf: bytes) -> list[str]:
+    """Parse the length-prefixed id stream ([u32 len][bytes]...)."""
+    ids = []
+    pos = 0
+    end = len(buf)
+    while pos + 4 <= end:
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        ids.append(buf[pos : pos + n].decode("utf-8"))
+        pos += n
+    return ids
+
+
+def _encode_ids(ids: Iterable[str]) -> bytes:
+    out = bytearray()
+    for id_ in ids:
+        b = id_.encode("utf-8")
+        out += struct.pack("<I", len(b))
+        out += b
+    return bytes(out)
+
+
+class NativeFeatureVectors:
+    """Drop-in FeatureVectors backed by the C++ store."""
+
+    def __init__(self, num_shards: int = 16) -> None:
+        self._lib = get_library()
+        if self._lib is None:  # pragma: no cover - build always works in CI
+            raise RuntimeError("native library unavailable")
+        self._num_shards = num_shards
+        self._ptr = None
+        self._dim: int | None = None
+        self._init_lock = threading.Lock()
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        ptr, self._ptr = self._ptr, None
+        if ptr and self._lib is not None:
+            self._lib.fs_destroy(ptr)
+
+    def _ensure(self, dim: int):
+        with self._init_lock:
+            if self._ptr is None:
+                self._ptr = self._lib.fs_create(dim, self._num_shards)
+                self._dim = dim
+            elif dim != self._dim:
+                raise ValueError(f"vector dim {dim} != store dim {self._dim}")
+        return self._ptr
+
+    # -- FeatureVectors API --------------------------------------------------
+
+    def size(self) -> int:
+        if self._ptr is None:
+            return 0
+        return int(self._lib.fs_size(self._ptr))
+
+    def set_vector(self, id_: str, vector: np.ndarray) -> None:
+        vec = np.ascontiguousarray(vector, dtype=np.float32)
+        ptr = self._ensure(vec.shape[0])
+        key = id_.encode("utf-8")
+        self._lib.fs_set(
+            ptr, key, len(key), vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        )
+
+    def get_vector(self, id_: str) -> np.ndarray | None:
+        if self._ptr is None:
+            return None
+        out = np.empty(self._dim, dtype=np.float32)
+        key = id_.encode("utf-8")
+        found = self._lib.fs_get(
+            self._ptr, key, len(key), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        )
+        return out if found else None
+
+    def remove_vector(self, id_: str) -> None:
+        if self._ptr is not None:
+            key = id_.encode("utf-8")
+            self._lib.fs_remove(self._ptr, key, len(key))
+
+    def _pack(self, recent_only: bool = False) -> tuple[list[str], np.ndarray]:
+        if self._ptr is None:
+            return [], np.zeros((0, 0), dtype=np.float32)
+        mat_cap = max(1, self.size() + 64) * self._dim
+        ids_cap = max(1024, (self.size() + 64) * 64)
+        while True:
+            mat = np.empty(mat_cap, dtype=np.float32)
+            ids_buf = ctypes.create_string_buffer(ids_cap)
+            mat_needed = ctypes.c_int64()
+            ids_needed = ctypes.c_int64()
+            n = self._lib.fs_pack(
+                self._ptr,
+                mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                mat_cap,
+                ids_buf,
+                ids_cap,
+                ctypes.byref(mat_needed),
+                ctypes.byref(ids_needed),
+                1 if recent_only else 0,
+            )
+            if n >= 0:
+                ids = _decode_ids(ids_buf.raw[: ids_needed.value])
+                return ids, mat[: n * self._dim].reshape(n, self._dim).copy()
+            mat_cap = max(mat_needed.value, self._dim)
+            ids_cap = max(ids_needed.value, 1024)
+
+    def _pack_ids(self, recent_only: bool = False) -> list[str]:
+        """IDs without copying vector data (fs_ids)."""
+        if self._ptr is None:
+            return []
+        ids_cap = max(4096, (self.size() + 64) * 64)
+        while True:
+            ids_buf = ctypes.create_string_buffer(ids_cap)
+            ids_needed = ctypes.c_int64()
+            n = self._lib.fs_ids(
+                self._ptr, ids_buf, ids_cap, ctypes.byref(ids_needed),
+                1 if recent_only else 0,
+            )
+            if n >= 0:
+                return _decode_ids(ids_buf.raw[: ids_needed.value])
+            ids_cap = max(ids_needed.value, 4096)
+
+    def to_matrix(self) -> tuple[list[str], np.ndarray]:
+        return self._pack()
+
+    def ids(self) -> list[str]:
+        return self._pack_ids()
+
+    def items(self) -> list[tuple[str, np.ndarray]]:
+        ids, mat = self._pack()
+        return [(i, mat[r]) for r, i in enumerate(ids)]
+
+    def for_each(self, fn: Callable[[str, np.ndarray], None]) -> None:
+        for id_, v in self.items():
+            fn(id_, v)
+
+    def add_all_ids_to(self, out: set[str]) -> None:
+        out.update(self._pack_ids())
+
+    def add_all_recent_to(self, out: set[str]) -> None:
+        out.update(self._pack_ids(recent_only=True))
+
+    def retain_recent_and_ids(self, new_model_ids: Iterable[str]) -> None:
+        if self._ptr is None:
+            return
+        stream = _encode_ids(new_model_ids)
+        self._lib.fs_retain(self._ptr, stream, len(stream))
+
+    def get_vtv(self) -> np.ndarray | None:
+        if self._ptr is None or self.size() == 0:
+            return None
+        out = np.zeros((self._dim, self._dim), dtype=np.float64)
+        self._lib.fs_vtv(self._ptr, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return out
+
+
+def make_feature_vectors(num_shards: int = 16):
+    """Native store when available, else the pure-Python FeatureVectors."""
+    if get_library() is not None:
+        return NativeFeatureVectors(num_shards)
+    from oryx_tpu.app.als.common import FeatureVectors
+
+    return FeatureVectors()

@@ -1,0 +1,134 @@
+"""LSH tests mirroring the reference's LocalitySensitiveHashTest
+(app/oryx-app-serving/src/test/.../als/model/LocalitySensitiveHashTest.java)."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.lsh import (
+    MAX_HASHES,
+    LocalitySensitiveHash,
+    choose_hashes_and_bits,
+)
+
+
+@pytest.mark.parametrize(
+    "sample_rate,num_cores,expected_hashes,expected_bits",
+    [
+        # testOneCore
+        (1.0, 1, 0, 0),
+        (0.5, 1, 1, 0),
+        (0.1, 1, 4, 0),
+        # testTwoCores
+        (1.0, 2, 1, 1),
+        (0.75, 3, 2, 1),
+        # testManyCores
+        (0.5, 3, 3, 1),
+        (0.1, 8, 7, 1),
+        (0.01, 8, 11, 1),
+        (0.001, 8, 14, 1),
+        (0.0001, 8, 16, 1),
+        (0.00001, 8, MAX_HASHES, 1),
+    ],
+)
+def test_hashes_and_bits(sample_rate, num_cores, expected_hashes, expected_bits):
+    h, b = choose_hashes_and_bits(sample_rate, num_cores)
+    assert h == expected_hashes
+    assert b == expected_bits
+
+
+def test_candidate_indices_no_sample():
+    """sample-rate 1.0, 8 cores: all partitions probed, in index order
+    (testCandidateIndicesNoSample)."""
+    lsh = LocalitySensitiveHash(1.0, 10, 8)
+    cands = lsh.candidate_indices(np.zeros(10, dtype=np.float32))
+    assert len(cands) == lsh.num_partitions
+    assert list(cands) == list(range(lsh.num_partitions))
+
+
+def test_candidate_indices_one_bit():
+    """(testCandidateIndicesOneBit)."""
+    lsh = LocalitySensitiveHash(0.1, 10, 8)
+    assert lsh.max_bits_differing == 1
+
+    zero_cands = lsh.candidate_indices(np.zeros(10, dtype=np.float32))
+    assert len(zero_cands) == 1 + lsh.num_hashes
+    assert zero_cands[0] == 0
+    for i in range(1, len(zero_cands)):
+        assert zero_cands[i] == 1 << (i - 1)
+
+    one_cands = lsh.candidate_indices(np.ones(10, dtype=np.float32))
+    for i in range(1, len(one_cands)):
+        assert one_cands[i] == one_cands[0] ^ (1 << (i - 1))
+
+
+def test_candidate_indices_three_bits():
+    """(testCandidateIndices): 7 hashes / 3 bits -> 1+7+21+35 = 64 probes,
+    each within Hamming distance 3 of the main index."""
+    lsh = LocalitySensitiveHash(0.5, 10, 32)
+    assert lsh.max_bits_differing == 3
+    assert lsh.num_hashes == 7
+
+    cands = lsh.candidate_indices(np.ones(10, dtype=np.float32))
+    assert len(cands) == 64
+    main = int(cands[0])
+    assert len(set(int(c) for c in cands)) == 64
+    for c in cands:
+        assert bin(int(c) ^ main).count("1") <= 3
+    # popcount-ordered prototype: first 1+7 are within 1 bit
+    for c in cands[1:8]:
+        assert bin(int(c) ^ main).count("1") == 1
+
+
+def test_hash_distribution_and_index_consistency():
+    """Partitioning spreads vectors and index_for matches partitions_for
+    (testHashDistribution analogue)."""
+    gen = np.random.default_rng(42)
+    for features, sample_rate, cores in [(40, 0.1, 8), (10, 0.1, 1), (200, 0.1, 16)]:
+        lsh = LocalitySensitiveHash(sample_rate, features, cores)
+        mat = gen.standard_normal((2000, features)).astype(np.float32)
+        parts = lsh.partitions_for(mat)
+        assert parts.min() >= 0 and parts.max() < lsh.num_partitions
+        for row in range(0, 2000, 371):
+            assert lsh.index_for(mat[row]) == parts[row]
+        if lsh.num_hashes >= 4:
+            # no partition should swallow a grossly disproportionate share
+            counts = np.bincount(parts, minlength=lsh.num_partitions)
+            assert counts.max() <= 20 * (2000 / lsh.num_partitions)
+
+
+def test_hash_vectors_roughly_orthogonal():
+    lsh = LocalitySensitiveHash(0.1, 32, 8)
+    H = lsh.hash_vectors
+    n = np.linalg.norm(H, axis=1)
+    cos = np.abs(H @ H.T) / np.outer(n, n)
+    off = cos[~np.eye(len(H), dtype=bool)]
+    assert off.max() < 0.5  # rejection sampling keeps |cos| small
+
+
+def test_serving_model_lsh_top_n_finds_aligned_items():
+    """ALSServingModel with sample-rate < 1: items strongly aligned with
+    the query share its sign pattern, so the Hamming ball must contain
+    them — planted best items are recovered through the pruned path."""
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    gen = np.random.default_rng(7)
+    features = 16
+    q = gen.standard_normal(features).astype(np.float32)
+
+    model = ALSServingModel(features, implicit=True, sample_rate=0.3)
+    assert model.lsh is not None
+    for i in range(500):
+        v = gen.standard_normal(features).astype(np.float32) * 0.2
+        model.set_item_vector(f"noise{i}", v)
+    for i in range(10):
+        v = (2.0 * q + 0.05 * gen.standard_normal(features)).astype(np.float32)
+        model.set_item_vector(f"best{i}", v)
+
+    got = model.top_n(q, 10)
+    assert len(got) == 10
+    assert {id_ for id_, _ in got} == {f"best{i}" for i in range(10)}
+    # and the pruned path actually pruned: candidate rows < all rows
+    rows = np.flatnonzero(
+        np.isin(model._y_partitions, model.lsh.candidate_indices(q))
+    )
+    assert 0 < len(rows) < 510

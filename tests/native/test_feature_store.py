@@ -1,0 +1,182 @@
+"""Native C++ feature store: parity with the Python FeatureVectors and
+concurrency behavior (reference FeatureVectorsTest semantics)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.common import FeatureVectors
+from oryx_tpu.native import get_library
+from oryx_tpu.native.store import NativeFeatureVectors, make_feature_vectors
+
+needs_native = pytest.mark.skipif(
+    get_library() is None, reason="native library unavailable"
+)
+
+
+@pytest.fixture(params=["python", "native"])
+def store(request):
+    if request.param == "python":
+        return FeatureVectors()
+    if get_library() is None:
+        pytest.skip("native library unavailable")
+    return NativeFeatureVectors()
+
+
+def test_set_get_remove_size(store):
+    assert store.size() == 0
+    assert store.get_vector("a") is None
+    store.set_vector("a", np.array([1.0, 0.5, -2.0], np.float32))
+    store.set_vector("b", np.array([0.0, 1.0, 3.0], np.float32))
+    assert store.size() == 2
+    np.testing.assert_array_equal(store.get_vector("a"), [1.0, 0.5, -2.0])
+    store.set_vector("a", np.array([9.0, 9.0, 9.0], np.float32))  # overwrite
+    assert store.size() == 2
+    np.testing.assert_array_equal(store.get_vector("a"), [9.0, 9.0, 9.0])
+    store.remove_vector("a")
+    assert store.size() == 1
+    assert store.get_vector("a") is None
+    store.remove_vector("never-there")  # no-op
+    assert store.size() == 1
+
+
+def test_to_matrix_and_ids_consistent(store):
+    vecs = {f"id{i}": np.arange(4, dtype=np.float32) + i for i in range(37)}
+    for k, v in vecs.items():
+        store.set_vector(k, v)
+    ids, mat = store.to_matrix()
+    assert sorted(ids) == sorted(vecs)
+    assert mat.shape == (37, 4)
+    for row, id_ in enumerate(ids):
+        np.testing.assert_array_equal(mat[row], vecs[id_])
+    assert sorted(store.ids()) == sorted(vecs)
+    got = dict(store.items())
+    assert set(got) == set(vecs)
+    np.testing.assert_array_equal(got["id3"], vecs["id3"])
+
+
+def test_vtv(store):
+    gen = np.random.default_rng(5)
+    mats = gen.standard_normal((50, 6)).astype(np.float32)
+    for i, v in enumerate(mats):
+        store.set_vector(f"v{i}", v)
+    vtv = store.get_vtv()
+    expect = mats.astype(np.float64).T @ mats.astype(np.float64)
+    np.testing.assert_allclose(vtv, expect, rtol=1e-5)
+
+
+def test_vtv_empty(store):
+    assert store.get_vtv() is None
+
+
+def test_retain_recent_and_ids(store):
+    """Rotation semantics (FeatureVectors.retainRecentAndIDs:131-136):
+    survivors = new-model ids + written-since-last-rotation, recency resets."""
+    store.set_vector("old1", np.ones(2, np.float32))
+    store.set_vector("old2", np.ones(2, np.float32))
+    store.retain_recent_and_ids({"old1", "old2"})  # resets recency
+    store.set_vector("fresh", np.ones(2, np.float32))
+    recent: set = set()
+    store.add_all_recent_to(recent)
+    assert recent == {"fresh"}
+    store.retain_recent_and_ids({"old1"})
+    assert sorted(store.ids()) == ["fresh", "old1"]
+    # recency has reset again: nothing recent survives an immediate rotation
+    store.retain_recent_and_ids(set())
+    assert store.ids() == []
+
+
+def test_add_all_ids_to(store):
+    store.set_vector("x", np.zeros(3, np.float32))
+    store.set_vector("y", np.zeros(3, np.float32))
+    out: set = set()
+    store.add_all_ids_to(out)
+    assert out == {"x", "y"}
+
+
+@needs_native
+def test_native_dim_mismatch_raises():
+    fv = NativeFeatureVectors()
+    fv.set_vector("a", np.zeros(3, np.float32))
+    with pytest.raises(ValueError):
+        fv.set_vector("b", np.zeros(4, np.float32))
+
+
+@needs_native
+def test_native_unicode_ids():
+    fv = NativeFeatureVectors()
+    fv.set_vector("ключ-λ", np.array([1.0, 2.0], np.float32))
+    np.testing.assert_array_equal(fv.get_vector("ключ-λ"), [1.0, 2.0])
+    assert fv.ids() == ["ключ-λ"]
+
+
+@needs_native
+def test_native_hostile_ids():
+    """IDs are arbitrary wire strings: newlines, NULs, and long ids must
+    round-trip through pack/ids/retain without corrupting the mapping."""
+    fv = NativeFeatureVectors()
+    hostile = ["a\nb", "c\x00d", "plain", "x" * 500, ""]
+    for i, id_ in enumerate(hostile):
+        fv.set_vector(id_, np.full(3, float(i), np.float32))
+    assert sorted(fv.ids()) == sorted(hostile)
+    ids, mat = fv.to_matrix()
+    assert len(ids) == mat.shape[0] == len(hostile)
+    for row, id_ in enumerate(ids):
+        assert mat[row][0] == float(hostile.index(id_))
+    fv.retain_recent_and_ids(set())  # everything recent -> all survive
+    fv.retain_recent_and_ids({"a\nb", "c\x00d"})
+    assert sorted(fv.ids()) == ["a\nb", "c\x00d"]
+
+
+@needs_native
+def test_native_concurrent_read_write():
+    """Hammer the store from writer + reader + packer threads; every read
+    must return either None or a complete, self-consistent vector."""
+    fv = NativeFeatureVectors(num_shards=8)
+    dim = 8
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def writer(tid: int):
+        gen = np.random.default_rng(tid)
+        i = 0
+        while not stop.is_set():
+            key = f"k{tid}-{i % 200}"
+            val = np.full(dim, float(i), np.float32)
+            fv.set_vector(key, val)
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            v = fv.get_vector("k0-7")
+            if v is not None and len(set(v.tolist())) != 1:
+                errors.append(f"torn read: {v}")
+
+    def packer():
+        while not stop.is_set():
+            ids, mat = fv.to_matrix()
+            if len(ids) != mat.shape[0]:
+                errors.append(f"inconsistent pack: {len(ids)} vs {mat.shape}")
+            fv.get_vtv()
+
+    threads = (
+        [threading.Thread(target=writer, args=(t,)) for t in range(2)]
+        + [threading.Thread(target=reader) for _ in range(2)]
+        + [threading.Thread(target=packer)]
+    )
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors, errors[:3]
+    assert fv.size() <= 400
+
+
+def test_make_feature_vectors_fallback(monkeypatch):
+    monkeypatch.setenv("ORYX_NATIVE", "0")
+    assert isinstance(make_feature_vectors(), FeatureVectors)
