@@ -1,0 +1,171 @@
+"""Step/request metrics: counters, gauges, latency histograms.
+
+The reference has no metrics registry at all — observability is the Spark
+web UI plus log lines every 10k updates (SURVEY.md §5: "Rebuild should
+exceed this (step metrics, eval metrics, serving QPS/latency
+histograms)"). This module is that exceedance: a small thread-safe
+registry the layers report into, exposed by the serving layer at
+/metrics as JSON.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry", "timed"]
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self._value: float | None = None
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> float | None:
+        return self._value
+
+    def snapshot(self) -> dict[str, Any]:
+        return {"type": "gauge", "value": self._value}
+
+
+class Histogram:
+    """Log-bucketed histogram for latencies/durations in seconds.
+
+    Buckets are powers of `base` from `start` (default: 1 µs up through
+    ~2 min); quantiles are estimated from bucket boundaries — plenty for
+    QPS/latency dashboards and assertions in tests.
+    """
+
+    def __init__(self, start: float = 1e-6, base: float = 2.0, count: int = 28) -> None:
+        self._lock = threading.Lock()
+        self._bounds = [start * base**i for i in range(count)]
+        self._buckets = [0] * (count + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = 0.0
+
+    def observe(self, value: float) -> None:
+        idx = 0
+        while idx < len(self._bounds) and value > self._bounds[idx]:
+            idx += 1
+        with self._lock:
+            self._buckets[idx] += 1
+            self._sum += value
+            self._count += 1
+            self._min = min(self._min, value)
+            self._max = max(self._max, value)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile observation."""
+        with self._lock:
+            if not self._count:
+                return 0.0
+            target = q * self._count
+            seen = 0
+            for i, c in enumerate(self._buckets):
+                seen += c
+                if seen >= target:
+                    return self._bounds[i] if i < len(self._bounds) else self._max
+            return self._max
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            if not self._count:
+                return {"type": "histogram", "count": 0}
+        return {
+            "type": "histogram",
+            "count": self._count,
+            "mean": self.mean,
+            "min": self._min,
+            "max": self._max,
+            "p50": self.quantile(0.50),
+            "p90": self.quantile(0.90),
+            "p99": self.quantile(0.99),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} is {type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in sorted(items)}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+
+registry = MetricsRegistry()
+"""Process-global default registry (each layer is its own process)."""
+
+
+class timed:
+    """Context manager observing elapsed seconds into a histogram:
+
+    with timed(registry.histogram("serving.request.seconds")): ...
+    """
+
+    def __init__(self, histogram: Histogram) -> None:
+        self._h = histogram
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._h.observe(time.perf_counter() - self._t0)
+        return False

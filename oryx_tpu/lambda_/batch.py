@@ -24,6 +24,7 @@ import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_ import data as data_store
@@ -88,6 +89,11 @@ class BatchLayer(AbstractLayer):
 
     def run_one_generation(self, timestamp_ms: int | None = None) -> None:
         """One full generation; callable directly for deterministic tests."""
+        with metrics.timed(metrics.registry.histogram("batch.generation.seconds")):
+            self._run_one_generation(timestamp_ms)
+        metrics.registry.counter("batch.generations").inc()
+
+    def _run_one_generation(self, timestamp_ms: int | None = None) -> None:
         if self._consumer is None:
             self._consumer = self.make_input_consumer()
         timestamp_ms = int(time.time() * 1000) if timestamp_ms is None else timestamp_ms

@@ -18,6 +18,7 @@ import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_.base import AbstractLayer, blocking_iterator
@@ -106,16 +107,19 @@ class SpeedLayer(AbstractLayer):
             new_data.extend(batch)
         if not new_data:
             return 0
-        updates = self.manager.build_updates(new_data)
-        ub = self.update_broker()
-        sent = 0
-        if ub is not None:
-            with ub.producer(self.update_topic) as producer:
-                for update in updates:
-                    # each delta goes out with key "UP" (SpeedLayerUpdate.java:58-60)
-                    producer.send("UP", update)
-                    sent += 1
-        if self.id:
-            self._input_consumer.commit()
+        with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
+            updates = self.manager.build_updates(new_data)
+            ub = self.update_broker()
+            sent = 0
+            if ub is not None:
+                with ub.producer(self.update_topic) as producer:
+                    for update in updates:
+                        # each delta goes out with key "UP" (SpeedLayerUpdate.java:58-60)
+                        producer.send("UP", update)
+                        sent += 1
+            if self.id:
+                self._input_consumer.commit()
+        metrics.registry.counter("speed.events").inc(len(new_data))
+        metrics.registry.counter("speed.updates").inc(sent)
         self._batch_count += 1
         return sent

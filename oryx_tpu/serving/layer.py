@@ -21,10 +21,12 @@ import gzip
 import importlib
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from oryx_tpu.bus.core import get_broker
+from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_.base import blocking_iterator
@@ -41,12 +43,49 @@ from oryx_tpu.serving.web import (
 log = logging.getLogger(__name__)
 
 
+def _import_recursively(module_name: str) -> None:
+    """Import a module — and, for a package, every submodule under it — so
+    @resource decorators register. The OryxApplication package-scan
+    analogue (OryxApplication.java:62-86 scans packages with Reflections,
+    so configs may name either a module or a whole package)."""
+    mod = importlib.import_module(module_name)
+    path = getattr(mod, "__path__", None)
+    if path is not None:
+        import pkgutil
+
+        for info in pkgutil.walk_packages(path, prefix=module_name + "."):
+            importlib.import_module(info.name)
+
+
 @resource("GET", "/ready")
 def _ready(ctx: ServingContext, req: Request) -> Response:
     """503 until the model is sufficiently loaded (Ready.java:34-42)."""
     if _model_ready(ctx):
         return Response(200, None)
     return Response(503, None)
+
+
+@resource("GET", "/metrics")
+def _metrics(ctx: ServingContext, req: Request) -> Response:
+    """Request QPS/latency histograms and model state, as JSON — the
+    observability the reference lacks (SURVEY.md §5)."""
+    snap = metrics.registry.snapshot()
+    manager = ctx.model_manager
+    model = manager.get_model() if manager is not None else None
+    if model is not None:
+        snap["serving.model.fraction_loaded"] = {
+            "type": "gauge",
+            "value": getattr(model, "get_fraction_loaded", lambda: 1.0)(),
+        }
+    return Response(200, snap, content_type="application/json")
+
+
+def _observe_request(method: str, status: int, t0: float) -> None:
+    metrics.registry.counter(f"serving.requests.{method}").inc()
+    metrics.registry.counter(f"serving.responses.{status // 100}xx").inc()
+    metrics.registry.histogram("serving.request.seconds").observe(
+        time.perf_counter() - t0
+    )
 
 
 def _model_ready(ctx: ServingContext) -> bool:
@@ -87,7 +126,7 @@ class ServingLayer:
         self.router = Router()
         if self.app_resources:
             for mod in self.app_resources:
-                importlib.import_module(mod)
+                _import_recursively(mod)
         # framework resources (this module) + configured app resources only —
         # never whatever else happens to be imported in this interpreter
         self.router.add_from_registry([__name__] + list(self.app_resources or []))
@@ -181,15 +220,19 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
             log.debug("%s " + fmt, self.address_string(), *args)
 
         def _handle(self, method: str) -> None:
+            t0 = time.perf_counter()
             try:
                 status, payload, ct, extra = self._dispatch(method)
             except OryxServingException as e:
+                _observe_request(method, e.status, t0)
                 self._send_error(e.status, e.message)
                 return
             except Exception:
                 log.exception("internal error handling %s %s", method, self.path)
+                _observe_request(method, 500, t0)
                 self._send_error(500, "internal error")
                 return
+            _observe_request(method, status, t0)
             body = payload
             headers = dict(extra)
             if len(body) > 1024 and "gzip" in self.headers.get("Accept-Encoding", ""):

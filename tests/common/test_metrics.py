@@ -1,0 +1,140 @@
+"""Metrics registry + layer/serving integration."""
+
+import threading
+
+from oryx_tpu.common.metrics import Counter, Histogram, MetricsRegistry, registry, timed
+
+
+def test_counter_and_gauge():
+    r = MetricsRegistry()
+    r.counter("a").inc()
+    r.counter("a").inc(2.5)
+    r.gauge("g").set(7.0)
+    snap = r.snapshot()
+    assert snap["a"] == {"type": "counter", "value": 3.5}
+    assert snap["g"] == {"type": "gauge", "value": 7.0}
+
+
+def test_histogram_quantiles_and_stats():
+    h = Histogram()
+    for ms in [1, 1, 2, 3, 5, 8, 13, 100]:
+        h.observe(ms / 1000)
+    assert h.count == 8
+    assert 0.001 <= h.mean <= 0.2
+    assert h.quantile(0.5) <= h.quantile(0.99)
+    snap = h.snapshot()
+    assert snap["count"] == 8
+    assert snap["min"] <= 0.0011 and snap["max"] >= 0.099
+    assert snap["p50"] <= snap["p99"]
+
+
+def test_histogram_empty_snapshot():
+    assert Histogram().snapshot() == {"type": "histogram", "count": 0}
+
+
+def test_timed_context_manager():
+    r = MetricsRegistry()
+    with timed(r.histogram("x")):
+        pass
+    assert r.histogram("x").count == 1
+
+
+def test_registry_type_conflict():
+    r = MetricsRegistry()
+    r.counter("m")
+    import pytest
+
+    with pytest.raises(TypeError):
+        r.histogram("m")
+
+
+def test_thread_safety():
+    r = MetricsRegistry()
+
+    def work():
+        for _ in range(10_000):
+            r.counter("n").inc()
+            r.histogram("h").observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert r.counter("n").value == 40_000
+    assert r.histogram("h").count == 40_000
+
+
+def test_serving_metrics_endpoint(tmp_path):
+    """/metrics reports request counts/latency after traffic."""
+    import json
+    import urllib.request
+
+    from oryx_tpu.common import config as config_utils
+    from oryx_tpu.serving.layer import ServingLayer
+
+    registry.clear()
+    cfg = config_utils.get_default().with_overlay(
+        f"""
+        oryx.input-topic.broker = "file:{tmp_path}/bus"
+        oryx.update-topic.broker = null
+        oryx.serving.api.port = 0
+        """
+    )
+    layer = ServingLayer(cfg)
+    layer.start()
+    try:
+        base = f"http://127.0.0.1:{layer.port}"
+        for _ in range(3):
+            try:
+                urllib.request.urlopen(f"{base}/ready")
+            except urllib.error.HTTPError:
+                pass  # 503 still counts as a served request
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            snap = json.loads(resp.read())
+        assert snap["serving.requests.GET"]["value"] >= 3
+        assert snap["serving.request.seconds"]["count"] >= 3
+        assert "serving.responses.5xx" in snap or "serving.responses.2xx" in snap
+    finally:
+        layer.close()
+
+
+def test_batch_and_speed_layer_metrics(tmp_path):
+    """Generations and micro-batches show up in the registry."""
+    from oryx_tpu.common import config as config_utils
+    from oryx_tpu.lambda_.batch import BatchLayer
+    from oryx_tpu.lambda_.speed import SpeedLayer
+
+    registry.clear()
+    cfg = config_utils.get_default().with_overlay(
+        f"""
+        oryx.id = "MetricsTest"
+        oryx.input-topic.broker = "file:{tmp_path}/bus"
+        oryx.update-topic.broker = "file:{tmp_path}/bus"
+        oryx.batch.update-class = "oryx_tpu.example.batch:ExampleBatchLayerUpdate"
+        oryx.batch.storage.data-dir = "{tmp_path}/data/"
+        oryx.batch.storage.model-dir = "{tmp_path}/model/"
+        oryx.speed.model-manager-class = "oryx_tpu.example.speed:ExampleSpeedModelManager"
+        """
+    )
+    batch = BatchLayer(cfg)
+    batch.prepare()
+    batch.run_one_generation()
+    assert registry.counter("batch.generations").value == 1
+    assert registry.histogram("batch.generation.seconds").count == 1
+
+    speed = SpeedLayer(cfg)
+    speed.prepare_input()
+    with speed.input_broker().producer(speed.input_topic) as p:
+        p.send("k", "hello world")
+    try:
+        speed.start()
+        import time
+
+        deadline = time.time() + 10
+        while registry.counter("speed.events").value == 0 and time.time() < deadline:
+            speed.run_one_batch()
+            time.sleep(0.05)
+        assert registry.counter("speed.events").value >= 1
+    finally:
+        speed.close()
