@@ -446,3 +446,39 @@ def ingest(ctx: ServingContext, req: Request):
     for line in read_ingest_lines(req):
         send_input(ctx, line)
     return Response(204)
+
+
+# ---------------------------------------------------------------------------
+# Console (als/Console.java:28 — HTML page at / and /index.html)
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.serving.console import ConsoleForm, console_response, render_console  # noqa: E402
+
+_CONSOLE_FORMS = [
+    ConsoleForm("Recommend to a user", "GET", "/recommend/{userID}",
+                query=("howMany", "offset", "considerKnownItems")),
+    ConsoleForm("Recommend to many users", "GET", "/recommendToMany/{userIDs:+}",
+                query=("howMany", "considerKnownItems"), note="separate user IDs with /"),
+    ConsoleForm("Recommend to anonymous", "GET", "/recommendToAnonymous/{itemValuePairs:+}",
+                query=("howMany",), note="item=value pairs separated with /"),
+    ConsoleForm("Similar items", "GET", "/similarity/{itemIDs:+}", query=("howMany",)),
+    ConsoleForm("Similarity to item", "GET", "/similarityToItem/{toItemID}/{itemIDs:+}"),
+    ConsoleForm("Estimate preference", "GET", "/estimate/{userID}/{itemIDs:+}"),
+    ConsoleForm("Because", "GET", "/because/{userID}/{itemID}", query=("howMany",)),
+    ConsoleForm("Known items", "GET", "/knownItems/{userID}"),
+    ConsoleForm("Most popular items", "GET", "/mostPopularItems", query=("howMany",)),
+    ConsoleForm("Most active users", "GET", "/mostActiveUsers", query=("howMany",)),
+    ConsoleForm("Set preference", "POST", "/pref/{userID}/{itemID}", body=True,
+                note="optional strength value in the body"),
+    ConsoleForm("Ingest", "POST", "/ingest", body=True,
+                note="user,item,strength CSV lines"),
+    ConsoleForm("Ready?", "GET", "/ready"),
+]
+
+_CONSOLE_HTML = render_console("Oryx ALS serving console", _CONSOLE_FORMS)
+
+
+@resource("GET", "/")
+@resource("GET", "/index.html")
+def console(ctx: ServingContext, req: Request):
+    return console_response(_CONSOLE_HTML)

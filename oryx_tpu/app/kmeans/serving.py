@@ -124,3 +124,28 @@ def add(ctx: ServingContext, req: Request) -> Response:
         if line.strip():
             send_input(ctx, line.strip())
     return Response(204)
+
+
+# ---------------------------------------------------------------------------
+# Console (kmeans/Console.java:28)
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.serving.console import ConsoleForm, console_response, render_console  # noqa: E402
+
+_CONSOLE_HTML = render_console(
+    "Oryx k-means serving console",
+    [
+        ConsoleForm("Assign to cluster", "GET", "/assign/{datum}",
+                    note="comma-separated numeric point"),
+        ConsoleForm("Distance to nearest", "GET", "/distanceToNearest/{datum}"),
+        ConsoleForm("Add points", "POST", "/add", body=True,
+                    note="one CSV point per line"),
+        ConsoleForm("Ready?", "GET", "/ready"),
+    ],
+)
+
+
+@resource("GET", "/")
+@resource("GET", "/index.html")
+def console(ctx: ServingContext, req: Request):
+    return console_response(_CONSOLE_HTML)

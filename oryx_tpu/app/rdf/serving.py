@@ -198,3 +198,30 @@ def train(ctx: ServingContext, req: Request) -> Response:
         if line.strip():
             send_input(ctx, line.strip())
     return Response(204)
+
+
+# ---------------------------------------------------------------------------
+# Console (rdf/Console.java:28)
+# ---------------------------------------------------------------------------
+
+from oryx_tpu.serving.console import ConsoleForm, console_response, render_console  # noqa: E402
+
+_CONSOLE_HTML = render_console(
+    "Oryx random decision forest serving console",
+    [
+        ConsoleForm("Predict", "GET", "/predict/{datum}",
+                    note="CSV example; blank target field"),
+        ConsoleForm("Classification distribution", "GET",
+                    "/classificationDistribution/{datum}"),
+        ConsoleForm("Feature importance", "GET", "/feature/importance"),
+        ConsoleForm("Train", "POST", "/train", body=True,
+                    note="one labeled CSV example per line"),
+        ConsoleForm("Ready?", "GET", "/ready"),
+    ],
+)
+
+
+@resource("GET", "/")
+@resource("GET", "/index.html")
+def console(ctx: ServingContext, req: Request):
+    return console_response(_CONSOLE_HTML)

@@ -90,7 +90,11 @@ class BatchLayer(AbstractLayer):
     def run_one_generation(self, timestamp_ms: int | None = None) -> None:
         """One full generation; callable directly for deterministic tests."""
         with metrics.timed(metrics.registry.histogram("batch.generation.seconds")):
-            self._run_one_generation(timestamp_ms)
+            try:
+                self._run_one_generation(timestamp_ms)
+            except Exception:
+                metrics.registry.counter("batch.generations.failed").inc()
+                raise
         metrics.registry.counter("batch.generations").inc()
 
     def _run_one_generation(self, timestamp_ms: int | None = None) -> None:

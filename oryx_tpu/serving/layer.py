@@ -53,7 +53,12 @@ def _import_recursively(module_name: str) -> None:
     if path is not None:
         import pkgutil
 
-        for info in pkgutil.walk_packages(path, prefix=module_name + "."):
+        def _fail(name: str) -> None:
+            # default onerror swallows subpackage ImportErrors, which would
+            # leave resources silently unregistered — fail loudly instead
+            raise ImportError(f"cannot import serving resource package {name}")
+
+        for info in pkgutil.walk_packages(path, prefix=module_name + ".", onerror=_fail):
             importlib.import_module(info.name)
 
 

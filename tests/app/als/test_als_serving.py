@@ -278,3 +278,14 @@ def test_ingest_gzip(server):
     assert status == 204
     msgs = tail.poll(timeout=2.0)
     assert [m.message for m in msgs] == ["UG,IG,1.0"]
+
+
+def test_console_served_at_root(server):
+    base, _ = server
+    status, body, headers = http("GET", f"{base}/")
+    assert status == 200
+    assert headers["Content-Type"] == "text/html"
+    assert headers["X-Frame-Options"] == "SAMEORIGIN"
+    assert b"ALS serving console" in body
+    status2, body2, _ = http("GET", f"{base}/index.html")
+    assert status2 == 200 and body2 == body

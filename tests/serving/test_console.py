@@ -1,0 +1,45 @@
+"""Console page tests (reference: per-app Console.java + the header/
+fragment/footer assembly in AbstractConsoleResource.java)."""
+
+from oryx_tpu.serving.console import ConsoleForm, console_response, render_console
+
+
+def test_render_console_contains_forms_and_framing():
+    html = render_console(
+        "Test console",
+        [
+            ConsoleForm("Recommend", "GET", "/recommend/{userID}", query=("howMany",)),
+            ConsoleForm("Ingest", "POST", "/ingest", body=True),
+        ],
+    )
+    assert html.startswith("<!doctype html>")
+    assert "<h1>Test console</h1>" in html
+    assert "GET /recommend/{userID}" in html
+    assert 'name="userID"' in html
+    assert 'name="howMany"' in html
+    assert "<textarea" in html  # body form
+    assert "<footer>" in html
+
+
+def test_greedy_params_render_one_input():
+    html = render_console(
+        "c", [ConsoleForm("Sim", "GET", "/similarity/{itemIDs:+}")]
+    )
+    assert 'name="itemIDs"' in html
+    # the client-side template keeps the greedy marker so the JS can
+    # split-and-encode multi-segment values without collapsing '/'
+    assert "/similarity/{itemIDs:+}" in html
+
+
+def test_console_response_headers():
+    resp = console_response("<html></html>")
+    assert resp.status == 200
+    assert resp.content_type == "text/html"
+    assert resp.headers["X-Frame-Options"] == "SAMEORIGIN"
+    assert resp.headers["Cache-Control"] == "public"
+
+
+def test_escapes_html_in_titles():
+    html = render_console("a<b>", [ConsoleForm("x<y>", "GET", "/p")])
+    assert "a&lt;b&gt;" in html
+    assert "x&lt;y&gt;" in html
