@@ -44,6 +44,23 @@ class FeatureVectors:
             self._vectors[id_] = vector
             self._recent_ids.add(id_)
 
+    def get_batch(self, ids: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Vectors for many ids: ([n, dim] float32 with zero rows for
+        misses, [n] bool valid). Interface parity with the native store."""
+        n = len(ids)
+        dim = 0
+        with self._lock.read():
+            for v in self._vectors.values():
+                dim = len(v)
+                break
+            mat = np.zeros((n, dim), dtype=np.float32)
+            valid = np.zeros(n, dtype=bool)
+            for j, id_ in enumerate(ids):
+                v = self._vectors.get(id_)
+                if v is not None:
+                    mat[j], valid[j] = v, True
+        return mat, valid
+
     def remove_vector(self, id_: str) -> None:
         with self._lock.write():
             self._vectors.pop(id_, None)

@@ -12,6 +12,7 @@ the ALSUtils fold-in, publishing ["X",user,vec[,knownItems]] /
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from typing import Iterable, Iterator
@@ -21,14 +22,28 @@ import numpy as np
 from oryx_tpu.api.speed import SpeedModel, SpeedModelManager
 from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
-from oryx_tpu.app.als.common import compute_updated_xu
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.text import join_json, read_json
+from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
-from oryx_tpu.native.store import make_feature_vectors
+from oryx_tpu.native.store import (
+    format_update_messages,
+    format_vectors_json,
+    make_feature_vectors,
+)
 
 log = logging.getLogger(__name__)
+
+_PLAIN = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-.:@ "
+)
+
+
+def _json_str(s: str) -> str:
+    """JSON string literal; quoting fast path for typical IDs."""
+    if all(c in _PLAIN for c in s):
+        return f'"{s}"'
+    return json.dumps(s)
 
 
 class ALSSpeedModel(SpeedModel):
@@ -93,6 +108,7 @@ class ALSSpeedModelManager(SpeedModelManager):
     def __init__(self, config: Config) -> None:
         self.implicit = config.get_bool("oryx.als.implicit")
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
+        self.fold_backend = config.get_string("oryx.speed.fold-in-backend")
         self.model: ALSSpeedModel | None = None
 
     # -- update-topic consumption (ALSSpeedModelManager.consume:74-126) ------
@@ -149,22 +165,56 @@ class ALSSpeedModelManager(SpeedModelManager):
             return []
         if yty is None or xtx is None:
             return []
+        # One data-parallel call for the whole micro-batch: every event
+        # reads pre-batch state (updates travel via the update topic), so
+        # there is no sequential dependency to honor — same contract as the
+        # reference's parallelStream, but as a single batched solve. The
+        # vector fetch and update serialization are likewise batched (one
+        # native call each) — the per-event hot path has no Python in it.
+        from oryx_tpu.ops import als as als_ops
+
+        n = len(agg)
+        users = [u for (u, _) in agg]
+        items = [i for (_, i) in agg]
+        xu, xu_valid = model.x.get_batch(users)
+        yi, yi_valid = model.y.get_batch(items)
+        values = np.fromiter((v for v in agg.values()), dtype=np.float32, count=n)
+        new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
+            yty.matrix, xtx.matrix, xu, xu_valid, yi, yi_valid, values,
+            self.implicit, backend=self.fold_backend,
+        )
+        x_rows = np.nonzero(x_upd)[0].tolist()
+        y_rows = np.nonzero(y_upd)[0].tolist()
+        known = not self.no_known_items
+        x_msgs = format_update_messages(
+            new_xu[x_rows], [users[j] for j in x_rows], [items[j] for j in x_rows], "X", known
+        )
+        y_msgs = format_update_messages(
+            new_yi[y_rows], [items[j] for j in y_rows], [users[j] for j in y_rows], "Y", known
+        )
+        if x_msgs is not None and y_msgs is not None:
+            return x_msgs + y_msgs
+        # pure-Python fallback when the native library is unavailable
         out: list[str] = []
-        for (user, item), value in agg.items():
-            xu = model.x.get_vector(user)
-            yi = model.y.get_vector(item)
-            new_xu = compute_updated_xu(yty, value, xu, yi, self.implicit)
-            new_yi = compute_updated_xu(xtx, value, yi, xu, self.implicit)
-            if new_xu is not None:
-                out.append(self._to_update_json("X", user, new_xu, item))
-            if new_yi is not None:
-                out.append(self._to_update_json("Y", item, new_yi, user))
+        x_json = dict(zip(x_rows, format_vectors_json(new_xu[x_rows])))
+        y_json = dict(zip(y_rows, format_vectors_json(new_yi[y_rows])))
+        for j, (user, item) in enumerate(agg):
+            vec = x_json.get(j)
+            if vec is not None:
+                out.append(self._assemble("X", user, vec, item))
+            vec = y_json.get(j)
+            if vec is not None:
+                out.append(self._assemble("Y", item, vec, user))
         return out
 
-    def _to_update_json(self, matrix: str, id_: str, vector: np.ndarray, other_id: str) -> str:
+    def _assemble(self, matrix: str, id_: str, vec_json: str, other_id: str) -> str:
+        """Splice a pre-formatted vector JSON into the update message
+        (["X"|"Y", id, vector(, knownIds)], ALSSpeedModelManager.
+        toUpdateJSON:207-215)."""
+        id_json = _json_str(id_)
         if self.no_known_items:
-            return join_json([matrix, id_, vector.tolist()])
-        return join_json([matrix, id_, vector.tolist(), [other_id]])
+            return f'["{matrix}",{id_json},{vec_json}]'
+        return f'["{matrix}",{id_json},{vec_json},[{_json_str(other_id)}]]'
 
     def close(self) -> None:
         pass

@@ -51,6 +51,10 @@ def parse_json_array(line: str) -> list:
 
 
 def parse_delimited(line: str, delimiter: str = ",") -> list[str]:
+    if not line:
+        return []
+    if '"' not in line:  # fast path: no quoting, plain split (hot ingest path)
+        return line.split(delimiter)
     reader = csv.reader(io.StringIO(line), delimiter=delimiter)
     for row in reader:
         return row

@@ -120,6 +120,11 @@ class Solver:
         except np.linalg.LinAlgError:
             self._chol = None
 
+    @property
+    def matrix(self) -> np.ndarray:
+        """The decomposed A = V^T V (for batched solves elsewhere)."""
+        return self._a
+
     def solve_d_to_d(self, b: np.ndarray) -> np.ndarray:
         b = np.asarray(b, dtype=np.float64)
         if self._chol is not None:

@@ -14,12 +14,15 @@
 //
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 
+#include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <functional>
 #include <mutex>
 #include <shared_mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -295,6 +298,270 @@ void fs_retain(void* p, const char* keep, int64_t keep_len) {
     }
     sh.recent.clear();
   }
+}
+
+// Batched lookup: ids as a length-prefixed stream, vectors written to
+// out_mat[n][dim] (rows for missing ids left untouched), out_valid[i]
+// set 1/0. One lock acquisition per id, no Python between lookups —
+// the speed layer fetches every event's user+item vector in one call.
+int64_t fs_get_batch(void* p, const char* ids, int64_t ids_len, int64_t n,
+                     float* out_mat, uint8_t* out_valid) {
+  auto* s = static_cast<Store*>(p);
+  const char* q = ids;
+  const char* end = ids + ids_len;
+  int64_t i = 0;
+  for (; i < n && q + sizeof(uint32_t) <= end; ++i) {
+    uint32_t len;
+    std::memcpy(&len, q, sizeof(len));
+    q += sizeof(len);
+    if (q + len > end) break;
+    std::string key(q, len);
+    q += len;
+    Shard& sh = s->shard_for(key);
+    std::shared_lock lock(sh.mu);
+    auto it = sh.index.find(key);
+    if (it == sh.index.end()) {
+      out_valid[i] = 0;
+    } else {
+      std::memcpy(out_mat + i * s->dim, sh.slab.data() + it->second * s->dim,
+                  s->dim * sizeof(float));
+      out_valid[i] = 1;
+    }
+  }
+  for (int64_t j = i; j < n; ++j) out_valid[j] = 0;
+  return i;
+}
+
+// Format n rows of float32 [n][k] as JSON number arrays "[v,v,...]" with
+// %.9g (shortest round-trip for float32 needs <= 9 significant digits).
+// Rows are written back-to-back; offsets[i]..offsets[i+1] bounds row i.
+// Returns total bytes, or -1 if cap is too small (needed reported).
+// This is the speed layer's update-serialization hot path: Python's json
+// encoder spends ~1us per float printing 17-digit float64 reprs.
+int64_t json_format_vectors(const float* mat, int64_t n, int64_t k,
+                            char* out, int64_t cap, int64_t* offsets,
+                            int64_t* needed) {
+  // worst case per float: sign + 9 digits + '.' + 'e+38' + ',' ~ 18 bytes
+  int64_t worst = n * (2 + k * 18);
+  *needed = worst;
+  if (cap < worst) return -1;
+  char* w = out;
+  for (int64_t i = 0; i < n; ++i) {
+    offsets[i] = w - out;
+    *w++ = '[';
+    const float* row = mat + i * k;
+    for (int64_t j = 0; j < k; ++j) {
+      if (j) *w++ = ',';
+      double v = static_cast<double>(row[j]);
+      int len = snprintf(w, 32, "%.9g", v);
+      // JSON has no Infinity/NaN literals; clamp to 0 like a poisoned
+      // update would be dropped downstream anyway
+      if (!std::isfinite(v)) {
+        len = snprintf(w, 32, "0");
+      }
+      w += len;
+    }
+    *w++ = ']';
+  }
+  offsets[n] = w - out;
+  return w - out;
+}
+
+// --- speed-layer update-message assembly -----------------------------------
+//
+// Emit complete update-topic messages ["X"|"Y", id, [v,...], [otherId]]
+// (ALSSpeedModelManager.toUpdateJSON wire format) for n rows at once,
+// formatted in parallel across threads. Rows are written into fixed-
+// stride per-row regions of `out` (so threads never contend); true
+// bounds come back via starts[i]/ends[i]. Gaps between rows are
+// space-filled so the caller may decode the whole buffer as ASCII.
+
+namespace {
+
+inline char* json_escape_append(char* w, const char* s, uint32_t len) {
+  *w++ = '"';
+  for (uint32_t i = 0; i < len; ++i) {
+    unsigned char c = static_cast<unsigned char>(s[i]);
+    if (c == '"' || c == '\\') {
+      *w++ = '\\';
+      *w++ = static_cast<char>(c);
+    } else if (c < 0x20) {
+      w += snprintf(w, 8, "\\u%04x", c);
+    } else {
+      *w++ = static_cast<char>(c);  // UTF-8 bytes pass through
+    }
+  }
+  *w++ = '"';
+  return w;
+}
+
+// 9 significant digits round-trips any float32. The common magnitude
+// range takes a fast integer path (~5x snprintf); outliers fall back to
+// %.9g. Both produce correctly rounded 9-digit decimals.
+inline char* float_append(char* w, float f) {
+  double v = static_cast<double>(f);
+  if (!std::isfinite(v)) {
+    *w++ = '0';  // JSON has no NaN/Infinity literals
+    return w;
+  }
+  if (v == 0.0) {
+    *w++ = '0';
+    return w;
+  }
+  double a = v < 0 ? -v : v;
+  if (a < 1e-4 || a >= 1e9) {
+    return w + snprintf(w, 32, "%.9g", v);
+  }
+  if (v < 0) *w++ = '-';
+  // kPow10[i] = 10^(i-8), covering 10^-8 .. 10^13
+  static const double kPow10[22] = {1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2,
+                                    1e-1, 1e0,  1e1,  1e2,  1e3,  1e4,  1e5,
+                                    1e6,  1e7,  1e8,  1e9,  1e10, 1e11, 1e12,
+                                    1e13};
+  // decimal exponent: a in [10^e, 10^(e+1))
+  int e = 0;
+  while (e < 8 && a >= kPow10[9 + e]) ++e;  // a >= 10^(e+1)
+  while (e > -4 && a < kPow10[8 + e]) --e;  // a < 10^e
+  // 9 significant digits as an integer, correctly rounded
+  int64_t d = static_cast<int64_t>(a * kPow10[16 - e] + 0.5);
+  if (d >= 1000000000) {  // rounding crossed a power of 10
+    d /= 10;
+    ++e;
+  }
+  char digits[9];
+  for (int i = 8; i >= 0; --i) {
+    digits[i] = static_cast<char>('0' + d % 10);
+    d /= 10;
+  }
+  int last = 8;  // index of last significant (non-trailing-zero) digit
+  while (last > 0 && digits[last] == '0') --last;
+  if (e >= 0) {
+    int i = 0;
+    for (; i <= e; ++i) *w++ = digits[i];          // integer part
+    if (last > e) {
+      *w++ = '.';
+      for (; i <= last; ++i) *w++ = digits[i];     // fraction
+    }
+  } else {
+    *w++ = '0';
+    *w++ = '.';
+    for (int z = 0; z < -e - 1; ++z) *w++ = '0';   // leading zeros
+    for (int i = 0; i <= last; ++i) *w++ = digits[i];
+  }
+  return w;
+}
+
+struct IdView {
+  const char* p;
+  uint32_t len;
+};
+
+// parse a length-prefixed id stream into views (no copies)
+std::vector<IdView> parse_id_stream(const char* ids, int64_t ids_len, int64_t n) {
+  std::vector<IdView> out;
+  out.reserve(n);
+  const char* q = ids;
+  const char* end = ids + ids_len;
+  while (static_cast<int64_t>(out.size()) < n && q + sizeof(uint32_t) <= end) {
+    uint32_t len;
+    std::memcpy(&len, q, sizeof(len));
+    q += sizeof(len);
+    if (q + len > end) break;
+    out.push_back({q, len});
+    q += len;
+  }
+  return out;
+}
+
+}  // namespace
+
+// Per-row worst case for als_format_updates' fixed stride.
+int64_t als_update_row_cap(int64_t k, int64_t max_id_len) {
+  return 16 + 2 * (6 * max_id_len + 2) + 2 + k * 18;
+}
+
+// matrix_tag: 'X' or 'Y'. ids/other_ids: length-prefixed streams of n ids.
+// include_known: emit the trailing [otherId] element. out must hold
+// n * als_update_row_cap(k, max_id_len) bytes. Each thread writes its
+// rows back-to-back inside its own region; regions are then compacted so
+// the result is one contiguous byte run. Returns total bytes, or -1 on a
+// malformed id stream.
+int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
+                           const char* ids, int64_t ids_len,
+                           const char* other_ids, int64_t other_ids_len,
+                           char matrix_tag, int include_known,
+                           int64_t max_id_len, char* out,
+                           int64_t* starts, int64_t* ends, int64_t num_threads) {
+  std::vector<IdView> id_views = parse_id_stream(ids, ids_len, n);
+  std::vector<IdView> other_views = parse_id_stream(other_ids, other_ids_len, n);
+  if (static_cast<int64_t>(id_views.size()) < n ||
+      (include_known && static_cast<int64_t>(other_views.size()) < n)) {
+    return -1;
+  }
+  if (n == 0) return 0;
+  const int64_t stride = als_update_row_cap(k, max_id_len);
+  if (num_threads < 1) num_threads = 1;
+  if (num_threads > n) num_threads = n;
+  const int64_t chunk = (n + num_threads - 1) / num_threads;
+  std::vector<int64_t> region_end(num_threads, 0);
+  auto worker = [&](int64_t t, int64_t lo, int64_t hi) {
+    char* w = out + lo * stride;
+    for (int64_t i = lo; i < hi; ++i) {
+      starts[i] = w - out;
+      *w++ = '[';
+      *w++ = '"';
+      *w++ = matrix_tag;
+      *w++ = '"';
+      *w++ = ',';
+      w = json_escape_append(w, id_views[i].p, id_views[i].len);
+      *w++ = ',';
+      *w++ = '[';
+      const float* row = mat + i * k;
+      for (int64_t j = 0; j < k; ++j) {
+        if (j) *w++ = ',';
+        w = float_append(w, row[j]);
+      }
+      *w++ = ']';
+      if (include_known) {
+        *w++ = ',';
+        *w++ = '[';
+        w = json_escape_append(w, other_views[i].p, other_views[i].len);
+        *w++ = ']';
+      }
+      *w++ = ']';
+      ends[i] = w - out;
+    }
+    region_end[t] = w - out;
+  };
+  if (num_threads == 1) {
+    worker(0, 0, n);
+    return region_end[0];
+  }
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < num_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    threads.emplace_back(worker, t, lo, hi);
+  }
+  int64_t used_threads = static_cast<int64_t>(threads.size());
+  for (auto& th : threads) th.join();
+  // compact regions into one contiguous run, shifting row offsets
+  int64_t dst = region_end[0];
+  for (int64_t t = 1; t < used_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    int64_t src = lo * stride;
+    int64_t len = region_end[t] - src;
+    std::memmove(out + dst, out + src, static_cast<size_t>(len));
+    int64_t delta = dst - src;
+    for (int64_t i = lo; i < hi; ++i) {
+      starts[i] += delta;
+      ends[i] += delta;
+    }
+    dst += len;
+  }
+  return dst;
 }
 
 }  // extern "C"

@@ -10,6 +10,7 @@ readers/writers on different shards genuinely run in parallel.
 from __future__ import annotations
 
 import ctypes
+import os
 import struct
 import threading
 from typing import Callable, Iterable
@@ -92,6 +93,25 @@ class NativeFeatureVectors:
         )
         return out if found else None
 
+    def get_batch(self, ids: list[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Vectors for many ids in one native call:
+        ([n, dim] float32 with zero rows for misses, [n] bool valid)."""
+        n = len(ids)
+        if self._ptr is None or n == 0:
+            return np.zeros((n, self._dim or 0), dtype=np.float32), np.zeros(n, dtype=bool)
+        stream = _encode_ids(ids)
+        mat = np.zeros((n, self._dim), dtype=np.float32)
+        valid = np.zeros(n, dtype=np.uint8)
+        self._lib.fs_get_batch(
+            self._ptr,
+            stream,
+            len(stream),
+            n,
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return mat, valid.astype(bool)
+
     def remove_vector(self, id_: str) -> None:
         if self._ptr is not None:
             key = id_.encode("utf-8")
@@ -171,6 +191,106 @@ class NativeFeatureVectors:
         out = np.zeros((self._dim, self._dim), dtype=np.float64)
         self._lib.fs_vtv(self._ptr, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
         return out
+
+
+def format_vectors_json(mat: np.ndarray) -> list[str]:
+    """Each row of [n, k] float32 as a JSON number-array string. Native
+    %.9g formatting (round-trips float32) when the library is available;
+    json.dumps fallback otherwise."""
+    mat = np.ascontiguousarray(mat, dtype=np.float32)
+    n, k = mat.shape
+    lib = get_library()
+    if lib is None or n == 0:
+        import json
+
+        return [json.dumps(row.tolist()) for row in mat]
+    cap = n * (2 + k * 18)
+    out = np.empty(cap, dtype=np.uint8)  # no zero-fill: the C side writes
+    offsets = np.empty(n + 1, dtype=np.int64)
+    needed = ctypes.c_int64()
+    total = lib.json_format_vectors(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        k,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+        cap,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.byref(needed),
+    )
+    if total < 0:  # pragma: no cover - cap is the function's own worst case
+        raise RuntimeError("json_format_vectors buffer underestimate")
+    # one decode of the packed output, then O(row) str slices (ascii, so
+    # byte offsets == char offsets)
+    s = out[:total].tobytes().decode("ascii")
+    off = offsets.tolist()
+    return [s[off[i] : off[i + 1]] for i in range(n)]
+
+
+def format_update_messages(
+    mat: np.ndarray,
+    ids: list[str],
+    other_ids: list[str],
+    tag: str,
+    include_known: bool = True,
+    num_threads: int | None = None,
+) -> list[str] | None:
+    """Complete speed-layer update messages ["X"|"Y", id, [v..], [other]]
+    for n rows in one thread-parallel native call, or None when the
+    native library is unavailable (caller assembles in Python)."""
+    lib = get_library()
+    if lib is None:
+        return None
+    mat = np.ascontiguousarray(mat, dtype=np.float32)
+    n, k = mat.shape
+    if n == 0:
+        return []
+    def encode_stream(strs: list[str]) -> tuple[bytes, int, bool]:
+        out = bytearray()
+        max_len = 1
+        ascii_ = True
+        for s in strs:
+            b = s.encode("utf-8")
+            if len(b) != len(s):
+                ascii_ = False
+            if len(b) > max_len:
+                max_len = len(b)
+            out += struct.pack("<I", len(b))
+            out += b
+        return bytes(out), max_len, ascii_
+
+    ids_stream, max_a, ascii_a = encode_stream(ids)
+    other_stream, max_b, ascii_b = encode_stream(other_ids if include_known else [])
+    all_ascii = ascii_a and ascii_b
+    max_id_len = max(max_a, max_b)
+    stride = int(lib.als_update_row_cap(k, max_id_len))
+    out = np.empty(n * stride, dtype=np.uint8)
+    starts = np.empty(n, dtype=np.int64)
+    ends = np.empty(n, dtype=np.int64)
+    threads = num_threads or min(8, os.cpu_count() or 1)
+    total = lib.als_format_updates(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        k,
+        ids_stream,
+        len(ids_stream),
+        other_stream,
+        len(other_stream),
+        tag.encode("ascii"),
+        1 if include_known else 0,
+        max_id_len,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        threads,
+    )
+    if total < 0:  # pragma: no cover - streams are built right here
+        return None
+    st, en = starts.tolist(), ends.tolist()
+    if all_ascii:
+        s = str(memoryview(out)[:total], "ascii")
+        return [s[st[i] : en[i]] for i in range(n)]
+    buf = memoryview(out)[:total]
+    return [str(buf[st[i] : en[i]], "utf-8") for i in range(n)]
 
 
 def make_feature_vectors(num_shards: int = 16):

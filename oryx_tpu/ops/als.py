@@ -264,3 +264,136 @@ def mean_auc(
         correct = (pos_scores[:, None] > neg_scores[None, :]).sum()
         aucs.append(correct / (len(pos_scores) * len(neg_scores)))
     return float(np.mean(aucs)) if aucs else float("nan")
+
+
+# ---------------------------------------------------------------------------
+# Speed-layer fold-in, batched on device
+# ---------------------------------------------------------------------------
+#
+# The reference folds in one event at a time (ALSUtils.computeUpdatedXu:
+# 74-106 inside ALSSpeedModelManager.buildUpdates' parallelStream). All
+# events in a micro-batch read the PRE-batch model state (updates travel
+# via the update topic, not in-place), so the whole batch is one
+# data-parallel computation: a single [k,k] Cholesky factorization per
+# side reused against an [n,k] right-hand-side block on the MXU.
+
+
+def _batch_target_qui(implicit: bool, values, current):
+    """Vectorized ALSUtils.computeTargetQui:37-59; NaN = no update."""
+    if not implicit:
+        return values
+    pos = (values > 0.0) & (current < 1.0)
+    t_pos = current + (values / (1.0 + values)) * (1.0 - jnp.maximum(0.0, current))
+    neg = (values < 0.0) & (current > 0.0)
+    t_neg = current + (values / (values - 1.0)) * (0.0 - jnp.minimum(1.0, current))
+    return jnp.where(pos, t_pos, jnp.where(neg, t_neg, jnp.nan))
+
+
+def _fold_half(ata, vecs_own, own_valid, vecs_other, other_valid, values, implicit):
+    """New own-side vectors after events against the other side's vectors.
+
+    vecs_own[n,k] current vectors (zeros where own_valid is False — a
+    brand-new row starts from a "don't know" prior of 0.5), vecs_other
+    the interacting vectors. Returns (new_vecs[n,k], updated[n])."""
+    qui = jnp.where(own_valid, jnp.sum(vecs_own * vecs_other, axis=1), 0.0)
+    current = jnp.where(own_valid, qui, 0.5)
+    target = _batch_target_qui(implicit, values, current)
+    d_qui = target - qui
+    rhs = d_qui[:, None] * vecs_other  # [n, k]
+    chol = jax.scipy.linalg.cho_factor(ata)
+    d_vec = jax.scipy.linalg.cho_solve(chol, rhs.T).T
+    new_vecs = jnp.where(own_valid[:, None], vecs_own, 0.0) + d_vec
+    updated = other_valid & ~jnp.isnan(target)
+    return jnp.where(updated[:, None], new_vecs, 0.0), updated
+
+
+@functools.partial(jax.jit, static_argnames=("implicit",))
+def _fold_in_batch_jit(yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit):
+    new_xu, x_upd = _fold_half(yty, xu, xu_valid, yi, yi_valid, values, implicit)
+    new_yi, y_upd = _fold_half(xtx, yi, yi_valid, xu, xu_valid, values, implicit)
+    return new_xu, x_upd, new_yi, y_upd
+
+
+def _fold_half_host(ata, vecs_own, own_valid, vecs_other, other_valid, values, implicit):
+    """Host (BLAS) twin of _fold_half: float32 vectors/solves (same
+    precision as the device path), float64 target math (scalar parity)."""
+    vo = np.asarray(vecs_own, dtype=np.float32)
+    vt = np.asarray(vecs_other, dtype=np.float32)
+    values = values.astype(np.float64)
+    qui = np.where(own_valid, np.einsum("nk,nk->n", vo, vt, dtype=np.float64), 0.0)
+    current = np.where(own_valid, qui, 0.5)
+    if implicit:
+        with np.errstate(divide="ignore", invalid="ignore"):
+            t_pos = current + (values / (1.0 + values)) * (1.0 - np.maximum(0.0, current))
+            t_neg = current + (values / (values - 1.0)) * (0.0 - np.minimum(1.0, current))
+        target = np.where(
+            (values > 0.0) & (current < 1.0),
+            t_pos,
+            np.where((values < 0.0) & (current > 0.0), t_neg, np.nan),
+        )
+    else:
+        target = values
+    d_qui = np.nan_to_num(target - qui).astype(np.float32)
+    rhs = d_qui[:, None] * vt
+    d_vec = np.linalg.solve(np.asarray(ata, dtype=np.float32), rhs.T).T
+    new = np.where(own_valid[:, None], vo, 0.0) + d_vec
+    updated = other_valid & ~np.isnan(target)
+    return np.where(updated[:, None], new, 0.0).astype(np.float32, copy=False), updated
+
+
+def _bucket(n: int) -> int:
+    """Pad batch sizes to power-of-two buckets so the jitted fold-in
+    compiles once per bucket, not once per micro-batch size."""
+    return max(256, 1 << (n - 1).bit_length())
+
+
+def fold_in_batch(
+    yty: np.ndarray,
+    xtx: np.ndarray,
+    xu: np.ndarray,
+    xu_valid: np.ndarray,
+    yi: np.ndarray,
+    yi_valid: np.ndarray,
+    values: np.ndarray,
+    implicit: bool,
+    backend: str = "auto",
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Fold a micro-batch of n (user, item, value) events into both factor
+    sides at once. xu/yi are the events' current vectors ([n,k], zero rows
+    where the id is new, flagged by the valid masks). Returns
+    (new_xu[n,k], x_updated[n], new_yi[n,k], y_updated[n]) — rows where
+    the updated flag is False carry no update (reference: None returns of
+    ALSUtils.computeUpdatedXu).
+
+    backend: 'device' (jit, batch padded to power-of-two buckets),
+    'host' (float64 BLAS), or 'auto' — device once the batch is big
+    enough that the k x k solves dominate host<->device transfer."""
+    n, k = xu.shape
+    if backend == "auto":
+        # the k x k solves are tiny; device only pays off once the batch is
+        # large enough that MXU throughput beats host BLAS plus transfer
+        backend = "device" if n * max(k, 1) >= 8_000_000 else "host"
+    if backend == "host":
+        new_xu, x_upd = _fold_half_host(yty, xu, xu_valid, yi, yi_valid, values, implicit)
+        new_yi, y_upd = _fold_half_host(xtx, yi, yi_valid, xu, xu_valid, values, implicit)
+        return new_xu, x_upd, new_yi, y_upd
+    m = _bucket(n)
+    if m != n:
+        pad = m - n
+        xu = np.concatenate([xu, np.zeros((pad, k), xu.dtype)])
+        yi = np.concatenate([yi, np.zeros((pad, k), yi.dtype)])
+        xu_valid = np.concatenate([xu_valid, np.zeros(pad, bool)])
+        yi_valid = np.concatenate([yi_valid, np.zeros(pad, bool)])
+        values = np.concatenate([values, np.zeros(pad, values.dtype)])
+    out = _fold_in_batch_jit(
+        jnp.asarray(yty, dtype=jnp.float32),
+        jnp.asarray(xtx, dtype=jnp.float32),
+        jnp.asarray(xu, dtype=jnp.float32),
+        jnp.asarray(xu_valid),
+        jnp.asarray(yi, dtype=jnp.float32),
+        jnp.asarray(yi_valid),
+        jnp.asarray(values, dtype=jnp.float32),
+        implicit,
+    )
+    new_xu, x_upd, new_yi, y_upd = (np.asarray(o)[:n] for o in out)
+    return new_xu, x_upd, new_yi, y_upd

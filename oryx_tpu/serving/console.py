@@ -122,7 +122,7 @@ def _form_html(form: ConsoleForm) -> str:
     return (
         f"<fieldset><legend>{_html.escape(form.legend)}</legend>"
         f"<code>{_html.escape(form.method)} {_html.escape(form.path)}</code> {note}"
-        f'<form onsubmit="return go(this, {form.method!r}, {template!r}, {str(form.body).lower()})">'
+        f'<form onsubmit="go(this, {form.method!r}, {template!r}, {str(form.body).lower()}); return false">'
         f"{rows}{body_area} <input type=\"submit\" value=\"Send\">"
         '<pre class="out"></pre></form></fieldset>'
     )

@@ -180,3 +180,98 @@ def test_native_concurrent_read_write():
 def test_make_feature_vectors_fallback(monkeypatch):
     monkeypatch.setenv("ORYX_NATIVE", "0")
     assert isinstance(make_feature_vectors(), FeatureVectors)
+
+
+# ---------------------------------------------------------------------------
+# batched get + native JSON formatting
+# ---------------------------------------------------------------------------
+
+
+def test_get_batch_hits_and_misses():
+    fv = make_feature_vectors()
+    fv.set_vector("a", np.asarray([1.0, 2.0], np.float32))
+    fv.set_vector("b", np.asarray([3.0, 4.0], np.float32))
+    mat, valid = fv.get_batch(["a", "missing", "b", "a"])
+    assert valid.tolist() == [True, False, True, True]
+    np.testing.assert_array_equal(mat[0], [1.0, 2.0])
+    np.testing.assert_array_equal(mat[2], [3.0, 4.0])
+    np.testing.assert_array_equal(mat[3], [1.0, 2.0])
+    np.testing.assert_array_equal(mat[1], [0.0, 0.0])
+
+
+def test_get_batch_python_fallback_matches():
+    from oryx_tpu.app.als.common import FeatureVectors
+
+    fv = FeatureVectors()
+    fv.set_vector("a", np.asarray([1.0, 2.0], np.float32))
+    mat, valid = fv.get_batch(["a", "zz"])
+    assert valid.tolist() == [True, False]
+    np.testing.assert_array_equal(mat[0], [1.0, 2.0])
+
+
+def test_format_vectors_json_round_trips_float32():
+    import json
+
+    from oryx_tpu.native.store import format_vectors_json
+
+    gen = np.random.default_rng(3)
+    mat = np.concatenate(
+        [
+            gen.standard_normal((50, 7)).astype(np.float32),
+            (gen.standard_normal((50, 7)) * 1e6).astype(np.float32),
+            (gen.standard_normal((50, 7)) * 1e-6).astype(np.float32),
+            np.asarray([[0.0, -0.0, 1.0, -1.0, 0.1, 1e-38, 3.1e38]], np.float32),
+        ]
+    )
+    out = format_vectors_json(mat)
+    assert len(out) == mat.shape[0]
+    for row, s in zip(mat, out):
+        back = np.asarray(json.loads(s), dtype=np.float32)
+        np.testing.assert_array_equal(back, row)  # exact float32 round-trip
+
+
+def test_format_update_messages_wire_format():
+    import json
+
+    from oryx_tpu.native.store import format_update_messages
+
+    mat = np.asarray([[0.5, -2.0], [1.0, 3.25]], np.float32)
+    msgs = format_update_messages(mat, ["U1", 'we"ird\\id'], ["I1", "I2"], "X", True)
+    if msgs is None:  # native lib unavailable: nothing to check
+        return
+    assert json.loads(msgs[0]) == ["X", "U1", [0.5, -2.0], ["I1"]]
+    assert json.loads(msgs[1]) == ["X", 'we"ird\\id', [1.0, 3.25], ["I2"]]
+    no_known = format_update_messages(mat, ["U1", "U2"], [], "Y", False)
+    assert json.loads(no_known[0]) == ["Y", "U1", [0.5, -2.0]]
+
+
+def test_format_update_messages_unicode_ids():
+    import json
+
+    from oryx_tpu.native.store import format_update_messages
+
+    mat = np.asarray([[1.5]], np.float32)
+    msgs = format_update_messages(mat, ["usér-Ω"], ["ítem"], "X", True)
+    if msgs is None:
+        return
+    assert json.loads(msgs[0]) == ["X", "usér-Ω", [1.5], ["ítem"]]
+
+
+def test_format_update_messages_many_threads_compaction():
+    import json
+
+    from oryx_tpu.native.store import format_update_messages
+
+    gen = np.random.default_rng(9)
+    n, k = 1000, 5
+    mat = gen.standard_normal((n, k)).astype(np.float32)
+    ids = [f"U{j}" for j in range(n)]
+    others = [f"I{j}" for j in range(n)]
+    msgs = format_update_messages(mat, ids, others, "X", True, num_threads=7)
+    if msgs is None:
+        return
+    assert len(msgs) == n
+    for j in (0, 142, 143, 999):  # across thread-chunk boundaries
+        parsed = json.loads(msgs[j])
+        assert parsed[0] == "X" and parsed[1] == f"U{j}" and parsed[3] == [f"I{j}"]
+        np.testing.assert_array_equal(np.asarray(parsed[2], np.float32), mat[j])

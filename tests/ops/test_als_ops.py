@@ -108,3 +108,70 @@ def test_chunked_solve_matches_unchunked():
     b = als_ops.train_als(u, i, v, 50, 20, features=4, lam=0.05, implicit=False,
                           iterations=3, seed=9, chunk=16)
     np.testing.assert_allclose(a.x, b.x, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# batched fold-in vs the scalar reference semantics
+# ---------------------------------------------------------------------------
+
+
+def _scalar_fold(yty_mat, xtx_mat, events, xvecs, yvecs, implicit):
+    from oryx_tpu.app.als.common import compute_updated_xu
+    from oryx_tpu.common.vectormath import Solver
+
+    yty, xtx = Solver(yty_mat), Solver(xtx_mat)
+    out = []
+    for (u, i), v in events:
+        xu, yi = xvecs.get(u), yvecs.get(i)
+        out.append(
+            (
+                compute_updated_xu(yty, v, xu, yi, implicit),
+                compute_updated_xu(xtx, v, yi, xu, implicit),
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+def test_fold_in_batch_matches_scalar(implicit):
+    from oryx_tpu.ops import als as als_ops
+
+    gen = np.random.default_rng(42)
+    k = 4
+    xvecs = {f"U{j}": gen.standard_normal(k).astype(np.float32) for j in range(6)}
+    yvecs = {f"I{j}": gen.standard_normal(k).astype(np.float32) for j in range(6)}
+    xmat = np.stack(list(xvecs.values()))
+    ymat = np.stack(list(yvecs.values()))
+    yty_mat = ymat.T @ ymat
+    xtx_mat = xmat.T @ xmat
+    events = [
+        (("U0", "I0"), 1.0),
+        (("U1", "I1"), -0.5),  # negative strength
+        (("U2", "Inew"), 2.0),  # unknown item: no X update, no Y update
+        (("Unew", "I3"), 1.0),  # unknown user: fresh vector from 0.5 prior
+        (("U4", "I4"), 0.0),  # zero strength: implicit -> NaN target
+    ]
+    expected = _scalar_fold(yty_mat, xtx_mat, events, xvecs, yvecs, implicit)
+
+    n = len(events)
+    xu = np.zeros((n, k), np.float32)
+    yi = np.zeros((n, k), np.float32)
+    xu_valid = np.zeros(n, bool)
+    yi_valid = np.zeros(n, bool)
+    values = np.array([v for _, v in events], np.float32)
+    for j, ((u, i), _) in enumerate(events):
+        if u in xvecs:
+            xu[j], xu_valid[j] = xvecs[u], True
+        if i in yvecs:
+            yi[j], yi_valid[j] = yvecs[i], True
+
+    new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
+        yty_mat, xtx_mat, xu, xu_valid, yi, yi_valid, values, implicit
+    )
+    for j, (exp_xu, exp_yi) in enumerate(expected):
+        assert bool(x_upd[j]) == (exp_xu is not None), f"event {j} X"
+        assert bool(y_upd[j]) == (exp_yi is not None), f"event {j} Y"
+        if exp_xu is not None:
+            np.testing.assert_allclose(new_xu[j], exp_xu, rtol=1e-4, atol=1e-5)
+        if exp_yi is not None:
+            np.testing.assert_allclose(new_yi[j], exp_yi, rtol=1e-4, atol=1e-5)
