@@ -44,15 +44,19 @@ class FeatureVectors:
             self._vectors[id_] = vector
             self._recent_ids.add(id_)
 
-    def get_batch(self, ids: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    def get_batch(
+        self, ids: list[str], dim: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
         """Vectors for many ids: ([n, dim] float32 with zero rows for
-        misses, [n] bool valid). Interface parity with the native store."""
+        misses, [n] bool valid). Interface parity with the native store.
+        ``dim`` keeps the matrix shape well-formed when the store is empty
+        (e.g. right after a rotation removed every vector)."""
         n = len(ids)
-        dim = 0
         with self._lock.read():
             for v in self._vectors.values():
                 dim = len(v)
                 break
+            dim = dim or 0
             mat = np.zeros((n, dim), dtype=np.float32)
             valid = np.zeros(n, dtype=bool)
             for j, id_ in enumerate(ids):

@@ -152,6 +152,8 @@ class ALSServingModel(ServingModel):
 
     def retain_recent_and_item_ids(self, ids: set[str]) -> None:
         self.y.retain_recent_and_ids(ids)
+        with self._solver_lock:
+            self._yty_solver = None  # rotation invalidates the cached YtY
         with self._cache_lock:
             self._y_dirty = True
 

@@ -91,6 +91,10 @@ class ALSSpeedModel(SpeedModel):
     def retain_recent_and_ids(self, user_ids: set[str], item_ids: set[str]) -> None:
         self.x.retain_recent_and_ids(user_ids)
         self.y.retain_recent_and_ids(item_ids)
+        # rotation changes both stores: cached Gramian solvers are stale
+        with self._solver_lock:
+            self._xtx_solver = None
+            self._yty_solver = None
 
     def get_fraction_loaded(self) -> float:
         """Loaded fraction vs expected IDs (ALSSpeedModel.java:128-142)."""
@@ -176,8 +180,8 @@ class ALSSpeedModelManager(SpeedModelManager):
         n = len(agg)
         users = [u for (u, _) in agg]
         items = [i for (_, i) in agg]
-        xu, xu_valid = model.x.get_batch(users)
-        yi, yi_valid = model.y.get_batch(items)
+        xu, xu_valid = model.x.get_batch(users, dim=model.features)
+        yi, yi_valid = model.y.get_batch(items, dim=model.features)
         values = np.fromiter((v for v in agg.values()), dtype=np.float32, count=n)
         new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
             yty.matrix, xtx.matrix, xu, xu_valid, yi, yi_valid, values,

@@ -93,12 +93,15 @@ class NativeFeatureVectors:
         )
         return out if found else None
 
-    def get_batch(self, ids: list[str]) -> tuple[np.ndarray, np.ndarray]:
+    def get_batch(
+        self, ids: list[str], dim: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
         """Vectors for many ids in one native call:
-        ([n, dim] float32 with zero rows for misses, [n] bool valid)."""
+        ([n, dim] float32 with zero rows for misses, [n] bool valid).
+        ``dim`` keeps the shape well-formed when the store is empty."""
         n = len(ids)
         if self._ptr is None or n == 0:
-            return np.zeros((n, self._dim or 0), dtype=np.float32), np.zeros(n, dtype=bool)
+            return np.zeros((n, self._dim or dim or 0), dtype=np.float32), np.zeros(n, dtype=bool)
         stream = _encode_ids(ids)
         mat = np.zeros((n, self._dim), dtype=np.float32)
         valid = np.zeros(n, dtype=np.uint8)
@@ -203,7 +206,9 @@ def format_vectors_json(mat: np.ndarray) -> list[str]:
     if lib is None or n == 0:
         import json
 
-        return [json.dumps(row.tolist()) for row in mat]
+        # match the native formatter: non-finite components become 0 so the
+        # wire format stays valid JSON regardless of which path serialized
+        return [json.dumps(np.nan_to_num(row, nan=0.0, posinf=0.0, neginf=0.0).tolist()) for row in mat]
     cap = n * (2 + k * 18)
     out = np.empty(cap, dtype=np.uint8)  # no zero-fill: the C side writes
     offsets = np.empty(n + 1, dtype=np.int64)

@@ -302,8 +302,14 @@ def _fold_half(ata, vecs_own, own_valid, vecs_other, other_valid, values, implic
     rhs = d_qui[:, None] * vecs_other  # [n, k]
     chol = jax.scipy.linalg.cho_factor(ata)
     d_vec = jax.scipy.linalg.cho_solve(chol, rhs.T).T
+    # Cholesky of a near-singular AtA yields NaNs in float32 (the host
+    # Solver's QR threshold/lstsq fallback has no device analogue), so
+    # fall back to a least-squares-style pinv solve for those rows rather
+    # than publishing corrupted vectors
+    d_lstsq = (jnp.linalg.pinv(ata, rcond=1e-5) @ rhs.T).T
+    d_vec = jnp.where(jnp.isfinite(d_vec), d_vec, d_lstsq)
     new_vecs = jnp.where(own_valid[:, None], vecs_own, 0.0) + d_vec
-    updated = other_valid & ~jnp.isnan(target)
+    updated = other_valid & ~jnp.isnan(target) & jnp.all(jnp.isfinite(d_vec), axis=1)
     return jnp.where(updated[:, None], new_vecs, 0.0), updated
 
 
@@ -335,9 +341,20 @@ def _fold_half_host(ata, vecs_own, own_valid, vecs_other, other_valid, values, i
         target = values
     d_qui = np.nan_to_num(target - qui).astype(np.float32)
     rhs = d_qui[:, None] * vt
-    d_vec = np.linalg.solve(np.asarray(ata, dtype=np.float32), rhs.T).T
+    ata32 = np.asarray(ata, dtype=np.float32)
+    try:
+        d_vec = np.linalg.solve(ata32, rhs.T).T
+    except np.linalg.LinAlgError:
+        d_vec = np.full_like(rhs, np.nan)
+    # same safety net as the device path: singular/ill-conditioned AtA
+    # falls back to a pseudo-inverse solve, and rows that still come out
+    # non-finite are dropped instead of published
+    bad = ~np.isfinite(d_vec).all(axis=1)
+    if bad.any():
+        d_lstsq = (np.linalg.pinv(ata32, rcond=1e-5) @ rhs.T).T
+        d_vec = np.where(bad[:, None], d_lstsq, d_vec)
     new = np.where(own_valid[:, None], vo, 0.0) + d_vec
-    updated = other_valid & ~np.isnan(target)
+    updated = other_valid & ~np.isnan(target) & np.isfinite(d_vec).all(axis=1)
     return np.where(updated[:, None], new, 0.0).astype(np.float32, copy=False), updated
 
 

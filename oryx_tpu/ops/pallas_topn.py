@@ -1,0 +1,192 @@
+"""Pallas TPU kernel: fused item-scoring + top-k for ALS serving.
+
+The serving hot loop is "score every item against a user vector, keep the
+best k" (reference: ALSServingModel.topN / TopNConsumer.java scanning LSH
+partitions on a thread pool, VectorMath.dot per item). On TPU the exact
+scan is one matmul — but the naive XLA program (``scores = Q @ Y.T`` then
+``lax.top_k``) writes the full [b, n_items] score matrix to HBM and reads
+it back for the top-k, which at 1M+ items costs more bandwidth than
+reading the item matrix itself. This kernel fuses the two:
+
+- the item matrix is laid out feature-major ``[k_feat, n_items]`` so each
+  grid step streams a contiguous ``[k_feat, BLOCK_N]`` block of items
+  through VMEM (Mosaic double-buffers blocks across the grid);
+- each step computes ``[b, BLOCK_N]`` scores on the MXU with float32
+  accumulation (items may be stored bfloat16, halving HBM traffic);
+- a statically-unrolled iterative max reduces the block to its local
+  top-k (k is small: 10..a few hundred) entirely in VMEM;
+- only ``[num_blocks, b, k]`` candidates ever reach HBM; a final tiny
+  ``lax.top_k`` over ``num_blocks * k`` merges them.
+
+HBM traffic per batch drops from ``n*k_feat*4 + 2*b*n*4`` bytes to
+``n*k_feat*{2|4}`` — a 2-6x win for the bandwidth-bound scan.
+
+Cosine scoring divides by cached item norms in-kernel (an extra
+``[1, BLOCK_N]`` f32 stream, ~2% overhead) so ranking happens on the
+normalized scores, matching CosineAverageFunction.java semantics.
+
+On non-TPU backends the public entry points fall back to plain XLA ops;
+``interpret=True`` runs the kernel under the Pallas interpreter (used by
+the CPU test suite).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on some CPU-only builds; interpret mode needs none
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+BLOCK_N = 4096  # items per grid step; [k_feat<=256, 4096] f32 block = 4 MB VMEM
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@dataclass(frozen=True)
+class StreamingItemMatrix:
+    """Device-resident item factors in the kernel's feature-major layout."""
+
+    mat_t: jax.Array  # [k_feat, n_padded], f32 or bf16
+    norms: jax.Array  # [1, n_padded] f32 (row L2 norms, 0-padded)
+    n_items: int
+
+    @property
+    def num_features(self) -> int:
+        return self.mat_t.shape[0]
+
+
+def upload_streaming(matrix: np.ndarray, dtype=jnp.float32) -> StreamingItemMatrix:
+    """Pad items up to a BLOCK_N multiple and move [k, n] to device."""
+    n, _k = matrix.shape
+    n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
+    mat = np.asarray(matrix, dtype=np.float32)
+    norms = np.zeros((1, n_pad), dtype=np.float32)
+    norms[0, :n] = np.linalg.norm(mat, axis=1)
+    mat_t = np.zeros((matrix.shape[1], n_pad), dtype=np.float32)
+    mat_t[:, :n] = mat.T
+    return StreamingItemMatrix(
+        mat_t=jnp.asarray(mat_t, dtype=dtype),
+        norms=jnp.asarray(norms),
+        n_items=n,
+    )
+
+
+def _topn_kernel(q_ref, mat_ref, norms_ref, vals_ref, idx_ref, *, k, n_items, cosine):
+    """One grid step: score a [k_feat, BLOCK_N] item block, keep its top-k."""
+    block = pl.program_id(0)
+    q = q_ref[:]  # [b, k_feat]
+    # f32 items get true f32 accumulation (TPU default would silently drop
+    # to bf16 passes); bf16 items are the intentional fast path
+    precision = (
+        jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else jax.lax.Precision.DEFAULT
+    )
+    scores = jnp.dot(
+        q, mat_ref[:], preferred_element_type=jnp.float32, precision=precision
+    )  # [b, BLOCK_N]
+    b = scores.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (b, BLOCK_N), 1) + block * BLOCK_N
+    if cosine:
+        qn = jnp.sqrt(
+            jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32), axis=1, keepdims=True)
+        )
+        denom = jnp.maximum(norms_ref[:] * qn, 1e-12)  # [b, BLOCK_N] via broadcast
+        scores = scores / denom
+    neg_inf = jnp.float32(-jnp.inf)
+    scores = jnp.where(cols < n_items, scores, neg_inf)
+    vals_cols = []
+    idx_cols = []
+    for _ in range(k):  # k is small and static: unrolled iterative max
+        m = jnp.max(scores, axis=1, keepdims=True)  # [b, 1]
+        # first column index attaining the max (ties -> lowest id, like a
+        # stable host scan)
+        at = jnp.min(jnp.where(scores == m, cols, jnp.int32(2**31 - 1)), axis=1, keepdims=True)
+        vals_cols.append(m)
+        idx_cols.append(at)
+        scores = jnp.where(cols == at, neg_inf, scores)
+    vals_ref[0] = jnp.concatenate(vals_cols, axis=1)  # [b, k]
+    idx_ref[0] = jnp.concatenate(idx_cols, axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_items", "cosine", "interpret")
+)
+def _streaming_topk(mat_t, norms, queries, *, k, n_items, cosine, interpret):
+    k_feat, n_pad = mat_t.shape
+    b = queries.shape[0]
+    grid = n_pad // BLOCK_N
+    kernel = functools.partial(_topn_kernel, k=k, n_items=n_items, cosine=cosine)
+    common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    vals, idxs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((b, k_feat), lambda i: (0, 0), **common),
+            pl.BlockSpec((k_feat, BLOCK_N), lambda i: (0, i), **common),
+            pl.BlockSpec((1, BLOCK_N), lambda i: (0, i), **common),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0), **common),
+            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0), **common),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, b, k), jnp.float32),
+            jax.ShapeDtypeStruct((grid, b, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries.astype(mat_t.dtype), mat_t, norms)
+    # merge the per-block candidates: [b, grid * k] is tiny
+    flat_v = jnp.transpose(vals, (1, 0, 2)).reshape(b, grid * k)
+    flat_i = jnp.transpose(idxs, (1, 0, 2)).reshape(b, grid * k)
+    top_v, pos = jax.lax.top_k(flat_v, k)
+    top_i = jnp.take_along_axis(flat_i, pos, axis=1)
+    return top_v, top_i
+
+
+def top_k_streaming_device(
+    up: StreamingItemMatrix,
+    queries: np.ndarray,
+    k: int,
+    cosine: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(scores [b, k], indices [b, k]) as device arrays — the async
+    building block. ``interpret`` defaults to the Pallas interpreter on
+    non-TPU backends so the same handle works everywhere."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+    k = max(1, min(int(k), up.n_items))
+    return _streaming_topk(
+        up.mat_t,
+        up.norms,
+        jnp.asarray(q),
+        k=k,
+        n_items=up.n_items,
+        cosine=cosine,
+        interpret=interpret,
+    )
+
+
+def top_k_streaming(
+    up: StreamingItemMatrix,
+    queries: np.ndarray,
+    k: int,
+    cosine: bool = False,
+    interpret: bool | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(indices [b, k], scores [b, k]) of the best items per query row."""
+    vals, idxs = top_k_streaming_device(up, queries, k, cosine=cosine, interpret=interpret)
+    return np.asarray(idxs), np.asarray(vals)

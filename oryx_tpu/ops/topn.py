@@ -1,47 +1,101 @@
-"""Top-N scoring: one batched matvec + top_k on device.
+"""Top-N scoring: batched matvec + top_k on device.
 
 Replaces the reference's per-request thread-pool scan over LSH partitions
 (ALSServingModel.topN / TopNConsumer.java, VectorMath.dot in the hot
-loop): dot scores for ALL items are one [n, k] @ [k] matvec on the MXU,
-cosine scores normalize by cached row norms, and jax.lax.top_k returns
-the best candidates. Queries can also be batched [b, k] for concurrent
-requests.
+loop): dot scores for ALL items are computed on the MXU and top-k
+selected on device. Two device backends share one public API:
+
+- ``xla``: plain ``scores = Q @ Y.T`` + ``lax.top_k`` — simple, fine for
+  small/medium item matrices;
+- ``pallas`` (TPU): the fused streaming kernel in
+  :mod:`oryx_tpu.ops.pallas_topn`, which never materializes the [b, n]
+  score matrix in HBM and can hold items in bfloat16 — 2-6x less HBM
+  traffic at 1M+ items.
+
+``upload`` picks the backend (pallas when running on TPU, xla
+otherwise); ``top_k_scores`` / ``top_k_scores_batch`` dispatch on the
+uploaded handle's type.
+
+``submit_top_k`` is the async form: it enqueues the device computation
+and a non-blocking device→host copy, returning a handle whose
+``result()`` materializes the answer. Callers that keep several requests
+in flight (the serving layer's request pipeline, bench.py) overlap
+device compute and PCIe/tunnel transfers instead of paying a full
+round-trip per request.
 """
 
 from __future__ import annotations
 
 import functools
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oryx_tpu.ops.pallas_topn import (
+    StreamingItemMatrix,
+    top_k_streaming,
+    top_k_streaming_device,
+    upload_streaming,
+)
 
-def upload(matrix: np.ndarray):
-    """Move a packed [n, k] float32 matrix to device, with cached norms."""
-    mat = jnp.asarray(matrix, dtype=jnp.float32)
-    norms = jnp.linalg.norm(mat, axis=1)
+
+def _default_streaming() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def upload(
+    matrix: np.ndarray,
+    dtype=None,
+    streaming: bool | None = None,
+):
+    """Move a packed [n, k] float32 item matrix to device.
+
+    Returns an opaque handle for the top-k functions. On TPU the handle
+    is a :class:`StreamingItemMatrix` (feature-major layout for the
+    Pallas kernel, optionally bfloat16); elsewhere it is the plain
+    ``(matrix, norms)`` device pair for the XLA path.
+    """
+    if streaming is None:
+        streaming = _default_streaming()
+    if streaming:
+        return upload_streaming(matrix, dtype=dtype or jnp.float32)
+    mat = jnp.asarray(matrix, dtype=dtype or jnp.float32)
+    norms = jnp.linalg.norm(mat.astype(jnp.float32), axis=1)
     return mat, norms
 
 
 @functools.partial(jax.jit, static_argnums=2)
 def _dot_topk(mat, query, k):
-    scores = mat @ query
+    scores = (mat @ query).astype(jnp.float32)
     return jax.lax.top_k(scores, k)
 
 
 @functools.partial(jax.jit, static_argnums=3)
 def _cosine_topk(mat, norms, query, k):
-    qn = jnp.linalg.norm(query)
-    scores = (mat @ query) / jnp.maximum(norms * qn, 1e-12)
+    qn = jnp.linalg.norm(query.astype(jnp.float32))
+    scores = (mat @ query).astype(jnp.float32) / jnp.maximum(norms * qn, 1e-12)
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _dot_topk_batch(mat, norms, queries, k, cosine):
+    scores = (queries @ mat.T).astype(jnp.float32)  # [b, n]
+    if cosine:
+        qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
+        scores = scores / jnp.maximum(norms[None, :] * qn, 1e-12)
     return jax.lax.top_k(scores, k)
 
 
 def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
     """(indices, scores) of the k best items for one query vector."""
+    if isinstance(uploaded, StreamingItemMatrix):
+        idx, vals = top_k_streaming(uploaded, query, k, cosine=cosine)
+        return idx[0], vals[0]
     mat, norms = uploaded
     k = max(1, min(int(k), mat.shape[0]))
-    q = jnp.asarray(query, dtype=jnp.float32)
+    q = jnp.asarray(query, dtype=mat.dtype)
     if cosine:
         s, i = _cosine_topk(mat, norms, q, k)
     else:
@@ -49,16 +103,45 @@ def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
     return np.asarray(i), np.asarray(s)
 
 
-@functools.partial(jax.jit, static_argnums=2)
-def _dot_topk_batch(mat, queries, k):
-    scores = queries @ mat.T  # [b, n]
-    return jax.lax.top_k(scores, k)
-
-
-def top_k_scores_batch(uploaded, queries: np.ndarray, k: int):
+def top_k_scores_batch(uploaded, queries: np.ndarray, k: int, cosine: bool = False):
     """Batched top-k for [b, k] query vectors (concurrent requests)."""
-    mat, _ = uploaded
+    if isinstance(uploaded, StreamingItemMatrix):
+        return top_k_streaming(uploaded, queries, k, cosine=cosine)
+    mat, norms = uploaded
     k = max(1, min(int(k), mat.shape[0]))
-    q = jnp.asarray(queries, dtype=jnp.float32)
-    s, i = _dot_topk_batch(mat, q, k)
+    q = jnp.asarray(queries, dtype=mat.dtype)
+    s, i = _dot_topk_batch(mat, norms, q, k, cosine)
     return np.asarray(i), np.asarray(s)
+
+
+@dataclass
+class TopNHandle:
+    """In-flight async top-k request; ``result()`` blocks and returns
+    (indices [b, k], scores [b, k]) as numpy arrays."""
+
+    _vals: jax.Array
+    _idxs: jax.Array
+
+    def result(self) -> tuple[np.ndarray, np.ndarray]:
+        return np.asarray(self._idxs), np.asarray(self._vals)
+
+
+def submit_top_k(
+    uploaded, queries: np.ndarray, k: int, cosine: bool = False
+) -> TopNHandle:
+    """Enqueue a batched top-k without waiting: device compute and the
+    device→host copy both run asynchronously. Keeping a window of
+    handles in flight pipelines transfers behind compute."""
+    if isinstance(uploaded, StreamingItemMatrix):
+        vals, idxs = top_k_streaming_device(uploaded, queries, k, cosine=cosine)
+    else:
+        mat, norms = uploaded
+        kk = max(1, min(int(k), mat.shape[0]))
+        q = jnp.asarray(np.atleast_2d(queries), dtype=mat.dtype)
+        vals, idxs = _dot_topk_batch(mat, norms, q, kk, cosine)
+    try:
+        vals.copy_to_host_async()
+        idxs.copy_to_host_async()
+    except AttributeError:  # pragma: no cover - older array types
+        pass
+    return TopNHandle(vals, idxs)

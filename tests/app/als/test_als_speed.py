@@ -123,3 +123,27 @@ def test_no_known_items_update_format():
     ])
     updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,1.0,1")]))
     assert all(len(json.loads(u)) == 3 for u in updates)
+
+
+def test_build_updates_after_rotation_to_empty_store():
+    """Model rotation that empties a factor store must not crash the next
+    micro-batch (stale cached solvers + [n, 0] vector batches were the
+    failure mode); it degrades to emitting no updates."""
+    mgr = make_manager(implicit=True)
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    # warm the solver caches, then rotate to a model with disjoint ids:
+    # every current vector is dropped, the cached Gramians are stale
+    assert mgr.model.get_yty_solver() is not None
+    # first rotation keeps the recently-written vectors; the second (no
+    # intermediate writes) drains both stores completely
+    feed(mgr, [KeyMessage("MODEL", model_message(x_ids=("U8",), y_ids=("I8",)))])
+    feed(mgr, [KeyMessage("MODEL", model_message(x_ids=("U9",), y_ids=("I9",)))])
+    assert mgr.model.x.size() == 0 and mgr.model.y.size() == 0
+    updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
+    assert updates == []

@@ -132,8 +132,9 @@ def _scalar_fold(yty_mat, xtx_mat, events, xvecs, yvecs, implicit):
     return out
 
 
+@pytest.mark.parametrize("backend", ["host", "device"])
 @pytest.mark.parametrize("implicit", [True, False])
-def test_fold_in_batch_matches_scalar(implicit):
+def test_fold_in_batch_matches_scalar(implicit, backend):
     from oryx_tpu.ops import als as als_ops
 
     gen = np.random.default_rng(42)
@@ -166,7 +167,8 @@ def test_fold_in_batch_matches_scalar(implicit):
             yi[j], yi_valid[j] = yvecs[i], True
 
     new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
-        yty_mat, xtx_mat, xu, xu_valid, yi, yi_valid, values, implicit
+        yty_mat, xtx_mat, xu, xu_valid, yi, yi_valid, values, implicit,
+        backend=backend,
     )
     for j, (exp_xu, exp_yi) in enumerate(expected):
         assert bool(x_upd[j]) == (exp_xu is not None), f"event {j} X"
@@ -175,3 +177,27 @@ def test_fold_in_batch_matches_scalar(implicit):
             np.testing.assert_allclose(new_xu[j], exp_xu, rtol=1e-4, atol=1e-5)
         if exp_yi is not None:
             np.testing.assert_allclose(new_yi[j], exp_yi, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("backend", ["host", "device"])
+def test_fold_in_singular_gramian_never_emits_nonfinite(backend):
+    """A rank-deficient Gramian must fall back to a pseudo-inverse solve
+    (reference: LinearSystemSolver's QR threshold + Solver semantics),
+    never publish NaN/huge vectors."""
+    from oryx_tpu.ops import als as als_ops
+
+    k = 4
+    gen = np.random.default_rng(5)
+    y = np.zeros((3, k), np.float32)
+    y[:, 0] = 1.0  # rank-1 -> exactly singular YtY
+    x = gen.standard_normal((3, k)).astype(np.float32)
+    yty = y.T @ y
+    xtx = x.T @ x + 0.1 * np.eye(k, dtype=np.float32)
+    values = np.array([1.0, 2.0, 0.5], np.float32)
+    valid = np.ones(3, bool)
+    new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
+        yty, xtx, x, valid, y, valid, values, True, backend=backend
+    )
+    assert np.isfinite(new_xu).all() and np.isfinite(new_yi).all()
+    # the well-conditioned side still updates
+    assert y_upd.any()
