@@ -16,3 +16,17 @@ PMML-compatible artifacts.
 """
 
 __version__ = "0.1.0"
+
+import os as _os
+
+if "JAX_PLATFORMS" in _os.environ:
+    # honor the env var even when a site-installed accelerator plugin
+    # imported jax at interpreter startup and pinned jax_platforms (the
+    # pin would otherwise silently override JAX_PLATFORMS, making e.g. a
+    # CPU-only run hang trying to reach an unavailable accelerator)
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"] or None)
+    except Exception:  # pragma: no cover - jax absent or config renamed
+        pass

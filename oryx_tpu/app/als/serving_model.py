@@ -36,6 +36,7 @@ from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
 from oryx_tpu.native.store import make_feature_vectors
 from oryx_tpu.ops import topn as topn_ops
+from oryx_tpu.serving.batcher import get_default_batcher
 
 log = logging.getLogger(__name__)
 
@@ -234,7 +235,9 @@ class ALSServingModel(ServingModel):
             if lsh_rows is not None:
                 idx, scores = _host_top_k(y_host, lsh_rows, query, k, cosine=cosine)
             else:
-                idx, scores = topn_ops.top_k_scores(y_mat, query, k, cosine=cosine)
+                # continuous batching: concurrent requests against the same
+                # Y snapshot coalesce into one device call
+                idx, scores = get_default_batcher().score(y_mat, query, k, cosine=cosine)
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
                 id_ = ids[int(i)]

@@ -304,10 +304,18 @@ def _fold_half(ata, vecs_own, own_valid, vecs_other, other_valid, values, implic
     d_vec = jax.scipy.linalg.cho_solve(chol, rhs.T).T
     # Cholesky of a near-singular AtA yields NaNs in float32 (the host
     # Solver's QR threshold/lstsq fallback has no device analogue), so
-    # fall back to a least-squares-style pinv solve for those rows rather
-    # than publishing corrupted vectors
-    d_lstsq = (jnp.linalg.pinv(ata, rcond=1e-5) @ rhs.T).T
-    d_vec = jnp.where(jnp.isfinite(d_vec), d_vec, d_lstsq)
+    # whole rows that came out non-finite are re-solved via pseudo-inverse
+    # rather than published corrupted. lax.cond keeps the SVD off the hot
+    # path when the factorization was healthy (the common case).
+    row_ok = jnp.all(jnp.isfinite(d_vec), axis=1, keepdims=True)
+    d_vec = jax.lax.cond(
+        jnp.all(row_ok),
+        lambda d, _a, _r: d,
+        lambda d, a, r: jnp.where(row_ok, d, (jnp.linalg.pinv(a, rcond=1e-5) @ r.T).T),
+        d_vec,
+        ata,
+        rhs,
+    )
     new_vecs = jnp.where(own_valid[:, None], vecs_own, 0.0) + d_vec
     updated = other_valid & ~jnp.isnan(target) & jnp.all(jnp.isfinite(d_vec), axis=1)
     return jnp.where(updated[:, None], new_vecs, 0.0), updated

@@ -155,6 +155,28 @@ def _streaming_topk(mat_t, norms, queries, *, k, n_items, cosine, interpret):
     return top_v, top_i
 
 
+# above this k the kernel's unrolled per-block selection stops paying for
+# itself (and compile time grows with k); fall back to one XLA top_k
+MAX_KERNEL_K = 128
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_items", "cosine"))
+def _materialized_topk(mat_t, norms, queries, *, k, n_items, cosine):
+    """Large-k fallback over the same feature-major layout: materialize
+    [b, n] scores once and let XLA's top_k handle the wide selection."""
+    q = queries.astype(mat_t.dtype)
+    precision = (
+        jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else jax.lax.Precision.DEFAULT
+    )
+    scores = jnp.dot(q, mat_t, preferred_element_type=jnp.float32, precision=precision)
+    if cosine:
+        qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
+        scores = scores / jnp.maximum(norms * qn, 1e-12)
+    cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(cols < n_items, scores, -jnp.inf)
+    return jax.lax.top_k(scores, k)
+
+
 def top_k_streaming_device(
     up: StreamingItemMatrix,
     queries: np.ndarray,
@@ -169,6 +191,10 @@ def top_k_streaming_device(
         interpret = jax.default_backend() != "tpu"
     q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
     k = max(1, min(int(k), up.n_items))
+    if k > MAX_KERNEL_K:
+        return _materialized_topk(
+            up.mat_t, up.norms, jnp.asarray(q), k=k, n_items=up.n_items, cosine=cosine
+        )
     return _streaming_topk(
         up.mat_t,
         up.norms,

@@ -1,0 +1,200 @@
+"""Request micro-batcher: coalesce concurrent top-N calls into batched
+device submits.
+
+The reference parallelizes a single request across a thread pool
+(ALSServingModel.topN / ALSServingModel.java:289-335, one thread per LSH
+partition). On TPU the economics invert: one device scan is fast but each
+dispatch pays a fixed host→device→host cost, so the win comes from
+batching *across* concurrent requests instead of splitting one request.
+
+This batcher implements continuous batching, the standard accelerator
+serving pattern:
+
+- request threads enqueue (item-matrix handle, query, k, cosine) and
+  block on an event;
+- a dispatcher thread takes whatever is queued the moment it wakes —
+  no artificial wait, so an idle server adds zero batching latency —
+  groups entries by (matrix snapshot, cosine) so a model rotation
+  mid-flight can never mix row indices from different snapshots, pads
+  both k and the coalesced batch's row count to power-of-two buckets
+  (jitted programs specialize on shape — buckets keep the compiled-
+  program count logarithmic), and calls ``submit_top_k``;
+- a completer thread resolves the async handles in submission order and
+  wakes the request threads. While the device works on batch r+1, batch
+  r's results stream back — the same overlap bench.py exploits.
+
+Under load the queue naturally fills while the device is busy, so batch
+size adapts to concurrency automatically (1 request → batch of 1,
+hundreds of concurrent requests → full batches).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from oryx_tpu.ops import topn as topn_ops
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class _Entry:
+    uploaded: object
+    query: np.ndarray
+    k: int
+    cosine: bool
+    done: threading.Event = field(default_factory=threading.Event)
+    idx: np.ndarray | None = None
+    vals: np.ndarray | None = None
+    error: BaseException | None = None
+
+
+def _k_bucket(k: int) -> int:
+    return max(16, 1 << (int(k) - 1).bit_length())
+
+
+def _b_bucket(n: int) -> int:
+    """Batch-row bucket: jitted programs specialize on the batch shape, so
+    pad coalesced batches to power-of-two row counts (zero queries) to keep
+    the number of distinct compiled programs logarithmic in max_batch."""
+    return max(8, 1 << (int(n) - 1).bit_length())
+
+
+class TopNBatcher:
+    """Coalesces concurrent ``score`` calls into batched ``submit_top_k``
+    device calls. Thread-safe; one instance serves any number of models
+    (entries carry their own uploaded-matrix handle)."""
+
+    def __init__(self, max_batch: int = 256, max_inflight: int = 32) -> None:
+        self.max_batch = max_batch
+        self._queue: queue.Queue[_Entry | None] = queue.Queue()
+        self._pending: queue.Queue = queue.Queue()
+        self._inflight = threading.Semaphore(max_inflight)
+        self._state_lock = threading.Lock()  # serializes score-enqueue vs close
+        self._closed = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="TopNBatcherDispatch", daemon=True
+        )
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="TopNBatcherComplete", daemon=True
+        )
+        self._dispatcher.start()
+        self._completer.start()
+
+    # -- request side --------------------------------------------------------
+
+    def score(
+        self, uploaded, query: np.ndarray, k: int, cosine: bool = False
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(indices, scores) for one query — blocks until its batch lands."""
+        e = _Entry(uploaded, np.asarray(query, dtype=np.float32), int(k), bool(cosine))
+        with self._state_lock:  # an entry can never land after the sentinel
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._queue.put(e)
+        e.done.wait()
+        if e.error is not None:
+            raise e.error
+        return e.idx, e.vals
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _take_batch(self) -> list[_Entry] | None:
+        first = self._queue.get()
+        if first is None:
+            return None
+        batch = [first]
+        while len(batch) < self.max_batch:
+            try:
+                e = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if e is None:
+                self._queue.put(None)  # keep the shutdown signal visible
+                break
+            batch.append(e)
+        return batch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                self._pending.put(None)
+                return
+            # group by (matrix snapshot, cosine): indices are only
+            # meaningful against the snapshot the caller captured
+            groups: dict[tuple[int, bool], list[_Entry]] = {}
+            for e in batch:
+                groups.setdefault((id(e.uploaded), e.cosine), []).append(e)
+            for (_, cosine), entries in groups.items():
+                self._submit_group(entries, cosine)
+
+    def _submit_group(self, entries: list[_Entry], cosine: bool) -> None:
+        self._inflight.acquire()
+        try:
+            queries = np.stack([e.query for e in entries])
+            pad_rows = _b_bucket(len(entries)) - len(entries)
+            if pad_rows:
+                queries = np.concatenate(
+                    [queries, np.zeros((pad_rows, queries.shape[1]), queries.dtype)]
+                )
+            kk = _k_bucket(max(e.k for e in entries))
+            handle = topn_ops.submit_top_k(
+                entries[0].uploaded, queries, kk, cosine=cosine
+            )
+            self._pending.put((handle, entries))
+        except BaseException as exc:  # deliver the failure to the waiters
+            self._inflight.release()
+            for e in entries:
+                e.error = exc
+                e.done.set()
+
+    # -- completer -----------------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._pending.get()
+            if item is None:
+                return
+            handle, entries = item
+            try:
+                idx, vals = handle.result()
+                for row, e in enumerate(entries):
+                    e.idx = idx[row, : e.k]
+                    e.vals = vals[row, : e.k]
+            except BaseException as exc:
+                for e in entries:
+                    e.error = exc
+            finally:
+                self._inflight.release()
+                for e in entries:
+                    e.done.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        with self._state_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)
+        self._dispatcher.join(timeout=5)
+        self._completer.join(timeout=5)
+
+
+_default_lock = threading.Lock()
+_default: TopNBatcher | None = None
+
+
+def get_default_batcher() -> TopNBatcher:
+    """Process-wide batcher shared by all serving models."""
+    global _default
+    with _default_lock:
+        if _default is None or _default._closed:
+            _default = TopNBatcher()
+        return _default

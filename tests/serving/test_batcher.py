@@ -1,0 +1,95 @@
+"""Micro-batcher: concurrent scoring calls coalesce into batched device
+submits without changing any per-request answer."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from oryx_tpu.ops import topn as topn_ops
+from oryx_tpu.serving import batcher as batcher_mod
+from oryx_tpu.serving.batcher import TopNBatcher
+
+
+def _make(n=500, kf=8, seed=0):
+    gen = np.random.default_rng(seed)
+    y = gen.standard_normal((n, kf), dtype=np.float32)
+    return y, topn_ops.upload(y, streaming=False)
+
+
+def test_single_request_matches_direct_path():
+    y, up = _make()
+    b = TopNBatcher()
+    try:
+        q = np.arange(8, dtype=np.float32)
+        idx, vals = b.score(up, q, 5)
+        ridx, rvals = topn_ops.top_k_scores(up, q, 5)
+        np.testing.assert_array_equal(idx, ridx)
+        np.testing.assert_allclose(vals, rvals, atol=1e-5)
+    finally:
+        b.close()
+
+
+def test_concurrent_requests_batch_and_stay_correct():
+    y, up = _make(n=800, kf=12, seed=2)
+    gen = np.random.default_rng(3)
+    queries = gen.standard_normal((64, 12), dtype=np.float32)
+    b = TopNBatcher(max_batch=16)
+    results: dict[int, tuple] = {}
+    errors: list[BaseException] = []
+
+    def worker(j):
+        try:
+            results[j] = b.score(up, queries[j], 7, cosine=(j % 2 == 0))
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(j,)) for j in range(64)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        b.close()
+    assert not errors
+    assert len(results) == 64
+    for j, (idx, vals) in results.items():
+        ridx, rvals = topn_ops.top_k_scores(up, queries[j], 7, cosine=(j % 2 == 0))
+        np.testing.assert_array_equal(idx, ridx)
+        np.testing.assert_allclose(vals, rvals, atol=1e-4)
+
+
+def test_mixed_k_and_snapshots_group_safely():
+    _, up_a = _make(n=300, kf=8, seed=5)
+    _, up_b = _make(n=200, kf=8, seed=6)
+    gen = np.random.default_rng(7)
+    b = TopNBatcher()
+    results = {}
+
+    def worker(j, up, k):
+        results[(j, k)] = b.score(up, gen.standard_normal(8).astype(np.float32), k)
+
+    threads = [
+        threading.Thread(target=worker, args=(j, up_a if j % 2 else up_b, 3 + j % 5))
+        for j in range(20)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        b.close()
+    for (j, k), (idx, vals) in results.items():
+        assert len(idx) == k and len(vals) == k
+
+
+def test_closed_batcher_raises_and_default_revives():
+    b = batcher_mod.get_default_batcher()
+    b.close()
+    with pytest.raises(RuntimeError):
+        b.score(None, np.zeros(4, np.float32), 1)
+    b2 = batcher_mod.get_default_batcher()
+    assert b2 is not b and not b2._closed
+    b2.close()

@@ -1,0 +1,43 @@
+"""Tiny-size smoke of the LoadBenchmark harness (tools/load_benchmark.py):
+the synthetic-model factory + read-only manager boot a real serving layer
+and answer /recommend (reference: LoadBenchmark.java runs the same shape
+at benchmark sizes under -Pbenchmark)."""
+
+import json
+import urllib.request
+
+from oryx_tpu.common import config as C
+from oryx_tpu.serving.layer import ServingLayer
+from tools.load_benchmark import LoadTestModelManager, build_model  # noqa: F401
+
+
+def test_load_benchmark_harness_serves():
+    model = build_model(users=20, items=50, features=4)
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "LoadBenchTest"
+          input-topic.broker = "inproc://loadbench-test"
+          update-topic.broker = "inproc://loadbench-test"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+    layer = ServingLayer(cfg)
+    layer.start()
+    layer.model_manager.model = model
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{layer.port}/recommend/u0?howMany=5", timeout=10
+        ) as resp:
+            recs = json.loads(resp.read())
+        assert 0 < len(recs) <= 5
+        known = model.get_known_items("u0")
+        assert all(r["id"] not in known for r in recs)
+    finally:
+        layer.close()

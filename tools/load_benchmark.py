@@ -1,0 +1,159 @@
+"""Serving-layer load benchmark: synthetic ALS model + live HTTP traffic.
+
+Rebuild of the reference's opt-in LoadBenchmark (app/oryx-app-serving/src/
+test/.../als/LoadBenchmark.java:45-130, -Pbenchmark profile) and its
+LoadTestALSModelFactory (.../als/model/LoadTestALSModelFactory.java:34-101):
+build an ALSServingModel of `users` x `items` x `features` random factors
+with known-items, boot the real serving layer (HTTP server, model-ready
+gate, endpoint dispatch, micro-batcher, device top-N), then measure
+/recommend under concurrent client load.
+
+Usage (sizes mirror the reference's system properties
+oryx.test.als.benchmark.{users,items,features,workers}):
+
+    python tools/load_benchmark.py --users 100000 --items 1000000 \
+        --features 50 --workers 64 --seconds 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_model(users: int, items: int, features: int, seed: int = 1234):
+    """LoadTestALSModelFactory.buildTestModel: random unit-ish factors,
+    a handful of known items per user."""
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    gen = np.random.default_rng(seed)
+    model = ALSServingModel(features=features, implicit=True)
+    x = gen.standard_normal((users, features)).astype(np.float32)
+    y = gen.standard_normal((items, features)).astype(np.float32)
+    for j in range(users):
+        model.x.set_vector(f"u{j}", x[j])
+    for j in range(items):
+        model.y.set_vector(f"i{j}", y[j])
+    known_per_user = 10
+    for j in range(users):
+        model.add_known_items(
+            f"u{j}", (f"i{t}" for t in gen.integers(0, items, known_per_user))
+        )
+    return model
+
+
+class LoadTestModelManager:
+    """Minimal ServingModelManager wrapper around a prebuilt model."""
+
+    def __init__(self, config) -> None:
+        self._config = config
+        self.model = None  # injected before start
+
+    def consume(self, it):
+        for _ in it:
+            pass
+
+    def get_config(self):
+        return self._config
+
+    def get_model(self):
+        return self.model
+
+    def is_read_only(self) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--users", type=int, default=100_000)
+    ap.add_argument("--items", type=int, default=1_000_000)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--seconds", type=float, default=20.0)
+    args = ap.parse_args()
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.traffic import worker
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "LoadBench"
+          input-topic.broker = "inproc://loadbench"
+          update-topic.broker = "inproc://loadbench"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+
+    t0 = time.perf_counter()
+    model = build_model(args.users, args.items, args.features)
+    print(f"model built in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    layer = ServingLayer(cfg)
+    layer.start()
+    layer.model_manager.model = model
+    base = f"http://127.0.0.1:{layer.port}"
+    try:
+        # warm: first request uploads Y to device and compiles the kernel
+        import urllib.request
+
+        t0 = time.perf_counter()
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+        print(f"warm request (upload+compile): {time.perf_counter() - t0:.1f}s", flush=True)
+
+        latencies: list[float] = []
+        errors: list[float] = []
+        stop = threading.Event()
+        deadline = time.perf_counter() + args.seconds
+        threads = [
+            threading.Thread(
+                target=worker,
+                args=(base, "/recommend/u%d", args.users, deadline, latencies, errors, stop),
+                daemon=True,
+            )
+            for _ in range(args.workers)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        lat = sorted(latencies)
+        n = len(lat)
+        if not n:
+            print(f"no successful requests ({len(errors)} errors)")
+            return
+
+        def pct(p: float) -> float:
+            return lat[min(n - 1, int(p * n))] * 1000
+
+        print(
+            f"/recommend: {n} ok, {len(errors)} failed | "
+            f"{n / elapsed:.1f} qps x {args.workers} workers | "
+            f"latency ms mean {sum(lat) / n * 1000:.1f} p50 {pct(0.5):.1f} "
+            f"p90 {pct(0.9):.1f} p99 {pct(0.99):.1f}"
+        )
+    finally:
+        layer.close()
+
+
+if __name__ == "__main__":
+    main()

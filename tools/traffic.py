@@ -1,0 +1,88 @@
+"""HTTP load generator for the serving layer.
+
+Rebuild of the reference's TrafficUtil (app/oryx-app-serving/src/test/
+.../traffic/TrafficUtil.java:56- with ALSEndpoint): hammer a running
+serving instance with concurrent requests and report throughput plus a
+latency histogram (mean/p50/p90/p99, like TrafficUtil's DescriptiveStats
+logging).
+
+Usage:
+    python tools/traffic.py http://host:port /recommend/u%d \
+        --users 1000 --workers 64 --seconds 30
+
+The path template gets a random user index substituted for %d per
+request. Any endpoint works; defaults exercise /recommend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import threading
+import time
+import urllib.request
+
+
+def worker(base: str, template: str, users: int, deadline: float,
+           latencies: list, errors: list, stop: threading.Event) -> None:
+    rng = random.Random(threading.get_ident())
+    while time.perf_counter() < deadline and not stop.is_set():
+        path = template % rng.randrange(users) if "%d" in template else template
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(base + path, timeout=30) as resp:
+                resp.read()
+                ok = 200 <= resp.status < 300
+        except Exception:
+            ok = False
+        dt = time.perf_counter() - t0
+        (latencies if ok else errors).append(dt)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("base", help="base URL, e.g. http://127.0.0.1:8080")
+    ap.add_argument("template", nargs="?", default="/recommend/u%d")
+    ap.add_argument("--users", type=int, default=1000)
+    ap.add_argument("--workers", type=int, default=64)
+    ap.add_argument("--seconds", type=float, default=20.0)
+    args = ap.parse_args()
+
+    latencies: list[float] = []
+    errors: list[float] = []
+    stop = threading.Event()
+    deadline = time.perf_counter() + args.seconds
+    threads = [
+        threading.Thread(
+            target=worker,
+            args=(args.base, args.template, args.users, deadline, latencies, errors, stop),
+            daemon=True,
+        )
+        for _ in range(args.workers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    lat = sorted(latencies)
+    n = len(lat)
+    if n == 0:
+        print(f"no successful requests ({len(errors)} errors)")
+        return
+
+    def pct(p: float) -> float:
+        return lat[min(n - 1, int(p * n))] * 1000
+
+    print(
+        f"requests: {n} ok, {len(errors)} failed | "
+        f"{n / elapsed:.1f} qps over {elapsed:.1f}s x {args.workers} workers\n"
+        f"latency ms: mean {sum(lat) / n * 1000:.1f}  p50 {pct(0.50):.1f}  "
+        f"p90 {pct(0.90):.1f}  p99 {pct(0.99):.1f}  max {lat[-1] * 1000:.1f}"
+    )
+
+
+if __name__ == "__main__":
+    main()
