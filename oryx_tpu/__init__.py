@@ -18,15 +18,17 @@ PMML-compatible artifacts.
 __version__ = "0.1.0"
 
 import os as _os
+import sys as _sys
 
-if "JAX_PLATFORMS" in _os.environ:
-    # honor the env var even when a site-installed accelerator plugin
+if "JAX_PLATFORMS" in _os.environ and "jax" in _sys.modules:
+    # honor the env var when a site-installed accelerator plugin already
     # imported jax at interpreter startup and pinned jax_platforms (the
     # pin would otherwise silently override JAX_PLATFORMS, making e.g. a
-    # CPU-only run hang trying to reach an unavailable accelerator)
+    # CPU-only run hang trying to reach an unavailable accelerator). If
+    # jax is not yet imported, its own env handling honors the variable.
     try:
-        import jax as _jax
-
-        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"] or None)
-    except Exception:  # pragma: no cover - jax absent or config renamed
+        _sys.modules["jax"].config.update(
+            "jax_platforms", _os.environ["JAX_PLATFORMS"] or None
+        )
+    except Exception:  # pragma: no cover - config renamed
         pass

@@ -81,6 +81,23 @@ def train_kmeans(
     else:
         centers0 = _kmeans_parallel_init(points, k, gen)
 
+    if mesh is None and jax.default_backend() == "tpu":
+        # single-device TPU: the fused Pallas sweep reads the points once
+        # per iteration (no [n, k] distance matrix in HBM) — provided the
+        # block working set (points + centers/sums + distance/one-hot
+        # blocks, double-buffered) fits VMEM; huge k*d falls back to XLA
+        from oryx_tpu.ops.pallas_kmeans import BLOCK_N, _ceil_to
+
+        kp = max(8, _ceil_to(k, 8))
+        vmem_bytes = 4 * 2 * (BLOCK_N * d + 2 * kp * d + 2 * BLOCK_N * kp + kp)
+        if vmem_bytes <= 12 * 1024 * 1024:
+            from oryx_tpu.ops.pallas_kmeans import lloyd_pallas
+
+            centers, counts, cost = lloyd_pallas(
+                points, centers0.astype(np.float32), iterations
+            )
+            return centers, counts, cost
+
     num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     n_pad = pad_to_multiple(n, num_shards)
     if n_pad != n:

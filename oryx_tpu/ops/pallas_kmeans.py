@@ -1,0 +1,151 @@
+"""Pallas TPU kernel: one fused Lloyd sweep for k-means.
+
+The XLA formulation of a Lloyd iteration (ops/kmeans.py:_lloyd_run —
+distance matmul, argmin, then segment_sum) walks the points array twice
+and materializes the [n, k] distance matrix in HBM. This kernel fuses the
+whole sweep into a single pass:
+
+- grid over point blocks; centers stay resident in VMEM across steps;
+- each step computes the block's squared distances on the MXU, takes the
+  per-point argmin, and immediately reduces the block into partial
+  centroid sums via a one-hot matmul ``onehot(assign).T @ points`` (MXU
+  again) plus per-cluster counts and the block's cost;
+- partials accumulate into the kernel outputs across sequential grid
+  steps (TPU grids execute in order on a core), so HBM sees the points
+  exactly once per sweep and only [k, d] + [k] + [1] results ever come
+  back.
+
+The reference delegates this loop to Spark MLlib's KMeans
+(app/oryx-app-mllib/.../kmeans/KMeansUpdate.java:116-117), where each
+iteration is a cluster-wide map-reduce; here an iteration is one kernel
+launch. Used by train_kmeans on TPU; the XLA path remains for meshes
+(auto-sharded) and non-TPU backends, and tests run this kernel under the
+Pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+BLOCK_N = 1024
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _sweep_kernel(pts_ref, ctr_ref, sums_ref, counts_ref, cost_ref, *, n_items, k_real):
+    i = pl.program_id(0)
+    pts = pts_ref[:]  # [B, d]
+    ctr = ctr_ref[:]  # [kp, d]
+    b = pts.shape[0]
+    kp = ctr.shape[0]
+    precision = jax.lax.Precision.HIGHEST
+    d2 = (
+        jnp.sum(pts * pts, axis=1, keepdims=True)
+        - 2.0 * jnp.dot(pts, ctr.T, preferred_element_type=jnp.float32, precision=precision)
+        + jnp.sum(ctr * ctr, axis=1)[None, :]
+    )  # [B, kp]
+    col = jax.lax.broadcasted_iota(jnp.int32, (b, kp), 1)
+    d2 = jnp.where(col < k_real, d2, jnp.float32(jnp.inf))  # padded centers lose
+    row_global = jax.lax.broadcasted_iota(jnp.int32, (b, 1), 0) + i * b
+    valid = row_global < n_items  # [B, 1] padding rows contribute nothing
+    mind2 = jnp.min(d2, axis=1, keepdims=True)  # [B, 1]
+    # first center attaining the min (stable tie-break, like jnp.argmin)
+    amin = jnp.min(jnp.where(d2 == mind2, col, jnp.int32(2**31 - 1)), axis=1, keepdims=True)
+    onehot = ((col == amin) & valid).astype(jnp.float32)  # [B, kp]
+    psums = jnp.dot(onehot.T, pts, preferred_element_type=jnp.float32, precision=precision)
+    pcounts = jnp.sum(onehot, axis=0)[None, :]  # [1, kp]
+    pcost = jnp.sum(jnp.where(valid, jnp.maximum(mind2, 0.0), 0.0))
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = psums
+        counts_ref[:] = pcounts
+        cost_ref[0, 0] = pcost
+
+    @pl.when(i > 0)
+    def _():
+        sums_ref[:] += psums
+        counts_ref[:] += pcounts
+        cost_ref[0, 0] += pcost
+
+
+@functools.partial(jax.jit, static_argnames=("n_items", "k_real", "interpret"))
+def _sweep(points, centers, *, n_items, k_real, interpret):
+    """One fused assignment+reduction pass. points [n_pad, d] (rows beyond
+    n_items are padding), centers [kp, d] (rows beyond k_real are padding).
+    Returns (sums [kp, d], counts [kp], cost)."""
+    n_pad, d = points.shape
+    kp = centers.shape[0]
+    grid = n_pad // BLOCK_N
+    kernel = functools.partial(_sweep_kernel, n_items=n_items, k_real=k_real)
+    common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    sums, counts, cost = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_N, d), lambda i: (i, 0), **common),
+            pl.BlockSpec((kp, d), lambda i: (0, 0), **common),
+        ],
+        out_specs=[
+            pl.BlockSpec((kp, d), lambda i: (0, 0), **common),
+            pl.BlockSpec((1, kp), lambda i: (0, 0), **common),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), **common),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, kp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(points, centers)
+    return sums, counts[0], cost[0, 0]
+
+
+def lloyd_pallas(
+    points: np.ndarray,
+    centers0: np.ndarray,
+    iterations: int,
+    interpret: bool | None = None,
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """Lloyd iterations via the fused sweep; returns (centers, counts, cost)
+    with the same semantics as ops.kmeans._lloyd_run (final counts/cost
+    measured against the final centers)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    points = np.asarray(points, dtype=np.float32)
+    n, d = points.shape
+    k = centers0.shape[0]
+    n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
+    kp = max(8, _ceil_to(k, 8))
+    if n_pad != n:
+        points = np.concatenate([points, np.zeros((n_pad - n, d), np.float32)])
+    ctr = np.zeros((kp, d), np.float32)
+    ctr[:k] = centers0
+    pts_dev = jnp.asarray(points)
+    ctr_dev = jnp.asarray(ctr)
+    for _ in range(iterations):
+        sums, counts, _ = _sweep(pts_dev, ctr_dev, n_items=n, k_real=k, interpret=interpret)
+        ctr_dev = jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], ctr_dev
+        )
+    sums, counts, cost = _sweep(pts_dev, ctr_dev, n_items=n, k_real=k, interpret=interpret)
+    return (
+        np.asarray(ctr_dev[:k]),
+        np.asarray(counts[:k]),
+        float(cost),
+    )

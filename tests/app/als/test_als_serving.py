@@ -289,3 +289,21 @@ def test_console_served_at_root(server):
     assert b"ALS serving console" in body
     status2, body2, _ = http("GET", f"{base}/index.html")
     assert status2 == 200 and body2 == body
+
+
+def test_score_dtype_config_reaches_model():
+    """oryx.als.serving.score-dtype plumbs from config into the model's
+    device upload choice (bfloat16 halves serving HBM traffic)."""
+    from oryx_tpu.app.als.serving_model import ALSServingModelManager
+    from oryx_tpu.common import config as C
+
+    cfg = C.get_default().with_overlay(
+        'oryx.als.serving.score-dtype = "bfloat16"\noryx.als.implicit = true'
+    )
+    mgr = ALSServingModelManager(cfg)
+    assert mgr.score_dtype == "bfloat16"
+    model = ALSServingModel(4, True, score_dtype="bfloat16")
+    model.set_item_vector("i1", np.array([1, 0, 0, 0], np.float32))
+    model.set_user_vector("u1", np.array([1, 0, 0, 0], np.float32))
+    out = model.top_n(np.array([1, 0, 0, 0], np.float32), 1)
+    assert out and out[0][0] == "i1"

@@ -63,12 +63,12 @@ def test_concurrent_requests_batch_and_stay_correct():
 def test_mixed_k_and_snapshots_group_safely():
     _, up_a = _make(n=300, kf=8, seed=5)
     _, up_b = _make(n=200, kf=8, seed=6)
-    gen = np.random.default_rng(7)
+    queries = np.random.default_rng(7).standard_normal((20, 8)).astype(np.float32)
     b = TopNBatcher()
     results = {}
 
     def worker(j, up, k):
-        results[(j, k)] = b.score(up, gen.standard_normal(8).astype(np.float32), k)
+        results[(j, k)] = b.score(up, queries[j], k)
 
     threads = [
         threading.Thread(target=worker, args=(j, up_a if j % 2 else up_b, 3 + j % 5))

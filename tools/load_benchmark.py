@@ -84,7 +84,7 @@ def main() -> None:
 
     from oryx_tpu.common import config as C
     from oryx_tpu.serving.layer import ServingLayer
-    from tools.traffic import worker
+    from tools.traffic import report, worker
 
     cfg = C.get_default().with_overlay(
         """
@@ -136,21 +136,7 @@ def main() -> None:
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
-        lat = sorted(latencies)
-        n = len(lat)
-        if not n:
-            print(f"no successful requests ({len(errors)} errors)")
-            return
-
-        def pct(p: float) -> float:
-            return lat[min(n - 1, int(p * n))] * 1000
-
-        print(
-            f"/recommend: {n} ok, {len(errors)} failed | "
-            f"{n / elapsed:.1f} qps x {args.workers} workers | "
-            f"latency ms mean {sum(lat) / n * 1000:.1f} p50 {pct(0.5):.1f} "
-            f"p90 {pct(0.9):.1f} p99 {pct(0.99):.1f}"
-        )
+        report(latencies, errors, elapsed, args.workers, label="/recommend")
     finally:
         layer.close()
 

@@ -39,6 +39,26 @@ def worker(base: str, template: str, users: int, deadline: float,
         (latencies if ok else errors).append(dt)
 
 
+def report(latencies: list[float], errors: list[float], elapsed: float,
+           workers: int, label: str = "requests") -> None:
+    """Throughput + latency percentile summary (TrafficUtil's stats log)."""
+    lat = sorted(latencies)
+    n = len(lat)
+    if n == 0:
+        print(f"{label}: no successful requests ({len(errors)} errors)")
+        return
+
+    def pct(p: float) -> float:
+        return lat[min(n - 1, int(p * n))] * 1000
+
+    print(
+        f"{label}: {n} ok, {len(errors)} failed | "
+        f"{n / elapsed:.1f} qps over {elapsed:.1f}s x {workers} workers\n"
+        f"latency ms: mean {sum(lat) / n * 1000:.1f}  p50 {pct(0.50):.1f}  "
+        f"p90 {pct(0.90):.1f}  p99 {pct(0.99):.1f}  max {lat[-1] * 1000:.1f}"
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("base", help="base URL, e.g. http://127.0.0.1:8080")
@@ -66,22 +86,7 @@ def main() -> None:
     for t in threads:
         t.join()
     elapsed = time.perf_counter() - t0
-
-    lat = sorted(latencies)
-    n = len(lat)
-    if n == 0:
-        print(f"no successful requests ({len(errors)} errors)")
-        return
-
-    def pct(p: float) -> float:
-        return lat[min(n - 1, int(p * n))] * 1000
-
-    print(
-        f"requests: {n} ok, {len(errors)} failed | "
-        f"{n / elapsed:.1f} qps over {elapsed:.1f}s x {args.workers} workers\n"
-        f"latency ms: mean {sum(lat) / n * 1000:.1f}  p50 {pct(0.50):.1f}  "
-        f"p90 {pct(0.90):.1f}  p99 {pct(0.99):.1f}  max {lat[-1] * 1000:.1f}"
-    )
+    report(latencies, errors, elapsed, args.workers)
 
 
 if __name__ == "__main__":
