@@ -314,6 +314,11 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.sample_rate = config.get_float("oryx.als.sample-rate")
         self.score_dtype = config.get_string("oryx.als.serving.score-dtype")
+        if self.score_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"oryx.als.serving.score-dtype must be float32 or bfloat16, "
+                f"got {self.score_dtype!r}"
+            )
         self.rescorer_provider = _load_rescorer_providers(config)
         self.model: ALSServingModel | None = None
         self._consumed = 0

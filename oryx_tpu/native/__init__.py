@@ -103,10 +103,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.c_char_p, c.c_int64, c.POINTER(c.c_int64), c.c_int,
     ]
     lib.fs_vtv.argtypes = [c.c_void_p, c.POINTER(c.c_double)]
-    lib.fs_retain.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    lib.fs_retain.argtypes = [c.c_void_p, c.POINTER(c.c_int64), c.c_char_p, c.c_int64]
     lib.fs_get_batch.restype = c.c_int64
     lib.fs_get_batch.argtypes = [
-        c.c_void_p, c.c_char_p, c.c_int64, c.c_int64,
+        c.c_void_p, c.POINTER(c.c_int64), c.c_char_p, c.c_int64,
         c.POINTER(c.c_float), c.POINTER(c.c_uint8),
     ]
     lib.json_format_vectors.restype = c.c_int64
@@ -119,7 +119,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.als_format_updates.restype = c.c_int64
     lib.als_format_updates.argtypes = [
         c.POINTER(c.c_float), c.c_int64, c.c_int64,
-        c.c_char_p, c.c_int64, c.c_char_p, c.c_int64,
+        c.POINTER(c.c_int64), c.c_char_p, c.POINTER(c.c_int64), c.c_char_p,
         c.c_char, c.c_int, c.c_int64, c.POINTER(c.c_char),
         c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
     ]

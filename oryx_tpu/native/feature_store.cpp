@@ -267,20 +267,16 @@ void fs_vtv(void* p, double* out) {
 }
 
 // Rotation reconciliation (FeatureVectors.retainRecentAndIDs:131-136): keep
-// ids present in the new model (length-prefixed `keep` stream) OR written
-// since the last rotation, then reset recency.
-void fs_retain(void* p, const char* keep, int64_t keep_len) {
+// ids present in the new model OR written since the last rotation, then
+// reset recency. Ids arrive as (offsets[n+1], payload): id i is
+// payload[offsets[i]..offsets[i+1]) — offsets build vectorized in numpy,
+// unlike the per-id length-prefix packing this replaces.
+void fs_retain(void* p, const int64_t* offs, const char* payload, int64_t n) {
   auto* s = static_cast<Store*>(p);
   std::unordered_set<std::string> keep_set;
-  const char* q = keep;
-  const char* end = keep + keep_len;
-  while (q + sizeof(uint32_t) <= end) {
-    uint32_t len;
-    std::memcpy(&len, q, sizeof(len));
-    q += sizeof(len);
-    if (q + len > end) break;  // truncated stream: ignore the tail
-    keep_set.emplace(q, len);
-    q += len;
+  keep_set.reserve(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) {
+    keep_set.emplace(payload + offs[i], static_cast<size_t>(offs[i + 1] - offs[i]));
   }
   for (auto& sh : s->shards) {
     std::unique_lock lock(sh.mu);
@@ -300,23 +296,16 @@ void fs_retain(void* p, const char* keep, int64_t keep_len) {
   }
 }
 
-// Batched lookup: ids as a length-prefixed stream, vectors written to
+// Batched lookup: ids as (offsets, payload), vectors written to
 // out_mat[n][dim] (rows for missing ids left untouched), out_valid[i]
 // set 1/0. One lock acquisition per id, no Python between lookups —
 // the speed layer fetches every event's user+item vector in one call.
-int64_t fs_get_batch(void* p, const char* ids, int64_t ids_len, int64_t n,
-                     float* out_mat, uint8_t* out_valid) {
+int64_t fs_get_batch(void* p, const int64_t* offs, const char* payload,
+                     int64_t n, float* out_mat, uint8_t* out_valid) {
   auto* s = static_cast<Store*>(p);
-  const char* q = ids;
-  const char* end = ids + ids_len;
-  int64_t i = 0;
-  for (; i < n && q + sizeof(uint32_t) <= end; ++i) {
-    uint32_t len;
-    std::memcpy(&len, q, sizeof(len));
-    q += sizeof(len);
-    if (q + len > end) break;
-    std::string key(q, len);
-    q += len;
+  std::string key;
+  for (int64_t i = 0; i < n; ++i) {
+    key.assign(payload + offs[i], static_cast<size_t>(offs[i + 1] - offs[i]));
     Shard& sh = s->shard_for(key);
     std::shared_lock lock(sh.mu);
     auto it = sh.index.find(key);
@@ -328,8 +317,7 @@ int64_t fs_get_batch(void* p, const char* ids, int64_t ids_len, int64_t n,
       out_valid[i] = 1;
     }
   }
-  for (int64_t j = i; j < n; ++j) out_valid[j] = 0;
-  return i;
+  return n;
 }
 
 // Format n rows of float32 [n][k] as JSON number arrays "[v,v,...]" with
@@ -451,28 +439,6 @@ inline char* float_append(char* w, float f) {
   return w;
 }
 
-struct IdView {
-  const char* p;
-  uint32_t len;
-};
-
-// parse a length-prefixed id stream into views (no copies)
-std::vector<IdView> parse_id_stream(const char* ids, int64_t ids_len, int64_t n) {
-  std::vector<IdView> out;
-  out.reserve(n);
-  const char* q = ids;
-  const char* end = ids + ids_len;
-  while (static_cast<int64_t>(out.size()) < n && q + sizeof(uint32_t) <= end) {
-    uint32_t len;
-    std::memcpy(&len, q, sizeof(len));
-    q += sizeof(len);
-    if (q + len > end) break;
-    out.push_back({q, len});
-    q += len;
-  }
-  return out;
-}
-
 }  // namespace
 
 // Per-row worst case for als_format_updates' fixed stride.
@@ -480,24 +446,17 @@ int64_t als_update_row_cap(int64_t k, int64_t max_id_len) {
   return 16 + 2 * (6 * max_id_len + 2) + 2 + k * 18;
 }
 
-// matrix_tag: 'X' or 'Y'. ids/other_ids: length-prefixed streams of n ids.
-// include_known: emit the trailing [otherId] element. out must hold
+// matrix_tag: 'X' or 'Y'. ids/other_ids arrive as (offsets[n+1], payload)
+// pairs. include_known: emit the trailing [otherId] element. out must hold
 // n * als_update_row_cap(k, max_id_len) bytes. Each thread writes its
 // rows back-to-back inside its own region; regions are then compacted so
-// the result is one contiguous byte run. Returns total bytes, or -1 on a
-// malformed id stream.
+// the result is one contiguous byte run. Returns total bytes.
 int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
-                           const char* ids, int64_t ids_len,
-                           const char* other_ids, int64_t other_ids_len,
+                           const int64_t* id_offs, const char* id_payload,
+                           const int64_t* other_offs, const char* other_payload,
                            char matrix_tag, int include_known,
                            int64_t max_id_len, char* out,
                            int64_t* starts, int64_t* ends, int64_t num_threads) {
-  std::vector<IdView> id_views = parse_id_stream(ids, ids_len, n);
-  std::vector<IdView> other_views = parse_id_stream(other_ids, other_ids_len, n);
-  if (static_cast<int64_t>(id_views.size()) < n ||
-      (include_known && static_cast<int64_t>(other_views.size()) < n)) {
-    return -1;
-  }
   if (n == 0) return 0;
   const int64_t stride = als_update_row_cap(k, max_id_len);
   if (num_threads < 1) num_threads = 1;
@@ -513,7 +472,8 @@ int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
       *w++ = matrix_tag;
       *w++ = '"';
       *w++ = ',';
-      w = json_escape_append(w, id_views[i].p, id_views[i].len);
+      w = json_escape_append(w, id_payload + id_offs[i],
+                             static_cast<uint32_t>(id_offs[i + 1] - id_offs[i]));
       *w++ = ',';
       *w++ = '[';
       const float* row = mat + i * k;
@@ -525,7 +485,8 @@ int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
       if (include_known) {
         *w++ = ',';
         *w++ = '[';
-        w = json_escape_append(w, other_views[i].p, other_views[i].len);
+        w = json_escape_append(w, other_payload + other_offs[i],
+                               static_cast<uint32_t>(other_offs[i + 1] - other_offs[i]));
         *w++ = ']';
       }
       *w++ = ']';

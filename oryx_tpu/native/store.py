@@ -33,13 +33,22 @@ def _decode_ids(buf: bytes) -> list[str]:
     return ids
 
 
-def _encode_ids(ids: Iterable[str]) -> bytes:
-    out = bytearray()
-    for id_ in ids:
-        b = id_.encode("utf-8")
-        out += struct.pack("<I", len(b))
-        out += b
-    return bytes(out)
+def _offsets_payload(ids: list[str]) -> tuple[np.ndarray, bytes]:
+    """Ids for the native ABI as (offsets[n+1] int64, concatenated utf-8
+    payload): id i is payload[offsets[i]:offsets[i+1]]. Builds in a few
+    vectorized passes — the length-prefix interleaving this replaces cost
+    a Python loop with a struct.pack per id, which dominated the speed
+    layer's serialization profile at 100k-event micro-batches."""
+    n = len(ids)
+    bs = [s.encode("utf-8") for s in ids]
+    offs = np.zeros(n + 1, dtype=np.int64)
+    if n:
+        np.cumsum(np.fromiter(map(len, bs), np.int64, count=n), out=offs[1:])
+    return offs, b"".join(bs)
+
+
+def _offsets_ptr(offs: np.ndarray):
+    return offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
 class NativeFeatureVectors:
@@ -102,13 +111,13 @@ class NativeFeatureVectors:
         n = len(ids)
         if self._ptr is None or n == 0:
             return np.zeros((n, self._dim or dim or 0), dtype=np.float32), np.zeros(n, dtype=bool)
-        stream = _encode_ids(ids)
+        offs, payload = _offsets_payload(ids)
         mat = np.zeros((n, self._dim), dtype=np.float32)
         valid = np.zeros(n, dtype=np.uint8)
         self._lib.fs_get_batch(
             self._ptr,
-            stream,
-            len(stream),
+            _offsets_ptr(offs),
+            payload,
             n,
             mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             valid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -185,8 +194,8 @@ class NativeFeatureVectors:
     def retain_recent_and_ids(self, new_model_ids: Iterable[str]) -> None:
         if self._ptr is None:
             return
-        stream = _encode_ids(new_model_ids)
-        self._lib.fs_retain(self._ptr, stream, len(stream))
+        offs, payload = _offsets_payload(list(new_model_ids))
+        self._lib.fs_retain(self._ptr, _offsets_ptr(offs), payload, len(offs) - 1)
 
     def get_vtv(self) -> np.ndarray | None:
         if self._ptr is None or self.size() == 0:
@@ -249,24 +258,19 @@ def format_update_messages(
     n, k = mat.shape
     if n == 0:
         return []
-    def encode_stream(strs: list[str]) -> tuple[bytes, int, bool]:
-        out = bytearray()
-        max_len = 1
-        ascii_ = True
-        for s in strs:
-            b = s.encode("utf-8")
-            if len(b) != len(s):
-                ascii_ = False
-            if len(b) > max_len:
-                max_len = len(b)
-            out += struct.pack("<I", len(b))
-            out += b
-        return bytes(out), max_len, ascii_
-
-    ids_stream, max_a, ascii_a = encode_stream(ids)
-    other_stream, max_b, ascii_b = encode_stream(other_ids if include_known else [])
-    all_ascii = ascii_a and ascii_b
-    max_id_len = max(max_a, max_b)
+    if len(ids) != n or (include_known and len(other_ids) != n):
+        return None  # malformed pairing; the native side trusts the lengths
+    id_offs, id_payload = _offsets_payload(ids)
+    other_offs, other_payload = _offsets_payload(other_ids if include_known else [""] * n)
+    # ascii payloads mean byte offsets == char offsets when slicing output
+    all_ascii = len(id_payload) == sum(map(len, ids)) and (
+        not include_known or len(other_payload) == sum(map(len, other_ids))
+    )
+    max_id_len = max(
+        1,
+        int(np.diff(id_offs).max()) if n else 1,
+        int(np.diff(other_offs).max()) if n else 1,
+    )
     stride = int(lib.als_update_row_cap(k, max_id_len))
     out = np.empty(n * stride, dtype=np.uint8)
     starts = np.empty(n, dtype=np.int64)
@@ -276,10 +280,10 @@ def format_update_messages(
         mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         n,
         k,
-        ids_stream,
-        len(ids_stream),
-        other_stream,
-        len(other_stream),
+        _offsets_ptr(id_offs),
+        id_payload,
+        _offsets_ptr(other_offs),
+        other_payload,
         tag.encode("ascii"),
         1 if include_known else 0,
         max_id_len,

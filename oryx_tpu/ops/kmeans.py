@@ -83,16 +83,11 @@ def train_kmeans(
 
     if mesh is None and jax.default_backend() == "tpu":
         # single-device TPU: the fused Pallas sweep reads the points once
-        # per iteration (no [n, k] distance matrix in HBM) — provided the
-        # block working set (points + centers/sums + distance/one-hot
-        # blocks, double-buffered) fits VMEM; huge k*d falls back to XLA
-        from oryx_tpu.ops.pallas_kmeans import BLOCK_N, _ceil_to
+        # per iteration (no [n, k] distance matrix in HBM); huge k*d whose
+        # working set would overflow VMEM falls back to the XLA path
+        from oryx_tpu.ops.pallas_kmeans import fits_vmem, lloyd_pallas
 
-        kp = max(8, _ceil_to(k, 8))
-        vmem_bytes = 4 * 2 * (BLOCK_N * d + 2 * kp * d + 2 * BLOCK_N * kp + kp)
-        if vmem_bytes <= 12 * 1024 * 1024:
-            from oryx_tpu.ops.pallas_kmeans import lloyd_pallas
-
+        if fits_vmem(k, d):
             centers, counts, cost = lloyd_pallas(
                 points, centers0.astype(np.float32), iterations
             )

@@ -47,6 +47,15 @@ def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def fits_vmem(k: int, d: int, budget_bytes: int = 12 * 1024 * 1024) -> bool:
+    """Whether one sweep's block working set (points block + centers/sums
+    + distance and one-hot blocks, double-buffered) fits the VMEM budget.
+    Lives here so the estimate tracks the kernel's actual shapes."""
+    kp = max(8, _ceil_to(k, 8))
+    working = 4 * 2 * (BLOCK_N * d + 2 * kp * d + 2 * BLOCK_N * kp + kp)
+    return working <= budget_bytes
+
+
 def _sweep_kernel(pts_ref, ctr_ref, sums_ref, counts_ref, cost_ref, *, n_items, k_real):
     i = pl.program_id(0)
     pts = pts_ref[:]  # [B, d]

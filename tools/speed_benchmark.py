@@ -1,0 +1,92 @@
+"""Speed-layer fold-in benchmark: events/sec through build_updates.
+
+Measures the full micro-batch path of ALSSpeedModelManager.build_updates
+(parse → aggregate → batched two-sided fold-in solve → update
+serialization) on a synthetic model, end to end from raw input lines —
+the BASELINE.json target is 100k events/sec sustained.
+
+Usage:
+    python tools/speed_benchmark.py --events 100000 --features 50 \
+        --users 50000 --items 10000 [--backend auto|host|device]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--events", type=int, default=100_000)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--users", type=int, default=50_000)
+    ap.add_argument("--items", type=int, default=10_000)
+    ap.add_argument("--backend", default="auto", choices=["auto", "host", "device"])
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from oryx_tpu.app.als.speed import ALSSpeedModelManager
+    from oryx_tpu.bus.core import KeyMessage
+    from oryx_tpu.common import config as C
+
+    cfg = C.get_default().with_overlay(
+        "oryx.als.implicit = true\n"
+        f'oryx.speed.fold-in-backend = "{args.backend}"'
+    )
+    mgr = ALSSpeedModelManager(cfg)
+
+    gen = np.random.default_rng(42)
+    t0 = time.perf_counter()
+    from oryx_tpu.app.pmml import add_extension, add_extension_content
+    from oryx_tpu.common import pmml as pmml_io
+
+    root = pmml_io.build_skeleton_pmml()
+    add_extension(root, "features", args.features)
+    add_extension(root, "implicit", "true")
+    add_extension_content(root, "XIDs", [f"u{j}" for j in range(args.users)])
+    add_extension_content(root, "YIDs", [f"i{j}" for j in range(args.items)])
+    mgr.consume(iter([KeyMessage("MODEL", pmml_io.to_string(root))]))
+    x = gen.standard_normal((args.users, args.features)).astype(np.float32)
+    y = gen.standard_normal((args.items, args.features)).astype(np.float32)
+    for j in range(args.users):
+        mgr.model.x.set_vector(f"u{j}", x[j])
+    for j in range(args.items):
+        mgr.model.y.set_vector(f"i{j}", y[j])
+    print(f"model loaded in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    def batch_lines(n):
+        u = gen.integers(0, args.users, n)
+        i = gen.integers(0, args.items, n)
+        v = 1.0 + gen.random(n)
+        return [
+            KeyMessage(None, f"u{uu},i{ii},{vv:.3f},{t}")
+            for t, (uu, ii, vv) in enumerate(zip(u, i, v))
+        ]
+
+    # warm (compiles the device path if selected)
+    list(mgr.build_updates(batch_lines(min(args.events, 4096))))
+
+    best = 0.0
+    for _ in range(args.reps):
+        lines = batch_lines(args.events)
+        t0 = time.perf_counter()
+        out = list(mgr.build_updates(lines))
+        dt = time.perf_counter() - t0
+        best = max(best, args.events / dt)
+        print(
+            f"{args.events} events -> {len(out)} updates in {dt:.3f}s "
+            f"({args.events / dt:,.0f} events/sec)",
+            flush=True,
+        )
+    print(f"best: {best:,.0f} events/sec (backend={args.backend})")
+
+
+if __name__ == "__main__":
+    main()
