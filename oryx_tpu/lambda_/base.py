@@ -38,6 +38,12 @@ class AbstractLayer:
         # starts at latest (reference.conf:14-20 comment).
         self.group_id = f"OryxGroup-{layer_name}" + (f"-{self.id}" if self.id else "")
         self._stop_event = threading.Event()
+        # multi-host: join the JAX multi-controller runtime before any
+        # backend is touched, so jax.devices() spans the whole pod slice
+        # (no-op unless oryx.batch.compute.distributed.* is configured)
+        from oryx_tpu.parallel.distributed import maybe_initialize
+
+        maybe_initialize(config)
 
     # -- topics -------------------------------------------------------------
 

@@ -1,0 +1,70 @@
+"""Multi-host (multi-process) JAX initialization.
+
+The reference scales out via YARN containers coordinated by Spark; the
+TPU-native equivalent is JAX's multi-controller runtime: every host in a
+pod slice runs the same layer process, calls
+``jax.distributed.initialize``, and from then on ``jax.devices()`` spans
+the whole slice — the trainers' ``shard_map``/``NamedSharding`` programs
+then run collectives over ICI/DCN with no further coordination code.
+
+Configuration (all optional — absent means single-process):
+
+- ``oryx.batch.compute.distributed.coordinator-address`` — host:port of
+  process 0; also honored from $ORYX_COORDINATOR.
+- ``oryx.batch.compute.distributed.num-processes`` / $ORYX_NUM_PROCESSES
+- ``oryx.batch.compute.distributed.process-id`` / $ORYX_PROCESS_ID
+
+On TPU pods, all three can be omitted when the environment provides
+them (jax.distributed.initialize() auto-detects on Cloud TPU); setting
+just ``auto = true`` opts into that detection.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def maybe_initialize(config) -> bool:
+    """Initialize jax.distributed when configured; returns True if this
+    process is now (or already was) part of a multi-process runtime."""
+    global _initialized
+    if _initialized:
+        return True
+    coord = (
+        config.get("oryx.batch.compute.distributed.coordinator-address", None)
+        or os.environ.get("ORYX_COORDINATOR")
+    )
+    nproc = (
+        config.get("oryx.batch.compute.distributed.num-processes", None)
+        or os.environ.get("ORYX_NUM_PROCESSES")
+    )
+    pid = config.get("oryx.batch.compute.distributed.process-id", None)
+    if pid is None:
+        pid = os.environ.get("ORYX_PROCESS_ID")
+    auto = bool(config.get("oryx.batch.compute.distributed.auto", False))
+    if coord is None and not auto:
+        return False
+
+    import jax
+
+    if coord is None:
+        jax.distributed.initialize()  # Cloud TPU auto-detection
+    else:
+        jax.distributed.initialize(
+            coordinator_address=str(coord),
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+    _initialized = True
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        len(jax.devices()),
+    )
+    return True

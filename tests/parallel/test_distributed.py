@@ -1,0 +1,83 @@
+"""Multi-host bootstrap: two OS processes join one JAX multi-controller
+runtime via oryx config and run a cross-process reduction (the
+TPU-pod-slice topology, exercised on CPU)."""
+
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+_PROC = textwrap.dedent(
+    """
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from oryx_tpu.common import config as C
+    from oryx_tpu.parallel.distributed import maybe_initialize
+
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    cfg = C.get_default().with_overlay(
+        'oryx.batch.compute.distributed {{\\n'
+        f'  coordinator-address = "127.0.0.1:{{port}}"\\n'
+        '  num-processes = 2\\n'
+        f'  process-id = {{pid}}\\n'
+        '}}'
+    )
+    assert maybe_initialize(cfg)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), np.ones((1,), np.float32) * (pid + 1), (2,)
+    )
+    total = jax.jit(lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P()))(arr)
+    assert float(total) == 3.0, float(total)
+    print("DIST_OK", pid)
+    """
+).format(repo=str(REPO))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_runtime(tmp_path):
+    script = tmp_path / "proc.py"
+    script.write_text(_PROC)
+    port = str(_free_port())
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    import os
+
+    env.update({k: v for k, v in os.environ.items() if k not in env})
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # no virtual device splitting across processes
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(pid), port],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            env=env,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+        assert f"DIST_OK {pid}" in out
