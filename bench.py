@@ -16,21 +16,36 @@ request pipeline runs concurrent clients.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Resilience: the benchmark body runs in a child process. The parent
+preflights backend initialization and retries on transient UNAVAILABLE
+errors (TPU backend setup through the tunnel can fail or hang once) with
+a fresh process each time — JAX caches a failed backend for the life of
+the process, so in-process retry is useless. If the TPU never comes up
+within the attempt budget the bench falls back to CPU so the round still
+records a number, with the backend named in the metric string.
+
 Env knobs (LoadTestALSModelFactory-style): ORYX_BENCH_ITEMS,
 ORYX_BENCH_FEATURES, ORYX_BENCH_USERS, ORYX_BENCH_SECONDS,
 ORYX_BENCH_BATCH (request batch size), ORYX_BENCH_DEPTH (in-flight
-batches), ORYX_BENCH_DTYPE (bfloat16|float32).
+batches), ORYX_BENCH_DTYPE (bfloat16|float32), ORYX_BENCH_ATTEMPTS,
+ORYX_BENCH_INIT_TIMEOUT (per-attempt backend init timeout, seconds).
 """
 
 import json
 import os
+import subprocess
+import sys
 import time
 from collections import deque
 
-import numpy as np
+
+# --------------------------------------------------------------------------
+# Child: the actual benchmark body. Assumes the backend is importable; any
+# backend failure here is caught by the parent and retried.
+# --------------------------------------------------------------------------
 
 
-def main() -> None:
+def run_bench() -> None:
     items = int(os.environ.get("ORYX_BENCH_ITEMS", 1_000_000))
     features = int(os.environ.get("ORYX_BENCH_FEATURES", 50))
     users = int(os.environ.get("ORYX_BENCH_USERS", 4096))
@@ -41,7 +56,27 @@ def main() -> None:
     how_many = 10
     baseline_qps = 437.0  # reference: LSH 0.3, 50 feat x 1M items
 
+    import numpy as np
+    import jax
+
+    # A site-installed accelerator plugin may import jax at interpreter
+    # startup and pin jax_platforms, silently overriding $JAX_PLATFORMS —
+    # so a CPU-fallback child would still try (and hang on) the TPU
+    # backend. Re-assert the env var on the live config.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    ndev = len(jax.devices())
+    print(f"bench: backend={backend} devices={ndev}", file=sys.stderr)
+
+    if backend != "tpu":
+        # CPU fallback: keep the model shape honest but shrink the timed
+        # window so the run completes promptly.
+        seconds = min(seconds, 5.0)
+        depth = min(depth, 8)
 
     from oryx_tpu.ops import topn as topn_ops
 
@@ -52,7 +87,9 @@ def main() -> None:
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     uploaded = topn_ops.upload(y, dtype=dtype)
     # warm up / compile
+    t0 = time.perf_counter()
     topn_ops.submit_top_k(uploaded, x[:batch], how_many).result()
+    print(f"bench: warmup/compile {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     served = 0
     inflight: deque = deque()
@@ -76,13 +113,21 @@ def main() -> None:
     elapsed = time.perf_counter() - start
     qps = served / elapsed
 
+    # HBM-bandwidth utilization diagnostic (the scan is bandwidth-bound):
+    # each submitted batch reads the full item matrix once; `i` counts
+    # submitted (and by now drained) batches, partial or full.
+    bytes_per_scan = items * features * (2 if dtype_name == "bfloat16" else 4)
+    gbps = i * bytes_per_scan / elapsed / 1e9
+    print(f"bench: achieved ~{gbps:.1f} GB/s effective item-matrix read bandwidth", file=sys.stderr)
+
+    tag = "" if backend == "tpu" else f", {backend} FALLBACK"
     print(
         json.dumps(
             {
                 "metric": (
                     f"ALS recommend top-{how_many} qps, exact scan "
                     f"({features} feat x {items} items, {dtype_name}, "
-                    f"batch {batch} x depth {depth})"
+                    f"batch {batch} x depth {depth}{tag})"
                 ),
                 "value": round(qps, 1),
                 "unit": "recs/sec",
@@ -92,5 +137,104 @@ def main() -> None:
     )
 
 
+# --------------------------------------------------------------------------
+# Parent: preflight + retry harness.
+# --------------------------------------------------------------------------
+
+
+def _diagnose_stray_processes() -> None:
+    """Best-effort: list other python processes that might hold the chip."""
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,etime,command"], capture_output=True, text=True, timeout=10
+        ).stdout
+        me = os.getpid()
+        for line in out.splitlines():
+            if ("python" in line or "libtpu" in line) and str(me) not in line.split()[:1]:
+                if any(k in line for k in ("jax", "tpu", "bench", "oryx")):
+                    print(f"bench[diag]: possible chip holder: {line.strip()}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover - diagnostics only
+        print(f"bench[diag]: ps failed: {e}", file=sys.stderr)
+
+
+def _run_child(env: dict, timeout: float) -> tuple[int, str, str]:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        return proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        return -9, (e.stdout or ""), (e.stderr or "") + "\n[parent] child timed out"
+
+
+def main() -> None:
+    attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 4))
+    init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 600))
+    bench_seconds = float(os.environ.get("ORYX_BENCH_SECONDS", 10.0))
+    # init_timeout bounds backend bring-up + compile; the child also needs
+    # the timed window and data generation on top of that.
+    child_timeout = init_timeout + bench_seconds + 120
+
+    base_env = dict(os.environ)
+    base_env["ORYX_BENCH_CHILD"] = "1"
+    cpu_fallback = attempts > 1 or os.environ.get("JAX_PLATFORMS") == "cpu"
+
+    backoffs = [15, 30, 60, 90]
+    attempt = 0
+    while attempt < attempts:
+        last = attempt == attempts - 1
+        env = dict(base_env)
+        label = "tpu"
+        if last and cpu_fallback:
+            # Last resort: record a CPU number rather than nothing.
+            env["JAX_PLATFORMS"] = "cpu"
+            label = "cpu-fallback"
+        print(f"bench[parent]: attempt {attempt + 1}/{attempts} ({label})", file=sys.stderr)
+        rc, out, err = _run_child(env, timeout=child_timeout)
+        sys.stderr.write(err[-4000:])
+        json_line = None
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                json_line = line
+        if rc == 0 and json_line:
+            print(json_line)
+            return
+        transient = any(
+            k in err or k in out
+            for k in ("UNAVAILABLE", "Unable to initialize backend", "DEADLINE_EXCEEDED", "timed out")
+        )
+        print(
+            f"bench[parent]: attempt {attempt + 1} failed rc={rc} "
+            f"({'transient backend error' if transient else 'non-transient'})",
+            file=sys.stderr,
+        )
+        _diagnose_stray_processes()
+        if not transient and not last:
+            # Deterministic failure: retrying the same thing is pointless —
+            # jump straight to the final (cpu-fallback) attempt.
+            print("bench[parent]: skipping to final attempt", file=sys.stderr)
+            attempt = attempts - 1
+            continue
+        next_is_cpu = cpu_fallback and attempt + 1 == attempts - 1
+        if not last and not next_is_cpu:
+            # no point waiting for the TPU to recover when the next attempt
+            # is the forced-CPU fallback
+            wait = backoffs[min(attempt, len(backoffs) - 1)]
+            print(f"bench[parent]: retrying in {wait}s", file=sys.stderr)
+            time.sleep(wait)
+        attempt += 1
+
+    print("bench[parent]: all attempts failed — no benchmark number this round", file=sys.stderr)
+    sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("ORYX_BENCH_CHILD"):
+        run_bench()
+    else:
+        main()

@@ -26,9 +26,19 @@ if "JAX_PLATFORMS" in _os.environ and "jax" in _sys.modules:
     # pin would otherwise silently override JAX_PLATFORMS, making e.g. a
     # CPU-only run hang trying to reach an unavailable accelerator). If
     # jax is not yet imported, its own env handling honors the variable.
+    _jax = _sys.modules["jax"]
     try:
-        _sys.modules["jax"].config.update(
-            "jax_platforms", _os.environ["JAX_PLATFORMS"] or None
-        )
-    except Exception:  # pragma: no cover - config renamed
-        pass
+        _current = _jax.config.jax_platforms
+    except AttributeError:  # pragma: no cover - config renamed
+        _current = None
+    _desired = _os.environ["JAX_PLATFORMS"] or None
+    if _current != _desired:
+        try:
+            _jax.config.update("jax_platforms", _desired)
+            import logging as _logging
+
+            _logging.getLogger(__name__).info(
+                "overriding jax_platforms=%r with $JAX_PLATFORMS=%r", _current, _desired
+            )
+        except AttributeError:  # pragma: no cover - config renamed
+            pass

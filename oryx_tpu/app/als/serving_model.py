@@ -36,7 +36,7 @@ from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
 from oryx_tpu.native.store import make_feature_vectors
 from oryx_tpu.ops import topn as topn_ops
-from oryx_tpu.serving.batcher import get_default_batcher
+from oryx_tpu.serving.batcher import score_default
 
 log = logging.getLogger(__name__)
 
@@ -248,7 +248,7 @@ class ALSServingModel(ServingModel):
             else:
                 # continuous batching: concurrent requests against the same
                 # Y snapshot coalesce into one device call
-                idx, scores = get_default_batcher().score(y_mat, query, k, cosine=cosine)
+                idx, scores = score_default(y_mat, query, k, cosine=cosine)
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
                 id_ = ids[int(i)]

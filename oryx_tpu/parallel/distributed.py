@@ -50,6 +50,22 @@ def maybe_initialize(config) -> bool:
     if coord is None and not auto:
         return False
 
+    if coord is not None:
+        missing = [
+            name
+            for name, val in (
+                ("num-processes ($ORYX_NUM_PROCESSES)", nproc),
+                ("process-id ($ORYX_PROCESS_ID)", pid),
+            )
+            if val is None
+        ]
+        if missing:
+            raise ValueError(
+                "oryx.batch.compute.distributed.coordinator-address is set but "
+                + " and ".join(missing)
+                + " is missing; all three are required for explicit multi-process init"
+            )
+
     import jax
 
     if coord is None:

@@ -42,6 +42,12 @@ from oryx_tpu.ops import topn as topn_ops
 log = logging.getLogger(__name__)
 
 
+class BatcherClosedError(RuntimeError):
+    """Raised by ``score`` when the batcher was closed before the entry
+    could be enqueued; distinguishes the benign close race from device
+    errors so ``score_default`` never retries a real failure."""
+
+
 @dataclass
 class _Entry:
     uploaded: object
@@ -91,11 +97,16 @@ class TopNBatcher:
     def score(
         self, uploaded, query: np.ndarray, k: int, cosine: bool = False
     ) -> tuple[np.ndarray, np.ndarray]:
-        """(indices, scores) for one query — blocks until its batch lands."""
+        """(indices, scores) for one query — blocks until its batch lands.
+
+        When ``k`` exceeds the uploaded matrix's item count the device call
+        clamps it, so fewer than ``k`` rows come back — same contract as
+        ``top_k_scores``. Raises ``RuntimeError`` if the batcher is closed
+        (callers going through :func:`score_default` get a retry)."""
         e = _Entry(uploaded, np.asarray(query, dtype=np.float32), int(k), bool(cosine))
         with self._state_lock:  # an entry can never land after the sentinel
             if self._closed:
-                raise RuntimeError("batcher is closed")
+                raise BatcherClosedError("batcher is closed")
             self._queue.put(e)
         e.done.wait()
         if e.error is not None:
@@ -191,10 +202,74 @@ _default_lock = threading.Lock()
 _default: TopNBatcher | None = None
 
 
+_atexit_registered = False
+
+
 def get_default_batcher() -> TopNBatcher:
-    """Process-wide batcher shared by all serving models."""
-    global _default
+    """Process-wide batcher shared by all serving models. Lazily created
+    (and re-created after a close); an atexit hook closes whatever default
+    is live at interpreter shutdown so late re-creations — e.g. a request
+    draining after the last serving layer released the batcher — cannot
+    leak threads past process teardown."""
+    global _default, _atexit_registered
     with _default_lock:
         if _default is None or _default._closed:
             _default = TopNBatcher()
+            if not _atexit_registered:
+                import atexit
+
+                atexit.register(close_default_batcher)
+                _atexit_registered = True
         return _default
+
+
+def score_default(
+    uploaded, query: np.ndarray, k: int, cosine: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """``get_default_batcher().score(...)`` retried across close races: a
+    concurrent ``close`` can flip ``_closed`` between the lookup and the
+    enqueue, in which case the lookup is repeated against the replacement
+    batcher. Only :class:`BatcherClosedError` is retried — device errors
+    propagate immediately."""
+    for attempt in range(4):
+        try:
+            return get_default_batcher().score(uploaded, query, k, cosine=cosine)
+        except BatcherClosedError:
+            if attempt == 3:
+                raise
+    raise AssertionError("unreachable")
+
+
+_default_refs = 0
+
+
+def retain_default_batcher() -> None:
+    """Register a user of the process-wide batcher (serving-layer start)."""
+    global _default_refs
+    with _default_lock:
+        _default_refs += 1
+
+
+def release_default_batcher() -> None:
+    """Drop a reference; the batcher is closed when the last serving layer
+    in the process releases it (so one layer's close cannot kill a batcher
+    another live layer is using)."""
+    global _default, _default_refs
+    with _default_lock:
+        _default_refs = max(0, _default_refs - 1)
+        if _default_refs > 0:
+            return
+        batcher, _default = _default, None
+    if batcher is not None:
+        batcher.close()
+
+
+def close_default_batcher() -> None:
+    """Unconditionally shut down the process-wide batcher (tests,
+    process teardown)."""
+    global _default, _default_refs
+    with _default_lock:
+        batcher, _default = _default, None
+        _default_refs = 0
+    if batcher is not None:
+        batcher.close()

@@ -139,6 +139,10 @@ class ServingLayer:
     # -- lifecycle (ModelManagerListener.contextInitialized analogue) -------
 
     def start(self) -> None:
+        from oryx_tpu.serving.batcher import retain_default_batcher
+
+        retain_default_batcher()
+        self._batcher_retained = True
         cfg = self.config
         input_broker_loc = cfg.get_optional_string("oryx.input-topic.broker")
         input_topic = cfg.get_optional_string("oryx.input-topic.message.topic")
@@ -195,6 +199,9 @@ class ServingLayer:
             self._server_thread.join(timeout)
 
     def close(self) -> None:
+        if getattr(self, "_close_done", False):
+            return
+        self._close_done = True
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -207,6 +214,11 @@ class ServingLayer:
             self.model_manager.close()
         if self.input_producer is not None:
             self.input_producer.close()
+        if getattr(self, "_batcher_retained", False):
+            self._batcher_retained = False
+            from oryx_tpu.serving.batcher import release_default_batcher
+
+            release_default_batcher()
 
     def __enter__(self) -> "ServingLayer":
         self.start()
