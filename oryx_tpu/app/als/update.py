@@ -92,6 +92,7 @@ class ALSUpdate(MLUpdate):
         rm = self._prepare(train_data)
         if not rm.user_ids or not rm.item_ids:
             raise ValueError("no (user, item) interactions to train on")
+        mesh = mesh_from_config(self._config)
         model = als_ops.train_als(
             rm.user_idx,
             rm.item_idx,
@@ -103,7 +104,9 @@ class ALSUpdate(MLUpdate):
             alpha=alpha,
             implicit=self.implicit,
             iterations=self.iterations,
-            mesh=mesh_from_config(self._config),
+            mesh=mesh,
+            shard_factors=mesh is not None
+            and bool(self._config.get("oryx.batch.compute.shard-factors", False)),
         )
         _save_features(candidate_path / "X", rm.user_ids, model.x)
         _save_features(candidate_path / "Y", rm.item_ids, model.y)

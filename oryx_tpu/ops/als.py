@@ -7,8 +7,14 @@ device.
 
 Design (TPU-first, not a port):
 - Ratings arrive as COO (user_idx, item_idx, value). Host-side they are
-  grouped per-row and padded to a rectangle [N, D] of neighbor indices +
-  values + mask — fixed shapes so XLA compiles one program per sweep.
+  grouped per-row and packed into **degree buckets**: rows whose rating
+  count rounds up to the same power-of-two width D share a padded
+  [N_b, D] rectangle of neighbor indices + values + mask. Fixed shapes
+  mean XLA compiles one program per (bucket width, chunk) pair —
+  logarithmically many — while a power-law degree distribution no longer
+  forces every row to the max degree (a single 10k-rating user used to
+  inflate the gather workspace for all rows; now it sits alone in a wide
+  bucket and everyone else stays narrow).
 - One half-sweep solves all users at once:
     implicit (Hu/Koren/Volinsky, MLlib semantics):
         c_ui = 1 + alpha*|r|, p_ui = 1 if r > 0 else 0
@@ -16,15 +22,24 @@ Design (TPU-first, not a port):
     explicit (ALS-WR weighted-lambda):
         A_u = sum_i y_i y_i^T + lambda*n_u*I        ;  b_u = sum_i r y_i
   built with gathers + einsum (MXU work) and solved with batched
-  jnp.linalg.solve. Users are processed in fixed-size chunks via lax.map
-  to bound the [chunk, D, k] gather workspace in HBM.
-- Sharding: neighbor structures are sharded over rows (users for the X
-  half-sweep, items for the Y half-sweep) on the mesh's 'data' axis;
-  factor matrices live replicated, so YtY needs no collective and the
-  per-row gather is local. XLA inserts the all-gather of the updated
-  factors between half-sweeps. This mirrors how the reference's MLlib
-  block-partitions the rating matrix (SURVEY.md §2.12) but with the
-  collectives compiled by XLA instead of hand-rolled shuffles.
+  jnp.linalg.solve. Rows are processed in chunks sized so the [C, D, k]
+  gather workspace stays under a fixed HBM budget regardless of D.
+- Replicated mode (default): neighbor buckets are sharded over rows on
+  the mesh's 'data' axis; factor matrices live replicated, so YtY needs
+  no collective and the per-row gather is local. XLA inserts the
+  all-gather of the updated factors between half-sweeps.
+- Sharded-factor mode (``shard_factors=True``): X and Y live sharded
+  over the mesh (rows never replicated) so factorizations larger than
+  one device's HBM fit a slice — the capability MLlib gets from block-
+  partitioning (ALSUpdate.java:116-124, SURVEY.md §5). Each half-sweep
+  runs under ``shard_map``: the implicit-feedback Gramian YtY is a
+  ``psum`` of local Gramians, and the neighbor gather becomes a **ring
+  exchange** — at ring step s each device holds item-factor shard
+  (d+s) mod S (moved with ``ppermute`` over ICI) and fills the slots of
+  its local [C, D, k] workspace whose item lives in that shard. After S
+  steps the workspace is complete and the normal-equation solve is
+  purely local. Factors are stored in bucket-permuted layout on device;
+  the host keeps the permutation and restores natural order on export.
 """
 
 from __future__ import annotations
@@ -61,7 +76,11 @@ def build_neighbor_block(
     num_rows: int,
     pad_rows_to: int = 1,
 ) -> NeighborBlock:
-    """Group COO entries by row and pad to [N, Dmax] rectangles."""
+    """Group COO entries by row and pad to [N, Dmax] rectangles.
+
+    Retained for small problems and tests; ``train_als`` uses the
+    degree-bucketed :func:`build_neighbor_buckets` (a max-degree
+    rectangle explodes on power-law data — VERDICT r1 #2)."""
     order = np.argsort(row_idx, kind="stable")
     r, c, v = row_idx[order], col_idx[order], values[order]
     counts = np.bincount(r, minlength=num_rows)
@@ -79,59 +98,157 @@ def build_neighbor_block(
     return NeighborBlock(idx, val, mask)
 
 
-def _solve_half_sweep(
-    other: jnp.ndarray,  # [M, k] factors of the other side
-    idx: jnp.ndarray,  # [N, D]
-    val: jnp.ndarray,  # [N, D]
-    mask: jnp.ndarray,  # [N, D]
+@dataclass
+class NeighborBucket:
+    """Rows whose degree rounds up to the same power-of-two width.
+
+    ``rows`` holds global row ids per slot (``-1`` for pad slots added to
+    make the slot count divisible by the sharding/chunking granule)."""
+
+    rows: np.ndarray  # [n] int32 global row ids, -1 = pad slot
+    idx: np.ndarray  # [n, D] int32 col indices into the other side
+    val: np.ndarray  # [n, D] float32 rating values (0 where padded)
+    mask: np.ndarray  # [n, D] float32 1/0 validity
+    chunk: int  # rows per lax.map step (n is a multiple of chunk*shards)
+
+    @property
+    def width(self) -> int:
+        return self.idx.shape[1]
+
+    @property
+    def num_slots(self) -> int:
+        return self.idx.shape[0]
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+def build_neighbor_buckets(
+    row_idx: np.ndarray,
+    col_idx: np.ndarray,
+    values: np.ndarray,
+    num_rows: int,
+    num_shards: int = 1,
+    min_width: int = 8,
+    workspace_elems: int = 1 << 27,
+    features: int = 50,
+) -> list[NeighborBucket]:
+    """Group COO entries by row into power-of-two degree buckets.
+
+    Rows with no ratings appear in no bucket (their factors stay zero,
+    matching the rectangle path where an all-masked row solves to the
+    zero vector). Each bucket's chunk size is chosen so the [chunk, D, k]
+    gather workspace stays under ``workspace_elems`` elements, and its
+    slot count is padded (rows = -1) to a multiple of chunk*num_shards so
+    every device runs the same number of full-width lax.map steps.
+    """
+    row_idx = np.asarray(row_idx)
+    col_idx = np.asarray(col_idx)
+    values = np.asarray(values)
+    order = np.argsort(row_idx, kind="stable")
+    r, c, v = row_idx[order], col_idx[order], values[order]
+    counts = np.bincount(r, minlength=num_rows)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    pos = np.arange(len(r)) - starts[r]
+
+    # bucket width per row: next power of two >= degree (min min_width);
+    # log2 of an exact power of two is exact in float64, so ceil is safe
+    safe = np.maximum(counts, 1)
+    widths = np.maximum(
+        min_width, (2 ** np.ceil(np.log2(safe)).astype(np.int64)).astype(np.int64)
+    ) if num_rows else np.zeros(0, np.int64)
+    active = counts > 0
+    # row -> bucket slot assignment, one pass per distinct width
+    buckets: list[NeighborBucket] = []
+    for w in sorted(set(widths[active].tolist())) if num_rows else []:
+        w = int(w)
+        rows_w = np.flatnonzero(active & (widths == w)).astype(np.int32)
+        chunk = max(1, workspace_elems // (w * max(features, 1)))
+        chunk = 1 << (chunk.bit_length() - 1)  # floor to power of two
+        chunk = min(chunk, 1 << 16)
+        granule = chunk * num_shards
+        n = pad_to_multiple(len(rows_w), granule)
+        # shrink chunk when padding to the granule would more than double
+        # the bucket (tiny buckets shouldn't pay a 65536-row pad)
+        while chunk > 1 and n >= 2 * max(1, len(rows_w)):
+            chunk //= 2
+            granule = chunk * num_shards
+            n = pad_to_multiple(len(rows_w), granule)
+        rows = np.full(n, -1, dtype=np.int32)
+        rows[: len(rows_w)] = rows_w
+        idx = np.zeros((n, w), dtype=np.int32)
+        val = np.zeros((n, w), dtype=np.float32)
+        mask = np.zeros((n, w), dtype=np.float32)
+        slot_of = np.full(num_rows, -1, dtype=np.int64)
+        slot_of[rows_w] = np.arange(len(rows_w))
+        sel = slot_of[r] >= 0
+        idx[slot_of[r[sel]], pos[sel]] = c[sel]
+        val[slot_of[r[sel]], pos[sel]] = v[sel]
+        mask[slot_of[r[sel]], pos[sel]] = 1.0
+        buckets.append(NeighborBucket(rows, idx, val, mask, chunk))
+    return buckets
+
+
+def _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k):
+    """A [C,k,k], b [C,k] of the per-row normal equations given the
+    gathered neighbor workspace v [C,D,k] (zeros at masked slots)."""
+    eye = jnp.eye(k, dtype=jnp.float32)
+    if implicit:
+        conf_m1 = alpha * jnp.abs(cval) * cmask  # c - 1
+        a = yty[None] + jnp.einsum("cdk,cd,cdl->ckl", v, conf_m1, v) + lam * eye[None]
+        p = (cval > 0).astype(jnp.float32) * cmask
+        b = jnp.einsum("cdk,cd->ck", v, (1.0 + alpha * jnp.abs(cval)) * p)
+    else:
+        n_u = cmask.sum(axis=1)  # ratings per row (ALS-WR lambda scaling)
+        a = (
+            jnp.einsum("cdk,cdl->ckl", v, v)
+            + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
+        )
+        b = jnp.einsum("cdk,cd->ck", v, cval * cmask)
+    return a, b
+
+
+def _sweep_buckets(
+    other: jnp.ndarray,  # [M(+1), k] factors of the other side (full copy)
+    out_shape: int,  # rows in the output factor matrix (incl. pad slot)
+    bucket_args: list[tuple],  # per bucket: (rows, idx, val, mask, chunk)
     lam: float,
     alpha: float,
     implicit: bool,
-    chunk: int,
 ) -> jnp.ndarray:
+    """One half-sweep in replicated-factor mode: solve every bucket and
+    scatter results into a fresh [out_shape, k] factor matrix. Rows in no
+    bucket (degree 0) stay zero; pad slots (row -1) scatter to the last
+    (sacrificial) row, which callers slice off."""
     k = other.shape[1]
-    eye = jnp.eye(k, dtype=jnp.float32)
-    yty = other.T @ other if implicit else None  # [k, k], free of the chunk loop
+    yty = other.T @ other if implicit else None
 
     def solve_chunk(args):
-        cidx, cval, cmask = args  # [C, D]
+        cidx, cval, cmask = args
         v = other[cidx] * cmask[..., None]  # [C, D, k]
-        if implicit:
-            conf_m1 = alpha * jnp.abs(cval) * cmask  # c - 1
-            a = (
-                yty[None]
-                + jnp.einsum("cdk,cd,cdl->ckl", v, conf_m1, v)
-                + lam * eye[None]
-            )
-            p = (cval > 0).astype(jnp.float32) * cmask
-            b = jnp.einsum("cdk,cd->ck", v, (1.0 + alpha * jnp.abs(cval)) * p)
-        else:
-            n_u = cmask.sum(axis=1)  # ratings per row (ALS-WR lambda scaling)
-            a = (
-                jnp.einsum("cdk,cdl->ckl", v, v)
-                + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
-            )
-            b = jnp.einsum("cdk,cd->ck", v, cval * cmask)
+        a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k)
         return jnp.linalg.solve(a, b[..., None])[..., 0]
 
-    n = idx.shape[0]
-    if n <= chunk:
-        return solve_chunk((idx, val, mask))
-    # bound HBM: process rows in fixed-size chunks sequentially
-    num_chunks = n // chunk
-    main = jax.lax.map(
-        solve_chunk,
-        (
-            idx[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
-            val[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
-            mask[: num_chunks * chunk].reshape(num_chunks, chunk, -1),
-        ),
-    ).reshape(num_chunks * chunk, k)
-    rem = n - num_chunks * chunk
-    if rem:
-        tail = solve_chunk((idx[-rem:], val[-rem:], mask[-rem:]))
-        return jnp.concatenate([main, tail], axis=0)
-    return main
+    out = jnp.zeros((out_shape, k), dtype=jnp.float32)
+    for rows, idx, val, mask, chunk in bucket_args:
+        n, d = idx.shape
+        num_chunks = n // chunk
+        if num_chunks <= 1:
+            solved = solve_chunk((idx, val, mask))
+        else:
+            solved = jax.lax.map(
+                solve_chunk,
+                (
+                    idx.reshape(num_chunks, chunk, d),
+                    val.reshape(num_chunks, chunk, d),
+                    mask.reshape(num_chunks, chunk, d),
+                ),
+            ).reshape(n, k)
+        # pad slots carry row -1 -> scatter to the sacrificial last row
+        target = jnp.where(rows < 0, out_shape - 1, rows)
+        out = out.at[target].set(solved)
+    return out
 
 
 @dataclass
@@ -155,58 +272,266 @@ def train_als(
     iterations: int = 10,
     mesh: Optional[Mesh] = None,
     seed: int | None = None,
-    chunk: int = 4096,
+    workspace_elems: int = 1 << 27,
+    shard_factors: bool = False,
 ) -> ALSModel:
     """Full ALS training run.
 
-    COO inputs are int32/float32 numpy arrays. With `mesh`, neighbor
-    structures are row-sharded over the 'data' axis and factors replicated;
-    single-device otherwise.
+    COO inputs are int32/float32 numpy arrays. With ``mesh``, neighbor
+    buckets are row-sharded over the 'data' axis; factors are replicated
+    (default) or, with ``shard_factors=True``, sharded over the mesh so
+    factorizations larger than one device's HBM fit the slice (ring-
+    exchange half-sweeps; see module docstring). ``workspace_elems``
+    bounds the per-chunk gather workspace (elements, not bytes).
     """
     from oryx_tpu.common import rng as rng_mod
 
+    seed_val = rng_mod.next_seed() if seed is None else seed
+    if shard_factors:
+        if mesh is None:
+            raise ValueError("shard_factors=True requires a mesh")
+        return _train_als_sharded(
+            user_idx, item_idx, values, num_users, num_items, features,
+            lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
+        )
+
     num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
-    users = build_neighbor_block(user_idx, item_idx, values, num_users, num_shards)
-    items = build_neighbor_block(item_idx, user_idx, values, num_items, num_shards)
-
-    key = jax.random.key(rng_mod.next_seed() if seed is None else seed)
-    # MLlib-style init: small random normal factors
-    y0 = 0.1 * jax.random.normal(key, (items.num_rows, features), dtype=jnp.float32)
-
-    sweep = functools.partial(
-        _solve_half_sweep, lam=lam, alpha=alpha, implicit=implicit, chunk=chunk
+    u_buckets = build_neighbor_buckets(
+        user_idx, item_idx, values, num_users, num_shards,
+        workspace_elems=workspace_elems, features=features,
+    )
+    i_buckets = build_neighbor_buckets(
+        item_idx, user_idx, values, num_items, num_shards,
+        workspace_elems=workspace_elems, features=features,
     )
 
-    def run(u_idx_, u_val_, u_mask_, i_idx_, i_val_, i_mask_, y_init):
-        x = jnp.zeros((u_idx_.shape[0], features), dtype=jnp.float32)
+    # MLlib-style init: small random normal factors (+1 sacrificial pad
+    # row). Host RNG in natural row order so the sharded-factor mode
+    # (which permutes the same init) is step-identical with this path.
+    y0 = np.zeros((num_items + 1, features), np.float32)
+    y0[:num_items] = 0.1 * np.random.default_rng(seed_val).standard_normal(
+        (num_items, features)
+    ).astype(np.float32)
+
+    u_chunks = [b.chunk for b in u_buckets]
+    i_chunks = [b.chunk for b in i_buckets]
+
+    def run(u_arrs, i_arrs, y_init):
+        # chunk sizes are static (from the closure); only arrays are traced
+        u_args = [(*a, c) for a, c in zip(u_arrs, u_chunks)]
+        i_args = [(*a, c) for a, c in zip(i_arrs, i_chunks)]
+        x = jnp.zeros((num_users + 1, features), dtype=jnp.float32)
         y = y_init
 
         def body(_, carry):
             x_, y_ = carry
-            x_ = sweep(y_, u_idx_, u_val_, u_mask_)
-            y_ = sweep(x_, i_idx_, i_val_, i_mask_)
+            x_ = _sweep_buckets(y_, num_users + 1, u_args, lam, alpha, implicit)
+            y_ = _sweep_buckets(x_, num_items + 1, i_args, lam, alpha, implicit)
             return x_, y_
 
         return jax.lax.fori_loop(0, iterations, body, (x, y))
 
+    def to_arrs(buckets, row_sh=None, row_sh2=None):
+        out = []
+        for b in buckets:
+            if row_sh is None:
+                out.append((jnp.asarray(b.rows), jnp.asarray(b.idx), jnp.asarray(b.val), jnp.asarray(b.mask)))
+            else:
+                out.append(
+                    (
+                        jax.device_put(b.rows, row_sh),
+                        jax.device_put(b.idx, row_sh2),
+                        jax.device_put(b.val, row_sh2),
+                        jax.device_put(b.mask, row_sh2),
+                    )
+                )
+        return out
+
     if mesh is not None:
-        row_sharded = NamedSharding(mesh, P(DATA_AXIS, None))
+        row_sharded = NamedSharding(mesh, P(DATA_AXIS))
+        row_sharded2 = NamedSharding(mesh, P(DATA_AXIS, None))
         repl = NamedSharding(mesh, P())
-        u_args = [jax.device_put(a, row_sharded) for a in (users.idx, users.val, users.mask)]
-        i_args = [jax.device_put(a, row_sharded) for a in (items.idx, items.val, items.mask)]
+        u_arrs = to_arrs(u_buckets, row_sharded, row_sharded2)
+        i_arrs = to_arrs(i_buckets, row_sharded, row_sharded2)
         y0 = jax.device_put(np.asarray(y0), repl)
-        run_c = jax.jit(
-            run,
-            in_shardings=(row_sharded,) * 3 + (row_sharded,) * 3 + (repl,),
-            out_shardings=(row_sharded, row_sharded),
-        )
-        x, y = run_c(*u_args, *i_args, y0)
+        run_c = jax.jit(run, out_shardings=(repl, repl))
+        x, y = run_c(u_arrs, i_arrs, y0)
     else:
-        run_c = jax.jit(run)
-        x, y = run_c(users.idx, users.val, users.mask, items.idx, items.val, items.mask, y0)
+        x, y = jax.jit(run)(to_arrs(u_buckets), to_arrs(i_buckets), y0)
 
     x = np.asarray(x)[:num_users]
     y = np.asarray(y)[:num_items]
+    return ALSModel(x=x, y=y)
+
+
+# ---------------------------------------------------------------------------
+# Sharded-factor training: ring-exchange half-sweeps under shard_map
+# ---------------------------------------------------------------------------
+
+
+def _sharded_layout(buckets: list[NeighborBucket], num_rows: int, s: int):
+    """Device-major slot layout for sharded factors.
+
+    Global slot order is device-major, bucket-minor: device d's block is
+    the concatenation of every bucket's d-th shard slice. Returns
+    (perm_rows [T] global row id per slot (-1 pad), pos [num_rows] slot
+    position per row (-1 if degree 0), loc = slots per device)."""
+    loc = sum(b.num_slots // s for b in buckets)
+    total = loc * s
+    perm_rows = np.full(total, -1, dtype=np.int64)
+    pos = np.full(num_rows, -1, dtype=np.int64)
+    offset = 0
+    for b in buckets:
+        n_b = b.num_slots
+        n_loc = n_b // s
+        i = np.arange(n_b)
+        d, j = i // n_loc, i % n_loc
+        gp = d * loc + offset + j
+        perm_rows[gp] = b.rows
+        valid = b.rows >= 0
+        pos[b.rows[valid]] = gp[valid]
+        offset += n_loc
+    return perm_rows, pos, loc
+
+
+def _translate_to_shards(idx: np.ndarray, pos_other: np.ndarray, other_loc: int):
+    """Map col ids to (owner shard, local row) in the other side's layout.
+
+    Entries whose col has no slot (only possible for mask-0 padding, idx
+    0) get shard -1 — matched by no ring step, contributing zero."""
+    p = pos_other[idx]
+    ish = np.where(p < 0, -1, p // other_loc).astype(np.int32)
+    ilo = np.where(p < 0, 0, p % other_loc).astype(np.int32)
+    return ish, ilo
+
+
+def _train_als_sharded(
+    user_idx, item_idx, values, num_users, num_items, features,
+    lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
+) -> ALSModel:
+    """shard_map ALS with factors sharded over the mesh (see module doc)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    s = int(np.prod(mesh.devices.shape))
+    u_buckets = build_neighbor_buckets(
+        user_idx, item_idx, values, num_users, s,
+        workspace_elems=workspace_elems, features=features,
+    )
+    i_buckets = build_neighbor_buckets(
+        item_idx, user_idx, values, num_items, s,
+        workspace_elems=workspace_elems, features=features,
+    )
+    if not u_buckets or not i_buckets:
+        return ALSModel(
+            x=np.zeros((num_users, features), np.float32),
+            y=np.zeros((num_items, features), np.float32),
+        )
+
+    perm_x, pos_x, u_loc = _sharded_layout(u_buckets, num_users, s)
+    perm_y, pos_y, i_loc = _sharded_layout(i_buckets, num_items, s)
+
+    u_arrs = []
+    for b in u_buckets:
+        ish, ilo = _translate_to_shards(b.idx, pos_y, i_loc)
+        u_arrs.append((ish, ilo, b.val, b.mask))
+    i_arrs = []
+    for b in i_buckets:
+        ish, ilo = _translate_to_shards(b.idx, pos_x, u_loc)
+        i_arrs.append((ish, ilo, b.val, b.mask))
+    u_chunks = [b.chunk for b in u_buckets]
+    i_chunks = [b.chunk for b in i_buckets]
+
+    # same natural-order init as the replicated path, permuted into the
+    # sharded layout (pad slots zero — they enter the psum'd YtY)
+    y_nat = 0.1 * np.random.default_rng(seed_val).standard_normal(
+        (num_items, features)
+    ).astype(np.float32)
+    y0 = np.zeros((i_loc * s, features), np.float32)
+    yv0 = perm_y >= 0
+    y0[yv0] = y_nat[perm_y[yv0]]
+
+    ring = [(i, (i - 1) % s) for i in range(s)]
+    k = features
+
+    def ring_fill(other_loc, ish_c, ilo_c):
+        """[C, D, k] workspace: at ring step t this device holds the other
+        side's shard (my+t) mod S and fills the slots that shard owns."""
+        my = jax.lax.axis_index(DATA_AXIS)
+        v0 = jnp.zeros(ish_c.shape + (other_loc.shape[1],), jnp.float32)
+        # the accumulator varies per device (ppermute output feeds it):
+        # mark it device-varying so the scan carry types line up
+        v0 = jax.lax.pvary(v0, (DATA_AXIS,))
+
+        def step(carry, t):
+            cur, v = carry
+            shard_id = jax.lax.rem(my + t, s)
+            g = cur[ilo_c]
+            v = v + jnp.where((ish_c == shard_id)[..., None], g, 0.0)
+            cur = jax.lax.ppermute(cur, DATA_AXIS, ring)
+            return (cur, v), None
+
+        (_, v), _ = jax.lax.scan(step, (other_loc, v0), jnp.arange(s, dtype=jnp.int32))
+        return v
+
+    def half_sweep(other_loc, arrs, chunks):
+        yty = jax.lax.psum(other_loc.T @ other_loc, DATA_AXIS) if implicit else None
+        outs = []
+        for (ish, ilo, val, mask), chunk in zip(arrs, chunks):
+            n_loc, d = ish.shape
+
+            def solve_chunk(args):
+                ish_c, ilo_c, cval, cmask = args
+                v = ring_fill(other_loc, ish_c, ilo_c) * cmask[..., None]
+                a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k)
+                return jnp.linalg.solve(a, b[..., None])[..., 0]
+
+            nch = n_loc // chunk
+            if nch <= 1:
+                solved = solve_chunk((ish, ilo, val, mask))
+            else:
+                solved = jax.lax.map(
+                    solve_chunk,
+                    tuple(a.reshape(nch, chunk, d) for a in (ish, ilo, val, mask)),
+                ).reshape(n_loc, k)
+            outs.append(solved)
+        return jnp.concatenate(outs, axis=0)
+
+    def run(u_in, i_in, y_loc0):
+        def body(_, carry):
+            x_loc, y_loc = carry
+            x_loc = half_sweep(y_loc, u_in, u_chunks)
+            y_loc = half_sweep(x_loc, i_in, i_chunks)
+            return x_loc, y_loc
+
+        x_loc = jax.lax.pvary(jnp.zeros((u_loc, features), jnp.float32), (DATA_AXIS,))
+        return jax.lax.fori_loop(0, iterations, body, (x_loc, y_loc0))
+
+    spec2 = P(DATA_AXIS, None)
+    arr_specs_u = [(spec2,) * 4 for _ in u_arrs]
+    arr_specs_i = [(spec2,) * 4 for _ in i_arrs]
+    run_c = jax.jit(
+        shard_map(
+            run,
+            mesh=mesh,
+            in_specs=(arr_specs_u, arr_specs_i, spec2),
+            out_specs=(spec2, spec2),
+        )
+    )
+
+    sh2 = NamedSharding(mesh, spec2)
+    u_dev = [tuple(jax.device_put(a, sh2) for a in t) for t in u_arrs]
+    i_dev = [tuple(jax.device_put(a, sh2) for a in t) for t in i_arrs]
+    x_p, y_p = run_c(u_dev, i_dev, jax.device_put(y0, sh2))
+
+    x = np.zeros((num_users, features), np.float32)
+    y = np.zeros((num_items, features), np.float32)
+    xv = perm_x >= 0
+    yv = perm_y >= 0
+    x[perm_x[xv]] = np.asarray(x_p)[xv]
+    y[perm_y[yv]] = np.asarray(y_p)[yv]
     return ALSModel(x=x, y=y)
 
 

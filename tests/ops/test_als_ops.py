@@ -104,9 +104,10 @@ def test_sharded_training_matches_single_device():
 def test_chunked_solve_matches_unchunked():
     u, i, v, _ = low_rank_ratings(num_users=50, num_items=20)
     a = als_ops.train_als(u, i, v, 50, 20, features=4, lam=0.05, implicit=False,
-                          iterations=3, seed=9, chunk=4096)
+                          iterations=3, seed=9)
+    # tiny workspace forces chunk=1 lax.map sweeps in every bucket
     b = als_ops.train_als(u, i, v, 50, 20, features=4, lam=0.05, implicit=False,
-                          iterations=3, seed=9, chunk=16)
+                          iterations=3, seed=9, workspace_elems=64)
     np.testing.assert_allclose(a.x, b.x, atol=1e-4)
 
 
@@ -201,3 +202,83 @@ def test_fold_in_singular_gramian_never_emits_nonfinite(backend):
     assert np.isfinite(new_xu).all() and np.isfinite(new_yi).all()
     # the well-conditioned side still updates
     assert y_upd.any()
+
+
+# ---------------------------------------------------------------------------
+# degree buckets + sharded factors
+# ---------------------------------------------------------------------------
+
+
+def test_build_neighbor_buckets_power_law():
+    """A power-law degree distribution must not inflate narrow rows."""
+    gen = np.random.default_rng(3)
+    # 100 rows of degree <= 4, one super-row of degree 300
+    rows, cols, vals = [], [], []
+    for r in range(100):
+        deg = int(gen.integers(1, 5))
+        rows += [r] * deg
+        cols += gen.integers(0, 500, deg).tolist()
+        vals += [1.0] * deg
+    rows += [100] * 300
+    cols += gen.integers(0, 500, 300).tolist()
+    vals += [1.0] * 300
+    buckets = als_ops.build_neighbor_buckets(
+        np.array(rows, np.int32), np.array(cols, np.int32),
+        np.array(vals, np.float32), num_rows=101,
+    )
+    widths = sorted(b.width for b in buckets)
+    assert widths[0] == 8  # min width holds the small rows
+    assert widths[-1] == 512  # super-row rounds up to 512, alone
+    wide = [b for b in buckets if b.width == 512][0]
+    assert (wide.rows >= 0).sum() == 1
+    # every entry lands exactly once
+    assert sum(int(b.mask.sum()) for b in buckets) == len(rows)
+    # zero-degree rows excluded entirely
+    covered = np.concatenate([b.rows[b.rows >= 0] for b in buckets])
+    assert len(covered) == 101
+
+
+def test_bucketed_matches_on_skewed_degrees():
+    """Rows with wildly different degrees still solve correctly."""
+    gen = np.random.default_rng(11)
+    k = 3
+    xt = gen.standard_normal((30, k))
+    yt = gen.standard_normal((25, k))
+    rows, cols = [], []
+    for r in range(30):
+        deg = 24 if r == 0 else int(gen.integers(1, 4))
+        cs = gen.choice(25, size=deg, replace=False)
+        rows += [r] * deg
+        cols += cs.tolist()
+    u = np.array(rows, np.int32)
+    i = np.array(cols, np.int32)
+    v = (xt @ yt.T)[u, i].astype(np.float32)
+    model = als_ops.train_als(u, i, v, 30, 25, features=k, lam=0.005,
+                              implicit=False, iterations=12, seed=5)
+    pred = als_ops.predict_pairs(model.x, model.y, u, i)
+    assert np.sqrt(np.mean((pred - v) ** 2)) < 0.1
+
+
+def test_shard_factors_matches_replicated():
+    mesh = get_mesh()
+    u, i, v, _ = low_rank_ratings(num_users=48, num_items=32)
+    kwargs = dict(features=6, lam=0.01, implicit=False, iterations=8, seed=21)
+    repl = als_ops.train_als(u, i, v, 48, 32, **kwargs)
+    shard = als_ops.train_als(u, i, v, 48, 32, mesh=mesh, shard_factors=True, **kwargs)
+    pred_r = als_ops.predict_pairs(repl.x, repl.y, u, i)
+    pred_s = als_ops.predict_pairs(shard.x, shard.y, u, i)
+    np.testing.assert_allclose(pred_r, pred_s, atol=1e-2)
+
+
+def test_shard_factors_implicit():
+    mesh = get_mesh()
+    gen = np.random.default_rng(13)
+    u = gen.integers(0, 40, 600).astype(np.int32)
+    i = gen.integers(0, 30, 600).astype(np.int32)
+    v = np.abs(gen.standard_normal(600)).astype(np.float32) + 0.1
+    kwargs = dict(features=5, lam=0.1, alpha=1.0, implicit=True, iterations=6, seed=33)
+    repl = als_ops.train_als(u, i, v, 40, 30, **kwargs)
+    shard = als_ops.train_als(u, i, v, 40, 30, mesh=mesh, shard_factors=True, **kwargs)
+    pred_r = als_ops.predict_pairs(repl.x, repl.y, u, i)
+    pred_s = als_ops.predict_pairs(shard.x, shard.y, u, i)
+    np.testing.assert_allclose(pred_r, pred_s, atol=5e-2, rtol=5e-2)
