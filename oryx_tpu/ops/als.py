@@ -557,6 +557,42 @@ def rmse(x: np.ndarray, y: np.ndarray, user_idx, item_idx, values) -> float:
     return float(np.sqrt(np.mean((pred - values) ** 2)))
 
 
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _auc_bucket_jit(x, y, uids, pos, posm, neg, negm, chunk):
+    """Per-user AUC for one degree bucket: [N, P] padded positive and
+    sampled-negative item ids + masks. Scores on the MXU, pairwise
+    comparison [C, P, P] chunked to bound memory."""
+
+    def per_chunk(args):
+        cu, cp, cpm, cn, cnm = args
+        xu = x[cu]  # [C, k]
+        sp = jnp.einsum("cpk,ck->cp", y[cp], xu)
+        sn = jnp.einsum("cnk,ck->cn", y[cn], xu)
+        gt = (
+            (sp[:, :, None] > sn[:, None, :])
+            & cpm[:, :, None]
+            & cnm[:, None, :]
+        ).sum(axis=(1, 2))
+        pairs = cpm.sum(axis=1) * cnm.sum(axis=1)
+        return gt / jnp.maximum(pairs, 1), pairs > 0
+
+    n = uids.shape[0]
+    if n <= chunk:
+        return per_chunk((uids, pos, posm, neg, negm))
+    nch = n // chunk
+    a, v = jax.lax.map(
+        per_chunk,
+        (
+            uids.reshape(nch, chunk),
+            pos.reshape(nch, chunk, -1),
+            posm.reshape(nch, chunk, -1),
+            neg.reshape(nch, chunk, -1),
+            negm.reshape(nch, chunk, -1),
+        ),
+    )
+    return a.reshape(n), v.reshape(n)
+
+
 def mean_auc(
     x: np.ndarray,
     y: np.ndarray,
@@ -565,30 +601,81 @@ def mean_auc(
     rng: np.random.Generator,
 ) -> float:
     """Mean per-user AUC with about as many sampled negatives as positives
-    per user (Evaluation.areaUnderCurve, Evaluation.java:70-136)."""
+    per user (Evaluation.areaUnderCurve, Evaluation.java:70-136).
+
+    Fully vectorized (VERDICT r1 #8): users are grouped into power-of-two
+    positive-count buckets; negative sampling (4x candidates, positives
+    rejected) happens with one sort + searchsorted pass per bucket on
+    host, and the score/pairwise-comparison work runs on device with
+    chunked [C, P, P] comparisons — no Python per-user loop."""
     if len(user_idx) == 0:
         return float("nan")
     all_items = np.unique(item_idx)
     order = np.argsort(user_idx, kind="stable")
     uu, ii = user_idx[order], item_idx[order]
-    uniq_users = np.unique(uu)
-    starts = np.searchsorted(uu, uniq_users, side="left")
-    ends = np.searchsorted(uu, uniq_users, side="right")
-    aucs = []
-    for u, s, e in zip(uniq_users, starts, ends):
-        pos = ii[s:e]
-        pos_set = set(pos.tolist())
-        num_pos = len(pos)
-        # sample negatives: bounded tries like the reference (numItems tries)
-        cand = rng.choice(all_items, size=min(len(all_items), 4 * num_pos))
-        neg = np.asarray([c for c in cand if c not in pos_set][:num_pos], dtype=np.int64)
-        if len(neg) == 0:
-            continue
-        pos_scores = y[pos] @ x[u]
-        neg_scores = y[neg] @ x[u]
-        correct = (pos_scores[:, None] > neg_scores[None, :]).sum()
-        aucs.append(correct / (len(pos_scores) * len(neg_scores)))
-    return float(np.mean(aucs)) if aucs else float("nan")
+    uniq_users, starts = np.unique(uu, return_index=True)
+    ends = np.concatenate([starts[1:], [len(uu)]])
+    counts = ends - starts
+
+    xd = jnp.asarray(x, dtype=jnp.float32)
+    yd = jnp.asarray(y, dtype=jnp.float32)
+
+    # per-entry user ordinal and position within the user's run
+    entry_user = np.repeat(np.arange(len(uniq_users)), counts)
+    entry_pos = np.arange(len(ii)) - np.repeat(starts, counts)
+
+    aucs: list[np.ndarray] = []
+    valids: list[np.ndarray] = []
+    widths = np.maximum(1, 2 ** np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64))
+    for w in sorted(set(widths.tolist())):
+        sel = np.flatnonzero(widths == w)
+        nu = len(sel)
+        p = int(w)
+        pos = np.zeros((nu, p), dtype=np.int64)
+        posm = np.zeros((nu, p), dtype=bool)
+        slot_of = np.full(len(uniq_users), -1, dtype=np.int64)
+        slot_of[sel] = np.arange(nu)
+        esel = slot_of[entry_user] >= 0
+        pos[slot_of[entry_user[esel]], entry_pos[esel]] = ii[esel]
+        posm[slot_of[entry_user[esel]], entry_pos[esel]] = True
+        # sample 4x candidates, reject positives via disjoint-range keys:
+        # row r's sorted positives become keys in [r*M, (r+1)*M) so one
+        # global searchsorted answers rowwise membership
+        m = int(all_items.max()) + 2
+        cand = rng.choice(all_items, size=(nu, 4 * p))
+        keys = np.sort(np.where(posm, pos, m - 1) + np.arange(nu)[:, None] * m, axis=1)
+        ckeys = cand + np.arange(nu)[:, None] * m
+        loc = np.searchsorted(keys.ravel(), ckeys.ravel())
+        hit = np.zeros(loc.shape, dtype=bool)
+        in_range = loc < keys.size
+        hit[in_range] = keys.ravel()[loc[in_range]] == ckeys.ravel()[in_range]
+        ok = ~hit.reshape(nu, 4 * p)
+        rank = np.cumsum(ok, axis=1) - 1
+        want = counts[sel][:, None]  # as many negatives as positives
+        take = ok & (rank < want) & (rank < p)
+        neg = np.zeros((nu, p), dtype=np.int64)
+        negm = np.zeros((nu, p), dtype=bool)
+        rows, cols = np.nonzero(take)
+        neg[rows, rank[rows, cols]] = cand[rows, cols]
+        negm[rows, rank[rows, cols]] = True
+
+        chunk = max(1, min(nu, (1 << 24) // max(p * p, 1)))
+        pad = -nu % chunk
+        if pad:
+            z2 = np.zeros((pad, p), dtype=np.int64)
+            zb = np.zeros((pad, p), dtype=bool)
+            pos, posm = np.concatenate([pos, z2]), np.concatenate([posm, zb])
+            neg, negm = np.concatenate([neg, z2]), np.concatenate([negm, zb])
+        uids = np.concatenate([uniq_users[sel], np.zeros(pad, uniq_users.dtype)])
+        a, v = _auc_bucket_jit(
+            xd, yd, jnp.asarray(uids), jnp.asarray(pos), jnp.asarray(posm),
+            jnp.asarray(neg), jnp.asarray(negm), chunk,
+        )
+        aucs.append(np.asarray(a)[:nu])
+        valids.append(np.asarray(v)[:nu])
+    auc = np.concatenate(aucs)
+    valid = np.concatenate(valids)
+    return float(auc[valid].mean()) if valid.any() else float("nan")
 
 
 # ---------------------------------------------------------------------------
