@@ -1,0 +1,55 @@
+"""Profiling hooks: JAX profiler traces on demand.
+
+The reference delegates job observability to the Spark web UI
+(src/site/markdown/docs/performance.md:36-41); SURVEY.md §5 asks the
+rebuild to exceed that with real profiler integration. When a profile
+directory is configured (``oryx.batch.compute.profile-dir`` /
+``oryx.speed.compute.profile-dir``) each traced span produces an xprof
+trace under ``<dir>/<name>-<timestamp>/`` viewable with TensorBoard's
+profile plugin or xprof; without one the context manager is a no-op
+(zero overhead on the hot path).
+
+Step-time breakdowns are separate: layers wrap their phases in
+``metrics.timed`` histograms, exported at /metrics.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+
+@contextlib.contextmanager
+def maybe_trace(profile_dir: str | None, name: str):
+    """jax.profiler trace of the enclosed block when profile_dir is set."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    target = f"{profile_dir.rstrip('/')}/{name}-{int(time.time() * 1000)}"
+    log.info("profiling %s -> %s", name, target)
+    # tracing must never take down a layer: profiler start/stop failures
+    # are logged and swallowed; the body's own exceptions propagate
+    started = False
+    try:
+        jax.profiler.start_trace(target)
+        started = True
+    except Exception:
+        log.exception("could not start profiler trace %s", target)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                log.exception("could not stop profiler trace %s", target)
+
+
+def profile_dir_from_config(config, layer: str) -> str | None:
+    """Configured trace directory for a layer, or None (off)."""
+    return config.get(f"oryx.{layer}.compute.profile-dir", None)

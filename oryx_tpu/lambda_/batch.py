@@ -24,7 +24,7 @@ import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
-from oryx_tpu.common import metrics
+from oryx_tpu.common import metrics, profiling
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_ import data as data_store
@@ -91,7 +91,11 @@ class BatchLayer(AbstractLayer):
         """One full generation; callable directly for deterministic tests."""
         with metrics.timed(metrics.registry.histogram("batch.generation.seconds")):
             try:
-                self._run_one_generation(timestamp_ms)
+                with profiling.maybe_trace(
+                    profiling.profile_dir_from_config(self.config, "batch"),
+                    "batch-generation",
+                ):
+                    self._run_one_generation(timestamp_ms)
             except Exception:
                 metrics.registry.counter("batch.generations.failed").inc()
                 raise
@@ -102,35 +106,47 @@ class BatchLayer(AbstractLayer):
             self._consumer = self.make_input_consumer()
         timestamp_ms = int(time.time() * 1000) if timestamp_ms is None else timestamp_ms
 
+        def phase(name):
+            return metrics.timed(
+                metrics.registry.histogram(f"batch.phase.{name}.seconds")
+            )
+
         # 1. drain whatever is currently available on the input topic
         new_data: list[KeyMessage] = []
-        while True:
-            batch = self._consumer.poll(max_records=10_000, timeout=0.05)
-            if not batch:
-                break
-            new_data.extend(batch)
+        with phase("drain"):
+            while True:
+                batch = self._consumer.poll(max_records=10_000, timeout=0.05)
+                if not batch:
+                    break
+                new_data.extend(batch)
 
         # 2. all surviving past data
-        past_data = data_store.read_past_data(self.data_dir)
+        with phase("read-past"):
+            past_data = data_store.read_past_data(self.data_dir)
 
         # 3. user update, with a producer for the update topic
         ub = self.update_broker()
         producer = ub.producer(self.update_topic) if ub is not None else None
         try:
-            self._update.run_update(timestamp_ms, new_data, past_data, self.model_dir, producer)
+            with phase("update"):
+                self._update.run_update(
+                    timestamp_ms, new_data, past_data, self.model_dir, producer
+                )
         finally:
             if producer is not None:
                 producer.close()
 
         # 4. persist the micro-batch
-        data_store.save_micro_batch(self.data_dir, timestamp_ms, new_data)
+        with phase("save"):
+            data_store.save_micro_batch(self.data_dir, timestamp_ms, new_data)
 
         # 5. commit offsets (UpdateOffsetsFn.java:57-65)
         if self.id:
             self._consumer.commit()
 
         # 6. age-based GC
-        data_store.delete_old_data(self.data_dir, self.max_data_age_hours)
-        data_store.delete_old_models(self.model_dir, self.max_model_age_hours)
+        with phase("gc"):
+            data_store.delete_old_data(self.data_dir, self.max_data_age_hours)
+            data_store.delete_old_models(self.model_dir, self.max_model_age_hours)
 
         self._generation_count += 1

@@ -18,7 +18,7 @@ import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
-from oryx_tpu.common import metrics
+from oryx_tpu.common import metrics, profiling
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_.base import AbstractLayer, blocking_iterator
@@ -108,7 +108,11 @@ class SpeedLayer(AbstractLayer):
         if not new_data:
             return 0
         with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
-            updates = self.manager.build_updates(new_data)
+            with profiling.maybe_trace(
+                profiling.profile_dir_from_config(self.config, "speed"),
+                "speed-batch",
+            ):
+                updates = self.manager.build_updates(new_data)
             ub = self.update_broker()
             sent = 0
             if ub is not None:

@@ -19,10 +19,11 @@ SURVEY.md §7 step 5) grows all nodes of one depth at once:
   that the app tier converts to portable DecisionTree objects.
 
 Stats channels: per-class weighted counts for classification,
-(w, w*y, w*y^2) for regression. Training currently runs on the default
-device; the level pass is a single fused program, so sharding example
-rows over a mesh 'data' axis (histogram partial-sums psum-reduced across
-shards) is a drop-in extension once single-chip profiles demand it.
+(w, w*y, w*y^2) for regression. With ``mesh=``, example rows shard over
+the 'data' axis under shard_map: each device computes local histograms
+and a single psum produces the global ones; split selection is then
+replicated math and example routing stays local — the level pass is
+still one fused program per device.
 """
 
 from __future__ import annotations
@@ -64,9 +65,8 @@ def _impurity(stats: jnp.ndarray, total: jnp.ndarray, kind: str) -> jnp.ndarray:
     return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0), axis=-1)
 
 
-@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))
-def _grow_level(
-    binned,  # [n, p] int32
+def _grow_level_impl(
+    binned,  # [n, p] int32 (local rows under shard_map)
     stats_chan,  # [n, S] float32 per-example stat channels (w-weighted)
     node_of,  # [n] int32 heap index or -1 (inactive)
     feat_mask,  # [L, p] float32 1/0 mtry mask for this level
@@ -77,6 +77,7 @@ def _grow_level(
     min_node_size,  # float32
     min_info_gain,  # float32
     is_last_level: bool,
+    axis_name: str | None = None,  # psum histograms over this mesh axis
 ):
     """Returns (split_feature [L], split_bin [L], gain [L], node_tot [L,S],
     new_node_of [n])."""
@@ -93,6 +94,10 @@ def _grow_level(
         return carry, h.reshape(num_level_nodes, num_bins, s)
 
     _, hists = jax.lax.scan(hist_one_feature, 0, jnp.arange(p))  # [p, L, B, S]
+    if axis_name is not None:
+        # rows are sharded over the mesh: local histograms psum into the
+        # global ones; everything after this line is replicated math
+        hists = jax.lax.psum(hists, axis_name)
 
     node_tot = hists[0].sum(axis=1)  # [L, S] (same for every feature)
 
@@ -155,6 +160,52 @@ def _grow_level(
     return split_feature, split_bin, jnp.where(do_split, best_gain, 0.0), node_tot, new_node_of
 
 
+_grow_level = functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))(
+    _grow_level_impl
+)
+
+
+@functools.lru_cache(maxsize=8)
+def _grow_level_mesh(mesh, axis_name: str):
+    """shard_map'd level pass: example rows sharded over ``axis_name``,
+    local histograms psum'd, split decisions replicated (identical on
+    every device), routing local. One cached wrapper per mesh."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    rows = P(axis_name, None)
+    row1 = P(axis_name)
+    repl = P()
+
+    def wrapped(binned, stats_chan, node_of, feat_mask, level_start,
+                num_level_nodes, num_bins, impurity, min_node_size,
+                min_info_gain, is_last_level):
+        fn = functools.partial(
+            _grow_level_impl,
+            level_start=level_start,
+            num_level_nodes=num_level_nodes,
+            num_bins=num_bins,
+            impurity=impurity,
+            min_node_size=min_node_size,
+            min_info_gain=min_info_gain,
+            is_last_level=is_last_level,
+            axis_name=axis_name,
+        )
+        return shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(rows, rows, row1, repl),
+            out_specs=(repl, repl, repl, repl, row1),
+        )(binned, stats_chan, node_of, feat_mask)
+
+    # thresholds are fixed per training run: static keeps them out of the
+    # shard_map closure (closing over tracers is version-fragile)
+    return functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))(wrapped)
+
+
 def train_forest(
     binned: np.ndarray,
     targets: np.ndarray,
@@ -168,10 +219,12 @@ def train_forest(
     mtry: int | None = None,
     seed: int | None = None,
     exclude_features: set[int] | None = None,
+    mesh=None,
 ) -> ForestArrays:
     """Train `num_trees` trees over pre-binned features. Columns in
     `exclude_features` (e.g. the target's predictor slot) are never
-    sampled for splitting."""
+    sampled for splitting. With ``mesh``, example rows shard over the
+    'data' axis and per-level histograms psum across devices."""
     from oryx_tpu.common import rng as rng_mod
 
     binned = np.asarray(binned, dtype=np.int32)
@@ -202,19 +255,45 @@ def train_forest(
     t_counts = np.zeros((num_trees, max_nodes), dtype=np.float64)
     t_gains = np.zeros((num_trees, max_nodes), dtype=np.float64)
 
-    binned_dev = jnp.asarray(binned)  # uploaded once, reused every level/tree
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from oryx_tpu.parallel.mesh import DATA_AXIS, pad_to_multiple
+
+        num_shards = int(np.prod(mesh.devices.shape))
+        n_pad = pad_to_multiple(n, num_shards)
+        if n_pad != n:  # pad rows arrive inactive (node_of = -1, weight 0)
+            binned = np.concatenate([binned, np.zeros((n_pad - n, p), np.int32)])
+            stats_base = np.concatenate(
+                [stats_base, np.zeros((n_pad - n, stats_base.shape[1]), np.float32)]
+            )
+        rows_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        row1_sh = NamedSharding(mesh, P(DATA_AXIS))
+        grow = _grow_level_mesh(mesh, DATA_AXIS)
+        binned_dev = jax.device_put(binned, rows_sh)
+    else:
+        grow = _grow_level
+        binned_dev = jnp.asarray(binned)  # uploaded once, reused every level/tree
+
     for t in range(num_trees):
         w = gen.poisson(1.0, n).astype(np.float32) if num_trees > 1 else np.ones(n, np.float32)
-        stats_chan = jnp.asarray(stats_base * w[:, None])
+        if mesh is not None and len(w) != binned.shape[0]:
+            w = np.concatenate([w, np.zeros(binned.shape[0] - len(w), np.float32)])
+        stats_w = stats_base * w[:, None]
         node_of = np.where(w > 0, 0, -1).astype(np.int32)
-        node_of_dev = jnp.asarray(node_of)
+        if mesh is not None:
+            stats_chan = jax.device_put(stats_w, rows_sh)
+            node_of_dev = jax.device_put(node_of, row1_sh)
+        else:
+            stats_chan = jnp.asarray(stats_w)
+            node_of_dev = jnp.asarray(node_of)
         for depth in range(max_depth + 1):
             level_start = 2**depth - 1
             num_level = 2**depth
             feat_mask = np.zeros((num_level, p), dtype=np.float32)
             for l in range(num_level):
                 feat_mask[l, gen.choice(allowed, size=min(mtry, pa), replace=False)] = 1.0
-            sf, sb, gains, node_tot, node_of_dev = _grow_level(
+            sf, sb, gains, node_tot, node_of_dev = grow(
                 binned_dev,
                 stats_chan,
                 node_of_dev,
