@@ -33,6 +33,7 @@ from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng
+from oryx_tpu.common import storage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.text import join_json
 from oryx_tpu.ml import param as hp
@@ -136,8 +137,8 @@ class ALSUpdate(MLUpdate):
         test_data: list[KeyMessage],
         train_data: list[KeyMessage],
     ) -> float:
-        ids_x, x = _load_features(model_parent_path / "X")
-        ids_y, y = _load_features(model_parent_path / "Y")
+        ids_x, x = _load_features(storage.join(model_parent_path, "X"))
+        ids_y, y = _load_features(storage.join(model_parent_path, "Y"))
         rm_test = self._prepare(test_data)
         u_index = {u: i for i, u in enumerate(ids_x)}
         i_index = {i_: i for i, i_ in enumerate(ids_y)}
@@ -169,11 +170,11 @@ class ALSUpdate(MLUpdate):
     ) -> None:
         if model_update_topic is None:
             return
-        ids_y, y = _load_features(model_parent_path / "Y")
+        ids_y, y = _load_features(storage.join(model_parent_path, "Y"))
         # Y first: item vectors must exist before user fold-ins make sense
         for id_, vec in zip(ids_y, y):
             model_update_topic.send("UP", join_json(["Y", id_, vec.tolist()]))
-        ids_x, x = _load_features(model_parent_path / "X")
+        ids_x, x = _load_features(storage.join(model_parent_path, "X"))
         known: dict[str, set[str]] = {}
         if not self.no_known_items:
             rm = self._prepare(list(new_data) + list(past_data))
@@ -219,11 +220,17 @@ def _save_features(dir_path: Path, ids: list[str], matrix: np.ndarray) -> None:
             f.write(json.dumps([id_, [float(v) for v in row]]) + "\n")
 
 
-def _load_features(dir_path: Path) -> tuple[list[str], np.ndarray]:
+def _load_features(dir_uri) -> tuple[list[str], np.ndarray]:
+    """URI-aware: candidate dirs are local, promoted models may live on
+    an object store (gs://...) — both read through common.storage."""
     ids: list[str] = []
     rows: list[list[float]] = []
-    for part in sorted(Path(dir_path).glob("part-*.json.gz")):
-        with gzip.open(part, "rt", encoding="utf-8") as f:
+    names = [
+        n for n in storage.list_names(dir_uri)
+        if n.startswith("part-") and n.endswith(".json.gz")
+    ]
+    for name in sorted(names):
+        with storage.open_gzip_read(storage.join(dir_uri, name)) as f:
             for line in f:
                 line = line.strip()
                 if line:

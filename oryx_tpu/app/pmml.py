@@ -10,11 +10,14 @@ PMML, "MODEL-REF" carries a path to read it from
 
 from __future__ import annotations
 
+import logging
 from pathlib import Path
 from xml.etree.ElementTree import Element
 
-from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common import pmml as pmml_io, storage
 from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+
+log = logging.getLogger(__name__)
 
 
 # -- extensions -------------------------------------------------------------
@@ -130,8 +133,15 @@ def read_pmml_from_update_message(key: str, message: str) -> Element | None:
     if key == "MODEL":
         return pmml_io.from_string(message)
     if key == "MODEL-REF":
-        path = Path(message)
-        if not path.exists():
+        # the path may be local or an object-store URI (gs://...) — the
+        # reference reads referenced models from HDFS the same way. A
+        # poison reference (unknown scheme, missing driver, vanished
+        # path) must never kill a consumer loop: resolve to None.
+        try:
+            if not storage.exists(message):
+                return None
+            return pmml_io.from_string(storage.read_text(message))
+        except Exception:
+            log.warning("unresolvable MODEL-REF %r", message, exc_info=True)
             return None
-        return pmml_io.read_pmml(path)
     return None

@@ -22,6 +22,7 @@ from xml.etree.ElementTree import Element
 import numpy as np
 
 from oryx_tpu.app.rdf import encode, forest_pmml, tree as T
+from oryx_tpu.parallel.mesh import mesh_from_config
 from oryx_tpu.app.schema import InputSchema
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
@@ -85,6 +86,7 @@ class RDFUpdate(MLUpdate):
             min_info_gain=self.min_info_gain,
             impurity=impurity,
             exclude_features={target_pred},
+            mesh=mesh_from_config(self._config),
         )
         importances = forest_ops.feature_importances(arrays, features.shape[1])
         forest = arrays_to_forest(arrays, binning, importances)

@@ -1,0 +1,167 @@
+"""Pluggable storage: local filesystem or object store behind one URI API.
+
+The reference persists historical data and models to HDFS and resolves
+MODEL-REF messages from it (BatchUpdateFunction.java:103-130,
+AppPMMLUtils.java:256); a multi-host TPU deployment needs the same —
+a shared store all layers can reach. Paths without a scheme (or with
+``file://``) use the local filesystem directly (fast path, atomic
+temp+rename writes); any other scheme (``gs://``, ``s3://``,
+``memory://`` for tests) routes through fsspec, whose per-blob writes
+are atomic on object stores.
+
+All functions take URI strings. Directory semantics are emulated on
+object stores the usual way (prefixes); ``mkdirs`` is a no-op there.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import os
+import shutil
+from pathlib import Path
+from typing import IO, Iterator
+
+__all__ = [
+    "is_remote", "open_read", "open_write", "open_gzip_read",
+    "open_gzip_write", "exists", "list_names", "delete",
+    "mkdirs", "size", "read_text", "write_text", "join",
+    "upload_dir",
+]
+
+
+def is_remote(uri: str | os.PathLike) -> bool:
+    s = str(uri)
+    return "://" in s and not s.startswith("file://")
+
+
+def _local(uri: str | os.PathLike) -> Path:
+    s = str(uri)
+    return Path(s[len("file://"):] if s.startswith("file://") else s)
+
+
+def _fs(uri: str):
+    import fsspec
+
+    fs, path = fsspec.core.url_to_fs(uri)
+    return fs, path
+
+
+def join(uri: str | os.PathLike, *parts: str) -> str:
+    s = str(uri).rstrip("/")
+    return "/".join([s, *[p.strip("/") for p in parts]])
+
+
+@contextlib.contextmanager
+def open_read(uri: str | os.PathLike, mode: str = "rb") -> Iterator[IO]:
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        with fs.open(path, mode) as f:
+            yield f
+    else:
+        with open(_local(uri), mode, encoding="utf-8" if "b" not in mode else None) as f:
+            yield f
+
+
+@contextlib.contextmanager
+def open_write(uri: str | os.PathLike, mode: str = "wb") -> Iterator[IO]:
+    """Atomic on local (temp + rename); object-store blob puts are atomic
+    by nature (readers never see partial blobs)."""
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        with fs.open(path, mode) as f:
+            yield f
+    else:
+        p = _local(uri)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.parent / f".{p.name}.tmp"
+        with open(tmp, mode, encoding="utf-8" if "b" not in mode else None) as f:
+            yield f
+        tmp.replace(p)
+
+
+@contextlib.contextmanager
+def open_gzip_read(uri: str | os.PathLike) -> Iterator[IO]:
+    with open_read(uri, "rb") as raw, gzip.open(raw, "rt", encoding="utf-8") as g:
+        yield g
+
+
+@contextlib.contextmanager
+def open_gzip_write(uri: str | os.PathLike) -> Iterator[IO]:
+    with open_write(uri, "wb") as raw, gzip.open(raw, "wt", encoding="utf-8") as g:
+        yield g
+
+
+def exists(uri: str | os.PathLike) -> bool:
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        return fs.exists(path)
+    return _local(uri).exists()
+
+
+def list_names(uri: str | os.PathLike) -> list[str]:
+    """Entry names (final path components) directly under a directory /
+    prefix; empty when it doesn't exist."""
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        if not fs.exists(path):
+            return []
+        return sorted({p.rstrip("/").rsplit("/", 1)[-1] for p in fs.ls(path, detail=False)})
+    d = _local(uri)
+    if not d.is_dir():
+        return []
+    return sorted(p.name for p in d.iterdir())
+
+
+def delete(uri: str | os.PathLike, recursive: bool = False) -> None:
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        if fs.exists(path):
+            fs.rm(path, recursive=recursive)
+        return
+    p = _local(uri)
+    if p.is_dir():
+        if recursive:
+            shutil.rmtree(p, ignore_errors=True)
+        else:
+            p.rmdir()
+    else:
+        p.unlink(missing_ok=True)
+
+
+def mkdirs(uri: str | os.PathLike) -> None:
+    if is_remote(str(uri)):
+        return  # object stores have no directories
+    _local(uri).mkdir(parents=True, exist_ok=True)
+
+
+def size(uri: str | os.PathLike) -> int:
+    if is_remote(str(uri)):
+        fs, path = _fs(str(uri))
+        return fs.size(path)
+    return _local(uri).stat().st_size
+
+
+def read_text(uri: str | os.PathLike) -> str:
+    with open_read(uri, "rb") as f:
+        return f.read().decode("utf-8")
+
+
+def write_text(uri: str | os.PathLike, text: str) -> None:
+    with open_write(uri, "wb") as f:
+        f.write(text.encode("utf-8"))
+
+
+def upload_dir(local_dir: str | Path, dst_uri: str) -> None:
+    """Recursively copy a local directory tree to a destination URI
+    (model-candidate promotion to an object store). The PMML file
+    (model.pmml) is uploaded LAST so a consumer that sees it can rely on
+    the sibling artifacts being complete."""
+    root = Path(local_dir)
+    files = [p for p in root.rglob("*") if p.is_file()]
+    files.sort(key=lambda p: (p.name == "model.pmml", str(p)))
+    for p in files:
+        rel = p.relative_to(root)
+        target = join(dst_uri, *rel.parts)
+        with open(p, "rb") as f, open_write(target, "wb") as out:
+            shutil.copyfileobj(f, out)

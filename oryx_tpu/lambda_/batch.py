@@ -120,9 +120,10 @@ class BatchLayer(AbstractLayer):
                     break
                 new_data.extend(batch)
 
-        # 2. all surviving past data
+        # 2. all surviving past data (materialized here so the read-past
+        # phase metric actually measures storage I/O, not generator setup)
         with phase("read-past"):
-            past_data = data_store.read_past_data(self.data_dir)
+            past_data = list(data_store.read_past_data(self.data_dir))
 
         # 3. user update, with a producer for the update topic
         ub = self.update_broker()

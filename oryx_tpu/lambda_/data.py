@@ -8,50 +8,51 @@ DeleteOldDataFn.java:38-78 (timestamp parsed from the file/dir name).
 
 Records are JSON lines ``{"k": key, "m": message}`` — the plain-file
 equivalent of the reference's Hadoop SequenceFile<Text,Text>.
+
+Directories are URIs: plain paths use the local filesystem, `gs://` /
+`memory://` etc. route through the object-store backend
+(oryx_tpu.common.storage) — the HDFS-parity piece that lets every host
+of a multi-host deployment share one data/model store.
 """
 
 from __future__ import annotations
 
 import json
 import re
-import shutil
 import time
 from pathlib import Path
-from typing import Iterable, Iterator
+from typing import Iterator
 
 from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import storage
 
 _DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.data$")
 _MODEL_DIR_RE = re.compile(r"^(\d+)$")
 
 
-def save_micro_batch(data_dir: str | Path, timestamp_ms: int, records: list[KeyMessage]) -> Path | None:
+def save_micro_batch(
+    data_dir: str | Path, timestamp_ms: int, records: list[KeyMessage]
+) -> str | None:
     """Append one micro-batch; empty batches write nothing
     (SaveToHDFSFunction.java:60-66)."""
     if not records:
         return None
-    d = Path(data_dir)
-    d.mkdir(parents=True, exist_ok=True)
-    path = d / f"oryx-{timestamp_ms}.data"
-    tmp = d / f".oryx-{timestamp_ms}.data.tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
+    storage.mkdirs(data_dir)
+    path = storage.join(data_dir, f"oryx-{timestamp_ms}.data")
+    with storage.open_write(path, "wb") as f:
         for rec in records:
-            f.write(json.dumps({"k": rec.key, "m": rec.message}, separators=(",", ":")) + "\n")
-    tmp.replace(path)
+            f.write(
+                (json.dumps({"k": rec.key, "m": rec.message}, separators=(",", ":")) + "\n").encode("utf-8")
+            )
     return path
 
 
 def read_past_data(data_dir: str | Path) -> Iterator[KeyMessage]:
     """Stream all surviving historical records, oldest file first."""
-    d = Path(data_dir)
-    if not d.is_dir():
-        return
-    files = sorted(
-        (p for p in d.iterdir() if _DATA_FILE_RE.match(p.name)),
-        key=lambda p: int(_DATA_FILE_RE.match(p.name).group(1)),
-    )
-    for path in files:
-        with open(path, "r", encoding="utf-8") as f:
+    names = [n for n in storage.list_names(data_dir) if _DATA_FILE_RE.match(n)]
+    names.sort(key=lambda n: int(_DATA_FILE_RE.match(n).group(1)))
+    for name in names:
+        with storage.open_read(storage.join(data_dir, name), "rb") as f:
             for line in f:
                 line = line.strip()
                 if line:
@@ -59,32 +60,37 @@ def read_past_data(data_dir: str | Path) -> Iterator[KeyMessage]:
                     yield KeyMessage(rec.get("k"), rec.get("m", ""))
 
 
-def delete_old_data(data_dir: str | Path, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
+def delete_old_data(
+    data_dir: str | Path, max_age_hours: int, now_ms: int | None = None
+) -> list[str]:
     """Delete data files older than max_age_hours; -1 disables
     (DeleteOldDataFn.java:54-74)."""
-    return _delete_old(data_dir, _DATA_FILE_RE, max_age_hours, now_ms)
+    return _delete_old(data_dir, _DATA_FILE_RE, max_age_hours, now_ms, recursive=False)
 
 
-def delete_old_models(model_dir: str | Path, max_age_hours: int, now_ms: int | None = None) -> list[Path]:
+def delete_old_models(
+    model_dir: str | Path, max_age_hours: int, now_ms: int | None = None
+) -> list[str]:
     """Delete versioned model dirs (named <timestampMs>) older than
     max_age_hours; -1 disables."""
-    return _delete_old(model_dir, _MODEL_DIR_RE, max_age_hours, now_ms)
+    return _delete_old(model_dir, _MODEL_DIR_RE, max_age_hours, now_ms, recursive=True)
 
 
-def _delete_old(root: str | Path, pattern: re.Pattern, max_age_hours: int, now_ms: int | None) -> list[Path]:
+def _delete_old(
+    root: str | Path,
+    pattern: re.Pattern,
+    max_age_hours: int,
+    now_ms: int | None,
+    recursive: bool,
+) -> list[str]:
     if max_age_hours < 0:
-        return []
-    d = Path(root)
-    if not d.is_dir():
         return []
     cutoff = (time.time() * 1000 if now_ms is None else now_ms) - max_age_hours * 3600_000
     deleted = []
-    for p in d.iterdir():
-        m = pattern.match(p.name)
+    for name in storage.list_names(root):
+        m = pattern.match(name)
         if m and int(m.group(1)) < cutoff:
-            if p.is_dir():
-                shutil.rmtree(p, ignore_errors=True)
-            else:
-                p.unlink(missing_ok=True)
-            deleted.append(p)
+            target = storage.join(root, name)
+            storage.delete(target, recursive=recursive)
+            deleted.append(target)
     return deleted

@@ -30,7 +30,7 @@ from xml.etree.ElementTree import Element
 
 from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
-from oryx_tpu.common import pmml as pmml_io, rng
+from oryx_tpu.common import pmml as pmml_io, rng, storage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import collect_in_parallel
 from oryx_tpu.ml import param as hp
@@ -145,20 +145,27 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 return
             best_path, best_pmml = best
 
-            # promote to model_dir/<timestampMs>/ (temp -> rename)
-            final_dir = Path(model_dir) / str(timestamp_ms)
-            final_dir.parent.mkdir(parents=True, exist_ok=True)
-            if final_dir.exists():
-                shutil.rmtree(final_dir)
-            shutil.move(str(best_path), str(final_dir))
+            # promote to model_dir/<timestampMs>/: temp -> rename locally,
+            # recursive upload (PMML last) to an object store
+            if storage.is_remote(model_dir):
+                final_dir = storage.join(model_dir, str(timestamp_ms))
+                if storage.exists(final_dir):
+                    storage.delete(final_dir, recursive=True)
+                storage.upload_dir(best_path, final_dir)
+                shutil.rmtree(best_path, ignore_errors=True)
+            else:
+                final_dir = Path(model_dir) / str(timestamp_ms)
+                final_dir.parent.mkdir(parents=True, exist_ok=True)
+                if final_dir.exists():
+                    shutil.rmtree(final_dir)
+                shutil.move(str(best_path), str(final_dir))
 
             if model_update_topic is None:
                 log.info("not publishing model to update topic since none is configured")
             else:
-                pmml_path = final_dir / MODEL_FILE_NAME
-                size = pmml_path.stat().st_size
-                if size <= self.max_message_size:
-                    model_update_topic.send("MODEL", pmml_path.read_text(encoding="utf-8"))
+                pmml_path = storage.join(final_dir, MODEL_FILE_NAME)
+                if storage.size(pmml_path) <= self.max_message_size:
+                    model_update_topic.send("MODEL", storage.read_text(pmml_path))
                 else:
                     model_update_topic.send("MODEL-REF", str(pmml_path))
                 self.publish_additional_model_data(

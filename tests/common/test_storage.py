@@ -1,0 +1,103 @@
+"""Object-store abstraction tests: local + in-memory fake (fsspec
+memory://), exercising exactly the operations the lambda/ML tiers use."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import storage
+
+
+@pytest.fixture()
+def memfs_root():
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    root = "memory://oryx-test"
+    yield root
+    try:
+        fs.rm("/oryx-test", recursive=True)
+    except FileNotFoundError:
+        pass
+
+
+def test_is_remote():
+    assert storage.is_remote("gs://bucket/x")
+    assert storage.is_remote("memory://x")
+    assert not storage.is_remote("/tmp/x")
+    assert not storage.is_remote("file:///tmp/x")
+
+
+@pytest.mark.parametrize("kind", ["local", "memory"])
+def test_roundtrip_text_and_listing(kind, tmp_path, memfs_root):
+    root = str(tmp_path) if kind == "local" else memfs_root
+    a = storage.join(root, "sub", "a.txt")
+    b = storage.join(root, "sub", "b.txt")
+    storage.write_text(a, "alpha")
+    storage.write_text(b, "beta")
+    assert storage.read_text(a) == "alpha"
+    assert storage.exists(a)
+    assert not storage.exists(storage.join(root, "sub", "c.txt"))
+    assert storage.list_names(storage.join(root, "sub")) == ["a.txt", "b.txt"]
+    assert storage.size(b) == 4
+    storage.delete(a)
+    assert not storage.exists(a)
+    assert storage.list_names(storage.join(root, "missing")) == []
+
+
+@pytest.mark.parametrize("kind", ["local", "memory"])
+def test_gzip_roundtrip(kind, tmp_path, memfs_root):
+    root = str(tmp_path) if kind == "local" else memfs_root
+    uri = storage.join(root, "part-00000.json.gz")
+    with storage.open_gzip_write(uri) as f:
+        f.write("line1\nline2\n")
+    with storage.open_gzip_read(uri) as f:
+        assert f.read().splitlines() == ["line1", "line2"]
+
+
+def test_upload_dir_pmml_last(tmp_path, memfs_root, monkeypatch):
+    src = tmp_path / "cand"
+    (src / "X").mkdir(parents=True)
+    (src / "X" / "part-00000.json.gz").write_bytes(b"xx")
+    (src / "model.pmml").write_text("<PMML/>")
+    order = []
+    orig = storage.open_write
+
+    def spy(uri, mode="wb"):
+        order.append(uri.rsplit("/", 1)[-1])
+        return orig(uri, mode)
+
+    monkeypatch.setattr(storage, "open_write", spy)
+    dst = storage.join(memfs_root, "models", "123")
+    storage.upload_dir(src, dst)
+    assert order[-1] == "model.pmml"  # consumers key off the PMML arriving last
+    assert storage.read_text(storage.join(dst, "model.pmml")) == "<PMML/>"
+    assert storage.exists(storage.join(dst, "X", "part-00000.json.gz"))
+
+
+def test_data_store_on_object_store(memfs_root):
+    from oryx_tpu.bus.core import KeyMessage
+    from oryx_tpu.lambda_ import data as data_store
+
+    data_dir = storage.join(memfs_root, "data")
+    data_store.save_micro_batch(data_dir, 1000, [KeyMessage("k1", "m1")])
+    data_store.save_micro_batch(data_dir, 2000, [KeyMessage(None, "m2")])
+    got = list(data_store.read_past_data(data_dir))
+    assert [(g.key, g.message) for g in got] == [("k1", "m1"), (None, "m2")]
+    deleted = data_store.delete_old_data(data_dir, max_age_hours=1, now_ms=1999 + 3600_000)
+    assert len(deleted) == 1
+    got = list(data_store.read_past_data(data_dir))
+    assert [g.message for g in got] == ["m2"]
+
+
+def test_model_ref_resolution_from_object_store(memfs_root):
+    from oryx_tpu.app import pmml as app_pmml
+    from oryx_tpu.common import pmml as pmml_io
+
+    root = pmml_io.build_skeleton_pmml()
+    uri = storage.join(memfs_root, "models", "42", "model.pmml")
+    storage.write_text(uri, pmml_io.to_string(root))
+    got = app_pmml.read_pmml_from_update_message("MODEL-REF", uri)
+    assert got is not None
+    assert app_pmml.read_pmml_from_update_message(
+        "MODEL-REF", storage.join(memfs_root, "nope.pmml")
+    ) is None
