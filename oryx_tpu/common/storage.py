@@ -23,7 +23,7 @@ from pathlib import Path
 from typing import IO, Iterator
 
 __all__ = [
-    "is_remote", "open_read", "open_write", "open_gzip_read",
+    "is_remote", "local_path", "open_read", "open_write", "open_gzip_read",
     "open_gzip_write", "exists", "list_names", "delete",
     "mkdirs", "size", "read_text", "write_text", "join",
     "upload_dir",
@@ -38,6 +38,16 @@ def is_remote(uri: str | os.PathLike) -> bool:
 def _local(uri: str | os.PathLike) -> Path:
     s = str(uri)
     return Path(s[len("file://"):] if s.startswith("file://") else s)
+
+
+def local_path(uri: str | os.PathLike) -> Path:
+    """Local filesystem Path for a non-remote URI (strips any file://
+    scheme). Callers doing direct Path work (rename-based promotion)
+    must use this instead of Path(uri), or a file:// prefix turns into
+    a literal relative directory."""
+    if is_remote(str(uri)):
+        raise ValueError(f"not a local URI: {uri}")
+    return _local(uri)
 
 
 def _fs(uri: str):
@@ -65,12 +75,21 @@ def open_read(uri: str | os.PathLike, mode: str = "rb") -> Iterator[IO]:
 
 @contextlib.contextmanager
 def open_write(uri: str | os.PathLike, mode: str = "wb") -> Iterator[IO]:
-    """Atomic on local (temp + rename); object-store blob puts are atomic
-    by nature (readers never see partial blobs)."""
+    """Atomic everywhere: local writes go through temp + rename; remote
+    writes go to a temp key that is moved into place only on success —
+    fsspec finalizes a blob on close() even when the with-body raised,
+    so writing the final key directly would commit truncated data."""
     if is_remote(str(uri)):
         fs, path = _fs(str(uri))
-        with fs.open(path, mode) as f:
-            yield f
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with fs.open(tmp, mode) as f:
+                yield f
+        except BaseException:
+            with contextlib.suppress(Exception):
+                fs.rm(tmp)
+            raise
+        fs.mv(tmp, path)
     else:
         p = _local(uri)
         p.parent.mkdir(parents=True, exist_ok=True)

@@ -146,7 +146,16 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             best_path, best_pmml = best
 
             # promote to model_dir/<timestampMs>/: temp -> rename locally,
-            # recursive upload (PMML last) to an object store
+            # recursive upload (PMML last) to an object store. Capture the
+            # PMML bytes before the local copy disappears — publishing must
+            # not re-download what was on local disk a moment ago.
+            local_pmml = Path(best_path) / MODEL_FILE_NAME
+            pmml_size = local_pmml.stat().st_size
+            pmml_text = (
+                local_pmml.read_text(encoding="utf-8")
+                if pmml_size <= self.max_message_size
+                else None
+            )
             if storage.is_remote(model_dir):
                 final_dir = storage.join(model_dir, str(timestamp_ms))
                 if storage.exists(final_dir):
@@ -154,7 +163,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 storage.upload_dir(best_path, final_dir)
                 shutil.rmtree(best_path, ignore_errors=True)
             else:
-                final_dir = Path(model_dir) / str(timestamp_ms)
+                final_dir = storage.local_path(model_dir) / str(timestamp_ms)
                 final_dir.parent.mkdir(parents=True, exist_ok=True)
                 if final_dir.exists():
                     shutil.rmtree(final_dir)
@@ -163,11 +172,12 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             if model_update_topic is None:
                 log.info("not publishing model to update topic since none is configured")
             else:
-                pmml_path = storage.join(final_dir, MODEL_FILE_NAME)
-                if storage.size(pmml_path) <= self.max_message_size:
-                    model_update_topic.send("MODEL", storage.read_text(pmml_path))
+                if pmml_text is not None:
+                    model_update_topic.send("MODEL", pmml_text)
                 else:
-                    model_update_topic.send("MODEL-REF", str(pmml_path))
+                    model_update_topic.send(
+                        "MODEL-REF", storage.join(final_dir, MODEL_FILE_NAME)
+                    )
                 self.publish_additional_model_data(
                     best_pmml, new_data, past_data, final_dir, model_update_topic
                 )

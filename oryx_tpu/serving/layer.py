@@ -8,10 +8,15 @@ the update topic from the beginning into manager.consume, and serves the
 registered resources over HTTP with optional Basic auth, gzip, a context
 path, and /ready readiness gating (Ready.java:34-42).
 
-Divergence from the reference, by design: Tomcat+DIGEST auth becomes a
-threaded stdlib HTTP server with Basic auth (front with a real TLS
-terminator in production); Jersey package scanning becomes import of the
-modules named in oryx.serving.application-resources.
+Divergence from the reference, by design: Tomcat becomes a threaded
+stdlib HTTP(S) server. TLS is native (ServingLayer.makeConnector:194-245
+parity): configure `oryx.serving.api.keystore-file`/`key-file` (PEM) and
+the server listens on `secure-port` over TLS >= 1.2. DIGEST becomes
+Basic-over-TLS — Basic under TLS carries the same security as DIGEST's
+challenge dance did in 2015, and credentials over plaintext are refused
+at startup unless `allow-insecure-auth = true` (for deployments behind a
+TLS terminator). Jersey package scanning becomes import of the modules
+named in oryx.serving.application-resources.
 """
 
 from __future__ import annotations
@@ -117,6 +122,28 @@ class ServingLayer:
             # auth requires BOTH set (reference.conf contract); a missing
             # password must not silently degrade to a guessable credential
             raise ValueError("oryx.serving.api.user-name set without password")
+        self.keystore_file = config.get_optional_string("oryx.serving.api.keystore-file")
+        self.key_file = config.get_optional_string("oryx.serving.api.key-file")
+        self.keystore_password = config.get_optional_string(
+            "oryx.serving.api.keystore-password"
+        )
+        if bool(self.keystore_file) != bool(self.key_file):
+            raise ValueError(
+                "oryx.serving.api.keystore-file and key-file must be set together"
+            )
+        self.use_tls = bool(self.keystore_file)
+        if self.use_tls:
+            self.port = config.get_int("oryx.serving.api.secure-port")
+        if self.user_name and not self.use_tls:
+            # Basic credentials in cleartext are a downgrade the reference
+            # never allows (its DIGEST realm runs under a TLS constraint,
+            # ServingLayer.java:290-321); require explicit opt-in
+            if not (config.get_optional_bool("oryx.serving.api.allow-insecure-auth") or False):
+                raise ValueError(
+                    "oryx.serving.api.user-name is set but TLS is not configured; "
+                    "set keystore-file/key-file, or allow-insecure-auth = true "
+                    "behind a TLS terminator"
+                )
         self.no_init_topics = config.get_optional_bool("oryx.serving.no-init-topics") or False
         self.model_manager_class = config.get_optional_string("oryx.serving.model-manager-class")
         self.app_resources = config.get_optional_strings("oryx.serving.application-resources")
@@ -178,6 +205,20 @@ class ServingLayer:
         ctx = ServingContext(self.model_manager, self.input_producer, self.config)
         handler_cls = _make_handler(self, ctx)
         self._server = ThreadingHTTPServer(("0.0.0.0", self.port), handler_cls)
+        if self.use_tls:
+            # HTTPS connector analogue (ServingLayer.makeConnector:194-245)
+            import ssl
+
+            tls_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            tls_ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            tls_ctx.load_cert_chain(
+                certfile=self.keystore_file,
+                keyfile=self.key_file,
+                password=self.keystore_password,
+            )
+            self._server.socket = tls_ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._server_thread = threading.Thread(

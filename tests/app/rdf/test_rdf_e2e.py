@@ -1,0 +1,133 @@
+"""Full RDF lambda-architecture IT: batch + speed + serving over one bus
+(reference ring-3: RDFUpdateIT + speed/serving ITs; mirrors
+tests/app/als/test_als_e2e.py per VERDICT r1 #5)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from oryx_tpu.common import config as C
+from oryx_tpu.lambda_.batch import BatchLayer
+from oryx_tpu.lambda_.speed import SpeedLayer
+from oryx_tpu.serving.layer import ServingLayer
+
+
+def make_config(tmp_path, broker_loc):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "RDFE2E"
+          input-topic.broker = "{broker_loc}"
+          update-topic.broker = "{broker_loc}"
+          input-schema {{
+            num-features = 3
+            numeric-features = ["0", "1"]
+            target-feature = "2"
+          }}
+          rdf {{
+            num-trees = 5
+            hyperparams {{ max-depth = 4, impurity = "entropy" }}
+          }}
+          batch {{
+            streaming.generation-interval-sec = 3600
+            update-class = "oryx_tpu.app.rdf.update:RDFUpdate"
+            storage {{ data-dir = "{tmp_path}/data/"
+                      model-dir = "{tmp_path}/model/" }}
+          }}
+          speed {{
+            streaming.generation-interval-sec = 3600
+            model-manager-class = "oryx_tpu.app.rdf.speed:RDFSpeedModelManager"
+          }}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.app.rdf.serving:RDFServingModelManager"
+            application-resources = "oryx_tpu.app.rdf.serving"
+          }}
+          ml.eval {{ candidates = 1, test-fraction = 0 }}
+        }}
+        """
+    )
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_full_rdf_pipeline(tmp_path):
+    broker_loc = "inproc://rdf-e2e"
+    cfg = make_config(tmp_path, broker_loc)
+    batch = BatchLayer(cfg)
+    batch.prepare()
+    speed = SpeedLayer(cfg)
+    speed.start()
+    serving = ServingLayer(cfg)
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    try:
+        # 1. ingest labeled examples through /train: class = sign of x
+        gen = np.random.default_rng(8)
+        lines = []
+        for _ in range(150):
+            x = float(gen.uniform(-5, 5))
+            y = float(gen.uniform(-5, 5))
+            label = "pos" if x > 0 else "neg"
+            lines.append(f"{x:.3f},{y:.3f},{label}")
+        status, _ = http("POST", f"{base}/train", "\n".join(lines).encode())
+        assert status == 204
+
+        # 2. batch trains the forest and publishes the MiningModel PMML
+        batch.run_one_generation(timestamp_ms=4242)
+        assert (tmp_path / "model" / "4242" / "model.pmml").exists()
+
+        # 3. serving loads and predicts the rule
+        assert wait_for(lambda: http("GET", f"{base}/ready")[0] == 200)
+        assert json.loads(http("GET", f"{base}/predict/3.5,0.0,")[1]) == "pos"
+        assert json.loads(http("GET", f"{base}/predict/-3.5,0.0,")[1]) == "neg"
+        status, dist = http("GET", f"{base}/classificationDistribution/3.5,0.0,")
+        assert status == 200
+        probs = json.loads(dist)
+        assert probs["pos"] > probs.get("neg", 0.0)
+        status, imp = http("GET", f"{base}/feature/importance")
+        assert status == 200
+        importances = json.loads(imp)  # feature name -> importance
+        assert importances["0"] > importances["1"]  # x decides, y is noise
+
+        # 4. speed layer turns new examples into per-leaf UP updates:
+        # inject counter-label examples at a confidently-pos point and the
+        # leaf distributions there must shift away from pure pos
+        base_probs = json.loads(
+            http("GET", f"{base}/classificationDistribution/4.0,1.0,")[1]
+        )
+        status, _ = http(
+            "POST", f"{base}/train", b"\n".join(b"4.0,1.0,neg" for _ in range(20))
+        )
+        assert status == 204
+        sent = speed.run_one_batch()
+        assert sent > 0  # [treeID, nodeID, counts] updates published
+
+        def leaf_updated():
+            body = http("GET", f"{base}/classificationDistribution/4.0,1.0,")[1]
+            return json.loads(body).get("neg", 0.0) > base_probs.get("neg", 0.0)
+
+        assert wait_for(leaf_updated)
+    finally:
+        serving.close()
+        speed.close()
+        batch.close()

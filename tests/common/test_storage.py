@@ -1,7 +1,6 @@
 """Object-store abstraction tests: local + in-memory fake (fsspec
 memory://), exercising exactly the operations the lambda/ML tiers use."""
 
-import numpy as np
 import pytest
 
 from oryx_tpu.common import storage
@@ -101,3 +100,23 @@ def test_model_ref_resolution_from_object_store(memfs_root):
     assert app_pmml.read_pmml_from_update_message(
         "MODEL-REF", storage.join(memfs_root, "nope.pmml")
     ) is None
+
+
+def test_open_write_remote_discards_on_exception(memfs_root):
+    uri = storage.join(memfs_root, "partial.data")
+    with pytest.raises(RuntimeError):
+        with storage.open_write(uri, "wb") as f:
+            f.write(b"half-")
+            raise RuntimeError("mid-write failure")
+    # neither the final blob nor a temp key survives
+    assert not storage.exists(uri)
+    assert storage.list_names(memfs_root) in ([], None) or all(
+        not n.startswith("partial.data") for n in storage.list_names(memfs_root)
+    )
+
+
+def test_local_path_strips_scheme(tmp_path):
+    p = storage.local_path(f"file://{tmp_path}/models")
+    assert p == tmp_path / "models"
+    with pytest.raises(ValueError):
+        storage.local_path("gs://bucket/x")

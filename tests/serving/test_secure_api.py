@@ -1,0 +1,149 @@
+"""TLS + auth hardening tests (reference: SecureAPIConfigIT — HTTPS
+connector with keystore + auth constraint, ServingLayer.java:194-245,
+290-321)."""
+
+import base64
+import datetime
+import ssl
+import urllib.request
+
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.serving.layer import ServingLayer
+
+
+def _self_signed_cert(tmp_path):
+    """Generate a throwaway self-signed cert/key PEM pair via the
+    cryptography package (present as a transitive dependency)."""
+    crypto = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([x509.DNSName("localhost")]), critical=False
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "server.pem"
+    key_path = tmp_path / "server.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def make_config(broker, **overrides):
+    extra = "\n".join(f"{k} = {v}" for k, v in overrides.items())
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-topic.broker = "{broker}"
+          update-topic.broker = "{broker}"
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.example.serving:ExampleServingModelManager"
+            application-resources = "oryx_tpu.example.serving"
+            {extra}
+          }}
+        }}
+        """
+    )
+
+
+def https(url, cert_path, headers=None):
+    ctx = ssl.create_default_context(cafile=cert_path)
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=5, context=ctx) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_tls_serving_round_trip(tmp_path):
+    cert, key = _self_signed_cert(tmp_path)
+    cfg = make_config(
+        "inproc://secure1",
+        **{
+            "api.secure-port": 0,
+            "api.keystore-file": f'"{cert}"',
+            "api.key-file": f'"{key}"',
+        },
+    )
+    layer = ServingLayer(cfg)
+    assert layer.use_tls
+    layer.start()
+    try:
+        status, _ = https(f"https://localhost:{layer.port}/ready", cert)
+        assert status in (200, 503)
+        # plaintext client against the TLS port fails the handshake
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://localhost:{layer.port}/ready", timeout=3)
+    finally:
+        layer.close()
+
+
+def test_tls_with_basic_auth(tmp_path):
+    cert, key = _self_signed_cert(tmp_path)
+    cfg = make_config(
+        "inproc://secure2",
+        **{
+            "api.secure-port": 0,
+            "api.keystore-file": f'"{cert}"',
+            "api.key-file": f'"{key}"',
+            "api.user-name": '"oryx"',
+            "api.password": '"secret"',
+        },
+    )
+    layer = ServingLayer(cfg)
+    layer.start()
+    try:
+        status, _ = https(f"https://localhost:{layer.port}/ready", cert)
+        assert status == 401
+        tok = base64.b64encode(b"oryx:secret").decode()
+        status, _ = https(
+            f"https://localhost:{layer.port}/ready",
+            cert,
+            headers={"Authorization": f"Basic {tok}"},
+        )
+        assert status in (200, 503)
+    finally:
+        layer.close()
+
+
+def test_credentials_over_plaintext_refused():
+    with pytest.raises(ValueError, match="TLS is not configured"):
+        ServingLayer(
+            make_config(
+                "inproc://secure3",
+                **{"api.user-name": '"u"', "api.password": '"p"'},
+            )
+        )
+
+
+def test_keystore_without_key_refused(tmp_path):
+    with pytest.raises(ValueError, match="set together"):
+        ServingLayer(
+            make_config(
+                "inproc://secure4", **{"api.keystore-file": '"/tmp/x.pem"'}
+            )
+        )

@@ -103,7 +103,14 @@ def test_read_only_rejects_mutation():
 def test_basic_auth():
     broker_loc = "inproc://serve-auth"
     layer = ServingLayer(
-        make_config(broker_loc, **{"api.user-name": '"u"', "api.password": '"p"'})
+        make_config(
+            broker_loc,
+            **{
+                "api.user-name": '"u"',
+                "api.password": '"p"',
+                "api.allow-insecure-auth": "true",
+            },
+        )
     )
     layer.start()
     base = f"http://127.0.0.1:{layer.port}"
