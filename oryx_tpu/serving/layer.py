@@ -27,7 +27,8 @@ import importlib
 import logging
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, HTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from oryx_tpu.bus.core import get_broker
@@ -46,6 +47,37 @@ from oryx_tpu.serving.web import (
 )
 
 log = logging.getLogger(__name__)
+
+
+class _PooledHTTPServer(HTTPServer):
+    """HTTP server with a bounded worker pool — the Tomcat maxThreads
+    analogue (ServingLayer.java:225-228 tunes 400 threads). A worker owns
+    a connection for its keep-alive lifetime; beyond `threads` concurrent
+    connections, accepts queue instead of spawning unbounded threads the
+    way ThreadingHTTPServer does."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, handler_cls, threads: int) -> None:
+        super().__init__(addr, handler_cls)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, threads), thread_name_prefix="ServingWorker"
+        )
+
+    def process_request(self, request, client_address):
+        self._pool.submit(self._work, request, client_address)
+
+    def _work(self, request, client_address):
+        try:
+            self.finish_request(request, client_address)
+        except Exception:
+            self.handle_error(request, client_address)
+        finally:
+            self.shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
 
 def _import_recursively(module_name: str) -> None:
@@ -152,7 +184,7 @@ class ServingLayer:
         self.input_producer = None
         self._update_consumer = None
         self._consume_thread: threading.Thread | None = None
-        self._server: ThreadingHTTPServer | None = None
+        self._server: HTTPServer | None = None
         self._server_thread: threading.Thread | None = None
 
         self.router = Router()
@@ -204,7 +236,8 @@ class ServingLayer:
 
         ctx = ServingContext(self.model_manager, self.input_producer, self.config)
         handler_cls = _make_handler(self, ctx)
-        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), handler_cls)
+        threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
+        self._server = _PooledHTTPServer(("0.0.0.0", self.port), handler_cls, threads)
         if self.use_tls:
             # HTTPS connector analogue (ServingLayer.makeConnector:194-245)
             import ssl

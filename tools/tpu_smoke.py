@@ -1,0 +1,118 @@
+"""TPU hardware smoke test: run the Pallas kernels on the real chip and
+assert their outputs against the XLA reference paths (VERDICT r1 #7 —
+interpreter-green is not Mosaic-green; this records hardware evidence).
+
+Usage:  python tools/tpu_smoke.py  [--out tools/tpu_smoke_evidence.txt]
+
+Exits 0 only if (a) the backend is really TPU and (b) every kernel
+matches its XLA twin on-device. Appends a timestamped evidence block to
+the --out file, which is committed to the repo when a hardware run
+succeeds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="tools/tpu_smoke_evidence.txt")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    lines = [
+        f"=== tpu_smoke @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
+        f"backend={backend} device={dev.device_kind} ({dev})",
+        f"jax={jax.__version__}",
+    ]
+    if backend != "tpu" and not os.environ.get("ORYX_SMOKE_ALLOW_CPU"):
+        print("\n".join(lines))
+        print("FAIL: not running on TPU hardware", file=sys.stderr)
+        sys.exit(2)
+    if backend != "tpu":
+        lines.append("WARNING: CPU dry-run (interpreter kernels) — NOT hardware evidence")
+
+    gen = np.random.default_rng(0)
+
+    # 1. fused streaming top-N vs XLA matmul+top_k
+    from oryx_tpu.ops import topn as topn_ops
+    from oryx_tpu.ops.pallas_topn import upload_streaming
+
+    items, feats, batch, k = 200_000, 64, 64, 10
+    y = gen.standard_normal((items, feats), dtype=np.float32)
+    q = gen.standard_normal((batch, feats), dtype=np.float32)
+    t0 = time.perf_counter()
+    handle = upload_streaming(y, dtype=jnp.float32)
+    pi, pv = topn_ops.top_k_scores_batch(handle, q, k)
+    pallas_s = time.perf_counter() - t0
+    xla = topn_ops.upload(y, streaming=False)
+    xi, xv = topn_ops.top_k_scores_batch(xla, q, k)
+    if not np.array_equal(np.sort(pi, axis=1), np.sort(xi, axis=1)):
+        # indices may tie-swap; values must agree tightly
+        pass
+    np.testing.assert_allclose(np.asarray(pv), np.asarray(xv), rtol=2e-5, atol=2e-4)
+    lines.append(
+        f"pallas_topn: OK ({items}x{feats}, batch {batch}, top-{k}; "
+        f"compile+first-run {pallas_s:.1f}s; values match XLA)"
+    )
+
+    # bfloat16 streaming variant: ranks must broadly agree with fp32
+    hbf = upload_streaming(y, dtype=jnp.bfloat16)
+    bi, _ = topn_ops.top_k_scores_batch(hbf, q, k)
+    overlap = np.mean(
+        [len(set(bi[r].tolist()) & set(xi[r].tolist())) / k for r in range(batch)]
+    )
+    assert overlap > 0.8, f"bf16 top-k overlap too low: {overlap}"
+    lines.append(f"pallas_topn[bf16]: OK (top-{k} overlap vs fp32 = {overlap:.2f})")
+
+    # 2. fused Lloyd sweep vs XLA lloyd run
+    from oryx_tpu.ops import kmeans as km
+    from oryx_tpu.ops.pallas_kmeans import fits_vmem, lloyd_pallas
+
+    n, d, kk = 100_000, 16, 12
+    pts = gen.standard_normal((n, d), dtype=np.float32) + 4.0 * gen.standard_normal(
+        (kk, d), dtype=np.float32
+    )[gen.integers(0, kk, n)]
+    c0 = pts[gen.choice(n, kk, replace=False)]
+    assert fits_vmem(kk, d)
+    t0 = time.perf_counter()
+    pc, pcounts, pcost = lloyd_pallas(pts, c0.copy(), 5)
+    pallas_s = time.perf_counter() - t0
+    xc, xcounts, xcost = km._lloyd_run(pts, jnp.asarray(c0.copy()), np.ones(n, bool), 5)
+    np.testing.assert_allclose(np.asarray(pc), np.asarray(xc), rtol=1e-4, atol=1e-3)
+    assert abs(float(pcost) - float(xcost)) / max(float(xcost), 1e-9) < 1e-4
+    lines.append(
+        f"pallas_kmeans: OK ({n}x{d}, k={kk}, 5 iters; compile+run {pallas_s:.1f}s; "
+        f"centers+cost match XLA)"
+    )
+
+    # 3. throughput spot-check on the serving scan (the headline path)
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        h2 = topn_ops.submit_top_k(handle, q, k)
+    h2.result()
+    qps = reps * batch / (time.perf_counter() - t0)
+    lines.append(f"throughput: ~{qps:.0f} queries/sec ({items} items, fp32, batch {batch})")
+
+    out = "\n".join(lines) + "\n"
+    print(out)
+    with open(args.out, "a", encoding="utf-8") as f:
+        f.write(out + "\n")
+    print(f"evidence appended to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
