@@ -165,6 +165,22 @@ def maybe_create_topic(locator: str, topic: str, partitions: int = 1, config: di
     get_broker(locator).create_topic(topic, partitions, config)
 
 
+def topic_config_from(cfg, which: str) -> dict | None:
+    """Per-topic broker settings from an oryx config block
+    (`oryx.<which>-topic.*`): retention + segment sizing for brokers that
+    support them (the file bus), max-size recorded for operators."""
+    out = {}
+    for key, conf_key in (
+        ("max-size", f"oryx.{which}-topic.message.max-size"),
+        ("retention-hours", f"oryx.{which}-topic.retention-hours"),
+        ("segment-bytes", f"oryx.{which}-topic.segment-bytes"),
+    ):
+        v = cfg.get(conf_key, None)
+        if v is not None:
+            out[key] = v
+    return out or None
+
+
 def topic_exists(locator: str, topic: str) -> bool:
     return get_broker(locator).topic_exists(topic)
 

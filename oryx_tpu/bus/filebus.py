@@ -9,10 +9,24 @@ fcntl advisory locks so batch/speed/serving processes can share one bus
 directory. Multi-host deployments plug a real broker behind the same
 Broker interface.
 
+Segmented logs + retention: each partition is a sequence of segments —
+archived `partition-<i>.seg<base>.log` files (base = absolute offset of
+their first record) plus the active `partition-<i>.log` whose base lives
+in a `partition-<i>.base` sidecar. The producer rolls the active segment
+past `segment-bytes` and deletes archived segments older than
+`retention-hours`. This bounds the replay-from-zero recovery story the
+same way Kafka topic retention does for the reference (admin.md:78-81
+tells operators to bound update-topic retention): speed/serving restart
+by replaying from the earliest *retained* offset, and a stored offset
+that has aged out clamps forward to it (Kafka earliest-reset semantics).
+Offsets are absolute and survive segment rolls.
+
 Layout:
-    <root>/<topic>/partition-<i>.log     one JSON line per record
-    <root>/<topic>/.meta.json            {"partitions": N, "config": {...}}
-    <root>/__offsets__/<group>.json      {"<topic>": {"0": 17, ...}}
+    <root>/<topic>/partition-<i>.log           active segment
+    <root>/<topic>/partition-<i>.base          {"base": N} for the active
+    <root>/<topic>/partition-<i>.seg<J>.log    archived segment, base J
+    <root>/<topic>/.meta.json                  {"partitions": N, "config": {...}}
+    <root>/__offsets__/<group>.json            {"<topic>": {"0": 17, ...}}
 """
 
 from __future__ import annotations
@@ -95,6 +109,74 @@ class FileBroker(Broker):
         except (OSError, json.JSONDecodeError, KeyError):
             return 1
 
+    def _topic_config(self, topic: str) -> dict:
+        try:
+            return json.loads(self._meta_path(topic).read_text()).get("config") or {}
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    # -- segments ------------------------------------------------------------
+
+    def _active_path(self, topic: str, i: int) -> Path:
+        return self._topic_dir(topic) / f"partition-{i}.log"
+
+    def _active_base(self, topic: str, i: int) -> int:
+        side = self._topic_dir(topic) / f"partition-{i}.base"
+        try:
+            return int(json.loads(side.read_text())["base"])
+        except (OSError, json.JSONDecodeError, KeyError, ValueError):
+            return 0  # pre-segmentation logs: active segment starts at 0
+
+    def _set_active_base(self, topic: str, i: int, base: int) -> None:
+        side = self._topic_dir(topic) / f"partition-{i}.base"
+        tmp = side.with_suffix(".base.tmp")
+        tmp.write_text(json.dumps({"base": base}))
+        os.replace(tmp, side)
+
+    def _segments(self, topic: str, i: int) -> list[tuple[int, Path]]:
+        """(base, path) of every live segment, archived first, active last."""
+        d = self._topic_dir(topic)
+        segs: list[tuple[int, Path]] = []
+        prefix = f"partition-{i}.seg"
+        for p in d.glob(f"{prefix}*.log"):
+            try:
+                segs.append((int(p.name[len(prefix):-len(".log")]), p))
+            except ValueError:
+                continue
+        segs.sort()
+        segs.append((self._active_base(topic, i), self._active_path(topic, i)))
+        return segs
+
+    def earliest_offsets(self, topic: str) -> dict[int, int]:
+        """First retained offset per partition (post-retention floor)."""
+        return {
+            i: self._segments(topic, i)[0][0]
+            for i in range(self._num_partitions(topic))
+        }
+
+    def apply_retention(self, topic: str, now: float | None = None) -> list[Path]:
+        """Delete archived segments older than the topic's retention-hours
+        (config key; None/absent = keep forever). The active segment is
+        never deleted. Returns the deleted paths."""
+        hours = self._topic_config(topic).get("retention-hours")
+        if hours is None:
+            return []
+        cutoff = (time.time() if now is None else now) - float(hours) * 3600.0
+        deleted = []
+        for i in range(self._num_partitions(topic)):
+            # delete only a prefix of the segment chain — a hole in the
+            # middle would make offsets between surviving segments
+            # unreadable
+            for base, path in self._segments(topic, i)[:-1]:  # skip active
+                try:
+                    if path.stat().st_mtime >= cutoff:
+                        break
+                    path.unlink(missing_ok=True)
+                    deleted.append(path)
+                except OSError:
+                    break
+        return deleted
+
     # -- offsets ------------------------------------------------------------
 
     def _ledger_path(self, group: str) -> Path:
@@ -127,10 +209,10 @@ class FileBroker(Broker):
 
     def latest_offsets(self, topic: str) -> dict[int, int]:
         out: dict[int, int] = {}
-        d = self._topic_dir(topic)
         for i in range(self._num_partitions(topic)):
-            p = d / f"partition-{i}.log"
-            out[i] = _count_lines(p) if p.exists() else 0
+            p = self._active_path(topic, i)
+            base = self._active_base(topic, i)
+            out[i] = base + (_count_lines(p) if p.exists() else 0)
         return out
 
     # -- produce/consume ----------------------------------------------------
@@ -156,11 +238,17 @@ def _count_lines(path: Path) -> int:
     return n
 
 
+_DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
+
+
 class _FileProducer(TopicProducer):
     def __init__(self, broker: FileBroker, topic: str) -> None:
         self._broker = broker
         self._topic = topic
         self._nparts = broker._num_partitions(topic)
+        cfg = broker._topic_config(topic)
+        self._segment_bytes = int(cfg.get("segment-bytes") or _DEFAULT_SEGMENT_BYTES)
+        self._has_retention = cfg.get("retention-hours") is not None
 
     @property
     def update_broker(self) -> str:
@@ -175,8 +263,29 @@ class _FileProducer(TopicProducer):
         path = self._broker._topic_dir(self._topic) / f"partition-{p}.log"
         record = json.dumps({"k": key, "m": message}, separators=(",", ":"))
         with _Flock(path.with_suffix(".lock")):
+            try:
+                if path.stat().st_size >= self._segment_bytes:
+                    self._roll(p, path)
+            except OSError:
+                pass
             with open(path, "a", encoding="utf-8") as f:
                 f.write(record + "\n")
+
+    def _roll(self, partition: int, path: Path) -> None:
+        """Archive the full active segment and start a fresh one (under
+        the partition flock). Retention runs opportunistically here so a
+        long-lived bus stays bounded without an external GC process."""
+        broker = self._broker
+        base = broker._active_base(self._topic, partition)
+        n = _count_lines(path)
+        if n == 0:
+            return
+        archived = path.with_name(f"partition-{partition}.seg{base:020d}.log")
+        os.replace(path, archived)
+        broker._set_active_base(self._topic, partition, base + n)
+        path.touch()
+        if self._has_retention:
+            broker.apply_retention(self._topic)
 
     def close(self) -> None:
         pass
@@ -193,58 +302,80 @@ class _FileConsumer(TopicConsumer):
         nparts = broker._num_partitions(topic)
         stored = broker.get_offsets(group, topic) if group else {}
         if stored:
-            self._pos = {i: stored.get(i, 0) for i in range(nparts)}
+            # a stored offset older than retention clamps forward to the
+            # earliest retained record (Kafka earliest-reset semantics)
+            earliest = broker.earliest_offsets(topic)
+            self._pos = {
+                i: max(stored.get(i, 0), earliest.get(i, 0)) for i in range(nparts)
+            }
         elif from_beginning:
-            self._pos = {i: 0 for i in range(nparts)}
+            earliest = broker.earliest_offsets(topic)
+            self._pos = {i: earliest.get(i, 0) for i in range(nparts)}
         else:
             latest = broker.latest_offsets(topic)
             self._pos = {i: latest.get(i, 0) for i in range(nparts)}
-        # byte position of record self._pos[i] in each log; established
-        # lazily (one O(n) scan per partition), then advanced incrementally
-        # so each poll seeks instead of re-reading the whole log.
-        self._byte: dict[int, int] = {}
+        # (segment base, byte position of record self._pos[i]) per
+        # partition; established lazily (one O(n) line skip), then advanced
+        # incrementally so each poll seeks instead of re-reading. Survives
+        # segment rolls: a rolled active keeps its base in the archived
+        # name, so the cached byte stays valid for the same content.
+        self._cursor: dict[int, tuple[int, int]] = {}
 
-    def _seek_start(self, f, partition: int) -> None:
-        """Position f at record index self._pos[partition]."""
-        byte = self._byte.get(partition)
-        if byte is not None:
-            f.seek(byte)
-            return
-        for _ in range(self._pos[partition]):
-            if not f.readline():
-                break
-        self._byte[partition] = f.tell()
+    def _read_partition(self, i: int, budget: int, out: list[KeyMessage]) -> None:
+        """Append up to `budget` records from partition i, walking the
+        segment chain from self._pos[i]."""
+        broker = self._broker
+        while budget > 0:
+            segs = broker._segments(self._topic, i)
+            pos = self._pos[i]
+            if pos < segs[0][0]:
+                pos = self._pos[i] = segs[0][0]  # aged past: clamp forward
+                self._cursor.pop(i, None)
+            idx = len(segs) - 1
+            while idx > 0 and segs[idx][0] > pos:
+                idx -= 1
+            seg_base, seg_path = segs[idx]
+            is_active = idx == len(segs) - 1
+            if not seg_path.exists():
+                return
+            got = 0
+            with open(seg_path, "rb") as f:
+                cur = self._cursor.get(i)
+                if cur is not None and cur[0] == seg_base:
+                    f.seek(cur[1])
+                else:
+                    for _ in range(pos - seg_base):
+                        if not f.readline():
+                            break
+                while budget > 0:
+                    raw = f.readline()
+                    if not raw:
+                        break
+                    if not raw.endswith(b"\n"):
+                        break  # partial tail of an in-flight append; retry
+                    got += 1
+                    self._cursor[i] = (seg_base, f.tell())
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if line:
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue  # corrupt complete line: skip it for good
+                        out.append(KeyMessage(rec.get("k"), rec.get("m", "")))
+                        budget -= 1
+            self._pos[i] += got
+            if is_active or got == 0:
+                # active exhausted, or an archived segment yielded nothing
+                # (roll race: re-resolve next poll instead of spinning)
+                return
+            # archived segment exhausted: fall through to the next one
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
         deadline = time.monotonic() + timeout
         while True:
             out: list[KeyMessage] = []
-            d = self._broker._topic_dir(self._topic)
             for i in sorted(self._pos):
-                path = d / f"partition-{i}.log"
-                if not path.exists():
-                    continue
-                scanned = 0  # complete records consumed this poll
-                with open(path, "rb") as f:
-                    self._seek_start(f, i)
-                    while True:
-                        raw = f.readline()
-                        if not raw:
-                            break
-                        if not raw.endswith(b"\n"):
-                            break  # partial tail of an in-flight append; retry
-                        scanned += 1
-                        self._byte[i] = f.tell()
-                        line = raw.decode("utf-8", errors="replace").strip()
-                        if line:
-                            try:
-                                rec = json.loads(line)
-                            except json.JSONDecodeError:
-                                continue  # corrupt complete line: skip it for good
-                            out.append(KeyMessage(rec.get("k"), rec.get("m", "")))
-                        if len(out) >= max_records:
-                            break
-                self._pos[i] += scanned
+                self._read_partition(i, max_records - len(out), out)
                 if len(out) >= max_records:
                     return out
             if out or self._closed or time.monotonic() >= deadline:
