@@ -168,12 +168,18 @@ def _run_child(env: dict, timeout: float) -> tuple[int, str, str]:
         )
         return proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
-        return -9, (e.stdout or ""), (e.stderr or "") + "\n[parent] child timed out"
+        # TimeoutExpired carries bytes even when run() was given text=True.
+        def _text(v) -> str:
+            if isinstance(v, bytes):
+                return v.decode("utf-8", "replace")
+            return v or ""
+
+        return -9, _text(e.stdout), _text(e.stderr) + "\n[parent] child timed out"
 
 
 def main() -> None:
     attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 4))
-    init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 600))
+    init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 150))
     bench_seconds = float(os.environ.get("ORYX_BENCH_SECONDS", 10.0))
     # init_timeout bounds backend bring-up + compile; the child also needs
     # the timed window and data generation on top of that.

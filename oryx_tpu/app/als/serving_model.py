@@ -70,6 +70,7 @@ class ALSServingModel(ServingModel):
         self.y = make_feature_vectors()
         self._known_lock = ReadWriteLock()
         self._known_items: dict[str, set[str]] = {}
+        self._expected_lock = threading.Lock()
         self._expected_users: set[str] = set()
         self._expected_items: set[str] = set()
         self._solver_lock = threading.Lock()
@@ -95,11 +96,13 @@ class ALSServingModel(ServingModel):
 
     def set_user_vector(self, user: str, vector: np.ndarray) -> None:
         self.x.set_vector(user, vector)
-        self._expected_users.discard(user)
+        with self._expected_lock:
+            self._expected_users.discard(user)
 
     def set_item_vector(self, item: str, vector: np.ndarray) -> None:
         self.y.set_vector(item, vector)
-        self._expected_items.discard(item)
+        with self._expected_lock:
+            self._expected_items.discard(item)
         with self._solver_lock:
             self._yty_solver = None
         with self._cache_lock:
@@ -141,11 +144,17 @@ class ALSServingModel(ServingModel):
     # -- expected-ID accounting ----------------------------------------------
 
     def set_expected(self, user_ids: Iterable[str], item_ids: Iterable[str]) -> None:
-        self._expected_users = set(user_ids) - set(self.x.ids())
-        self._expected_items = set(item_ids) - set(self.y.ids())
+        # computed outside the lock, published under it, so a concurrent
+        # set_*_vector's discard can't resurrect an id we just removed
+        users = set(user_ids) - set(self.x.ids())
+        items = set(item_ids) - set(self.y.ids())
+        with self._expected_lock:
+            self._expected_users = users - set(self.x.ids())
+            self._expected_items = items - set(self.y.ids())
 
     def get_fraction_loaded(self) -> float:
-        expected = len(self._expected_users) + len(self._expected_items)
+        with self._expected_lock:
+            expected = len(self._expected_users) + len(self._expected_items)
         loaded = self.x.size() + self.y.size()
         if expected + loaded == 0:
             return 1.0

@@ -211,8 +211,13 @@ class FileBroker(Broker):
         out: dict[int, int] = {}
         for i in range(self._num_partitions(topic)):
             p = self._active_path(topic, i)
-            base = self._active_base(topic, i)
-            out[i] = base + (_count_lines(p) if p.exists() else 0)
+            # Under the partition lock: a concurrent roll replaces the
+            # active file before bumping the base sidecar, so an unlocked
+            # read could pair a fresh (empty) active with the stale base
+            # and report an offset lower than reality.
+            with _Flock(p.with_suffix(".lock")):
+                base = self._active_base(topic, i)
+                out[i] = base + (_count_lines(p) if p.exists() else 0)
         return out
 
     # -- produce/consume ----------------------------------------------------

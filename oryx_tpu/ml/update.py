@@ -158,7 +158,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             )
             if storage.is_remote(model_dir):
                 final_dir = storage.join(model_dir, str(timestamp_ms))
-                if storage.exists(final_dir):
+                # list, don't exists(): on object stores a bare prefix can
+                # report absent while stale blobs from a previous partial
+                # upload still live under it
+                if storage.list_names(final_dir):
                     storage.delete(final_dir, recursive=True)
                 storage.upload_dir(best_path, final_dir)
                 shutil.rmtree(best_path, ignore_errors=True)

@@ -463,7 +463,7 @@ def _train_als_sharded(
         v0 = jnp.zeros(ish_c.shape + (other_loc.shape[1],), jnp.float32)
         # the accumulator varies per device (ppermute output feeds it):
         # mark it device-varying so the scan carry types line up
-        v0 = jax.lax.pvary(v0, (DATA_AXIS,))
+        v0 = jax.lax.pcast(v0, (DATA_AXIS,), to="varying")
 
         def step(carry, t):
             cur, v = carry
@@ -506,7 +506,9 @@ def _train_als_sharded(
             y_loc = half_sweep(x_loc, i_in, i_chunks)
             return x_loc, y_loc
 
-        x_loc = jax.lax.pvary(jnp.zeros((u_loc, features), jnp.float32), (DATA_AXIS,))
+        x_loc = jax.lax.pcast(
+            jnp.zeros((u_loc, features), jnp.float32), (DATA_AXIS,), to="varying"
+        )
         return jax.lax.fori_loop(0, iterations, body, (x_loc, y_loc0))
 
     spec2 = P(DATA_AXIS, None)

@@ -25,6 +25,7 @@ import base64
 import gzip
 import importlib
 import logging
+import socket
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -54,12 +55,23 @@ class _PooledHTTPServer(HTTPServer):
     analogue (ServingLayer.java:225-228 tunes 400 threads). A worker owns
     a connection for its keep-alive lifetime; beyond `threads` concurrent
     connections, accepts queue instead of spawning unbounded threads the
-    way ThreadingHTTPServer does."""
+    way ThreadingHTTPServer does.
+
+    TLS is wrapped per-connection on the pool worker, never on the
+    listener: a client that connects and stalls mid-handshake costs one
+    worker, not the accept loop (Tomcat's connector does the same).
+    Accepted sockets get a read timeout so idle keep-alive connections
+    cannot pin workers past shutdown, and live connections are tracked so
+    server_close() can unblock every worker deterministically."""
 
     daemon_threads = True
+    read_timeout = 30.0
 
-    def __init__(self, addr, handler_cls, threads: int) -> None:
+    def __init__(self, addr, handler_cls, threads: int, tls_ctx=None) -> None:
         super().__init__(addr, handler_cls)
+        self._tls_ctx = tls_ctx
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, threads), thread_name_prefix="ServingWorker"
         )
@@ -68,16 +80,43 @@ class _PooledHTTPServer(HTTPServer):
         self._pool.submit(self._work, request, client_address)
 
     def _work(self, request, client_address):
+        conn = request
         try:
-            self.finish_request(request, client_address)
-        except Exception:
-            self.handle_error(request, client_address)
+            conn.settimeout(self.read_timeout)
+            if self._tls_ctx is not None:
+                try:
+                    conn = self._tls_ctx.wrap_socket(conn, server_side=True)
+                except Exception as e:
+                    log.debug("TLS handshake failed from %s: %s", client_address, e)
+                    return
+            with self._conns_lock:
+                self._conns.add(conn)
+            try:
+                self.finish_request(conn, client_address)
+            except Exception:
+                self.handle_error(conn, client_address)
+            finally:
+                with self._conns_lock:
+                    self._conns.discard(conn)
         finally:
-            self.shutdown_request(request)
+            self.shutdown_request(conn)
 
     def server_close(self):
         super().server_close()
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # Sockets are closed, so workers unblock promptly; waiting here keeps
+        # interpreter exit from hanging on the executor's atexit join.
+        self._pool.shutdown(wait=True, cancel_futures=True)
 
 
 def _import_recursively(module_name: str) -> None:
@@ -237,9 +276,11 @@ class ServingLayer:
         ctx = ServingContext(self.model_manager, self.input_producer, self.config)
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
-        self._server = _PooledHTTPServer(("0.0.0.0", self.port), handler_cls, threads)
+        tls_ctx = None
         if self.use_tls:
-            # HTTPS connector analogue (ServingLayer.makeConnector:194-245)
+            # HTTPS connector analogue (ServingLayer.makeConnector:194-245).
+            # The listener stays plaintext; each accepted socket is wrapped
+            # on a pool worker so a stalled handshake can't starve accept().
             import ssl
 
             tls_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -249,9 +290,9 @@ class ServingLayer:
                 keyfile=self.key_file,
                 password=self.keystore_password,
             )
-            self._server.socket = tls_ctx.wrap_socket(
-                self._server.socket, server_side=True
-            )
+        self._server = _PooledHTTPServer(
+            ("0.0.0.0", self.port), handler_cls, threads, tls_ctx=tls_ctx
+        )
         if self.port == 0:
             self.port = self._server.server_address[1]
         self._server_thread = threading.Thread(
