@@ -12,7 +12,6 @@ the ALSUtils fold-in, publishing ["X",user,vec[,knownItems]] /
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
 from typing import Iterable, Iterator
@@ -24,7 +23,7 @@ from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.text import read_json
+from oryx_tpu.common.text import json_str as _json_str, read_json
 from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
 from oryx_tpu.native.store import (
     format_update_messages,
@@ -33,18 +32,6 @@ from oryx_tpu.native.store import (
 )
 
 log = logging.getLogger(__name__)
-
-_PLAIN = frozenset(
-    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-.:@ "
-)
-
-
-def _json_str(s: str) -> str:
-    """JSON string literal; quoting fast path for typical IDs."""
-    if all(c in _PLAIN for c in s):
-        return f'"{s}"'
-    return json.dumps(s)
-
 
 class ALSSpeedModel(SpeedModel):
     def __init__(

@@ -34,8 +34,8 @@ from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng
 from oryx_tpu.common import storage
+from oryx_tpu.lambda_.records import ChainRecords, Records, as_records
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.text import join_json
 from oryx_tpu.ml import param as hp
 from oryx_tpu.ml.update import MLUpdate
 from oryx_tpu.ops import als as als_ops
@@ -70,12 +70,23 @@ class ALSUpdate(MLUpdate):
     # -- training ------------------------------------------------------------
 
     def _prepare(self, data: Iterable[KeyMessage]) -> als_data.RatingMatrix:
-        interactions = als_data.parse_interactions(data)
-        interactions = als_data.decay_interactions(
-            interactions, self.decay_factor, self.decay_zero_threshold
-        )
-        agg = als_data.aggregate(interactions, self.implicit)
-        return als_data.to_rating_matrix(agg)
+        """Columnar parse -> decay -> aggregate -> indexed COO, one
+        micro-batch block at a time (lambda_.records streams stored
+        blocks, so nothing materializes a giant per-line Python list)."""
+        parts: list[als_data.InteractionColumns] = []
+        if isinstance(data, Records):
+            for block in data.blocks():
+                parts.append(als_data.parse_interaction_block(block.messages))
+        else:
+            msgs = [
+                (rec if isinstance(rec, str) else rec.message).encode("utf-8")
+                for rec in data
+            ]
+            if msgs:
+                parts.append(als_data.parse_interaction_block(msgs))
+        cols = als_data.concat_columns(parts)
+        cols = als_data.decay_columns(cols, self.decay_factor, self.decay_zero_threshold)
+        return als_data.rating_matrix_from_columns(cols, self.implicit)
 
     def build_model(
         self,
@@ -172,20 +183,15 @@ class ALSUpdate(MLUpdate):
             return
         ids_y, y = _load_features(storage.join(model_parent_path, "Y"))
         # Y first: item vectors must exist before user fold-ins make sense
-        for id_, vec in zip(ids_y, y):
-            model_update_topic.send("UP", join_json(["Y", id_, vec.tolist()]))
+        _publish_factor_rows(model_update_topic, "Y", ids_y, y, None)
         ids_x, x = _load_features(storage.join(model_parent_path, "X"))
-        known: dict[str, set[str]] = {}
+        known: dict[str, set[str]] | None = None
         if not self.no_known_items:
-            rm = self._prepare(list(new_data) + list(past_data))
+            rm = self._prepare(
+                ChainRecords([as_records(new_data), as_records(past_data)])
+            )
             known = rm.known_items
-        for id_, vec in zip(ids_x, x):
-            if self.no_known_items:
-                model_update_topic.send("UP", join_json(["X", id_, vec.tolist()]))
-            else:
-                model_update_topic.send(
-                    "UP", join_json(["X", id_, vec.tolist(), sorted(known.get(id_, ()))])
-                )
+        _publish_factor_rows(model_update_topic, "X", ids_x, x, known)
 
     # -- split ---------------------------------------------------------------
 
@@ -209,15 +215,68 @@ class ALSUpdate(MLUpdate):
         return ordered[:split], ordered[split:]
 
 
+# -- publish helpers ---------------------------------------------------------
+
+_PUBLISH_CHUNK = 8192
+
+
+def _publish_factor_rows(
+    producer: TopicProducer,
+    tag: str,
+    ids: list[str],
+    matrix: np.ndarray,
+    known: dict[str, set[str]] | None,
+) -> None:
+    """Chunked batch publish of ["X"|"Y", id, vector(, knownItems)] "UP"
+    messages: vectors are JSON-formatted in bulk (native formatter when
+    built) and each chunk ships via one `send_many` — one broker lock and
+    one buffered write per chunk instead of one per row
+    (cf. TopicProducerImpl.java:194-202 batching)."""
+    from oryx_tpu.common.text import json_str
+    from oryx_tpu.native.store import format_vectors_json
+
+    for start in range(0, len(ids), _PUBLISH_CHUNK):
+        chunk_ids = ids[start : start + _PUBLISH_CHUNK]
+        vecs = format_vectors_json(matrix[start : start + _PUBLISH_CHUNK])
+        if known is None:
+            records = [
+                ("UP", f'["{tag}",{json_str(i)},{v}]')
+                for i, v in zip(chunk_ids, vecs)
+            ]
+        else:
+            records = [
+                (
+                    "UP",
+                    f'["{tag}",{json_str(i)},{v},'
+                    f"{json.dumps(sorted(known.get(i, ())))}]",
+                )
+                for i, v in zip(chunk_ids, vecs)
+            ]
+        producer.send_many(records)
+
+
 # -- factor-matrix artifacts -------------------------------------------------
+
+_SHARD_ROWS = 500_000
 
 
 def _save_features(dir_path: Path, ids: list[str], matrix: np.ndarray) -> None:
-    """Gzip JSON-lines shards of [id, [floats]] (saveFeaturesRDD:415-426)."""
+    """Gzip JSON-lines shards of [id, [floats]] (saveFeaturesRDD:415-426).
+
+    Sharded by row count (part-0000N) like the reference's partitioned
+    saveAsTextFile output, so a 40M-row factor matrix is many bounded
+    files rather than one serial multi-GB gzip stream."""
+    from oryx_tpu.native.store import format_vectors_json
+
     dir_path.mkdir(parents=True, exist_ok=True)
-    with gzip.open(dir_path / "part-00000.json.gz", "wt", encoding="utf-8") as f:
-        for id_, row in zip(ids, matrix):
-            f.write(json.dumps([id_, [float(v) for v in row]]) + "\n")
+    n = len(ids)
+    shard = 0
+    for start in range(0, max(n, 1), _SHARD_ROWS):
+        chunk_ids = ids[start : start + _SHARD_ROWS]
+        with gzip.open(dir_path / f"part-{shard:05d}.json.gz", "wt", encoding="utf-8") as f:
+            for id_, vec in zip(chunk_ids, format_vectors_json(matrix[start : start + _SHARD_ROWS])):
+                f.write(f"[{json.dumps(id_)},{vec}]\n")
+        shard += 1
 
 
 def _load_features(dir_uri) -> tuple[list[str], np.ndarray]:

@@ -265,8 +265,45 @@ class _FileProducer(TopicProducer):
 
     def send(self, key: str | None, message: str) -> None:
         p = partition_for(key, self._nparts)
-        path = self._broker._topic_dir(self._topic) / f"partition-{p}.log"
         record = json.dumps({"k": key, "m": message}, separators=(",", ":"))
+        self._append_lines(p, record + "\n")
+
+    # One buffered write's worth of payload; also bounds how far a batch
+    # can overshoot segment-bytes (the roll check runs once per slice).
+    _WRITE_SLICE_BYTES = 4 * 1024 * 1024
+
+    def send_many(self, records) -> int:
+        """One flock + one buffered write per ~4MB slice per partition —
+        the file-bus analogue of the reference producer's batching
+        (TopicProducerImpl.java:194-202). A million-row model publish is
+        a handful of lock/open/write cycles instead of a million, while
+        segment rolls still happen at slice granularity so retention and
+        replay stay bounded for arbitrarily large batches."""
+        dumps = json.dumps
+        pending: dict[int, list[str]] = {}
+        pending_bytes = [0] * self._nparts
+        n = 0
+
+        def flush(p: int) -> None:
+            lines = pending.pop(p, None)
+            if lines:
+                self._append_lines(p, "\n".join(lines) + "\n")
+                pending_bytes[p] = 0
+
+        for key, message in records:
+            p = partition_for(key, self._nparts)
+            line = dumps({"k": key, "m": message}, separators=(",", ":"))
+            pending.setdefault(p, []).append(line)
+            pending_bytes[p] += len(line) + 1
+            n += 1
+            if pending_bytes[p] >= self._WRITE_SLICE_BYTES:
+                flush(p)
+        for p in list(pending):
+            flush(p)
+        return n
+
+    def _append_lines(self, p: int, payload: str) -> None:
+        path = self._broker._topic_dir(self._topic) / f"partition-{p}.log"
         with _Flock(path.with_suffix(".lock")):
             try:
                 if path.stat().st_size >= self._segment_bytes:
@@ -274,7 +311,7 @@ class _FileProducer(TopicProducer):
             except OSError:
                 pass
             with open(path, "a", encoding="utf-8") as f:
-                f.write(record + "\n")
+                f.write(payload)
 
     def _roll(self, partition: int, path: Path) -> None:
         """Archive the full active segment and start a fresh one (under

@@ -79,15 +79,32 @@ class InProcessBroker(Broker):
 
     # -- produce/consume ----------------------------------------------------
 
+    def _get_or_create(self, topic: str) -> _Topic:
+        """Caller must hold self._cond."""
+        t = self._topics.get(topic)
+        if t is None:
+            t = _Topic(topic, 1)
+            self._topics[topic] = t
+        return t
+
     def _append(self, topic: str, key: str | None, message: str) -> None:
         with self._cond:
-            t = self._topics.get(topic)
-            if t is None:
-                t = _Topic(topic, 1)
-                self._topics[topic] = t
+            t = self._get_or_create(topic)
             p = partition_for(key, len(t.partitions))
             t.partitions[p].append(KeyMessage(key, message))
             self._cond.notify_all()
+
+    def _append_many(self, topic: str, records) -> int:
+        """Batch append under one lock acquisition + one wakeup."""
+        with self._cond:
+            t = self._get_or_create(topic)
+            nparts = len(t.partitions)
+            n = 0
+            for key, message in records:
+                t.partitions[partition_for(key, nparts)].append(KeyMessage(key, message))
+                n += 1
+            self._cond.notify_all()
+            return n
 
     def producer(self, topic: str) -> TopicProducer:
         return _InProcProducer(self, topic)
@@ -113,6 +130,9 @@ class _InProcProducer(TopicProducer):
 
     def send(self, key: str | None, message: str) -> None:
         self._broker._append(self._topic, key, message)
+
+    def send_many(self, records) -> int:
+        return self._broker._append_many(self._topic, records)
 
     def close(self) -> None:
         pass

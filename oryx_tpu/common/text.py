@@ -113,3 +113,15 @@ def join_json(items: Sequence[Any]) -> str:
 
 def read_json(text: str) -> Any:
     return json.loads(text)
+
+
+_PLAIN = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_-.:@ "
+)
+
+
+def json_str(s: str) -> str:
+    """JSON string literal; quoting fast path for typical IDs."""
+    if all(c in _PLAIN for c in s):
+        return f'"{s}"'
+    return json.dumps(s)

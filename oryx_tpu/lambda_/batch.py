@@ -40,6 +40,11 @@ class BatchLayer(AbstractLayer):
         self.data_dir = config.get_string("oryx.batch.storage.data-dir")
         self.model_dir = config.get_string("oryx.batch.storage.model-dir")
         self.max_data_age_hours = config.get_int("oryx.batch.storage.max-age-data-hours")
+        self.storage_format = config.get_string("oryx.batch.storage.format")
+        if self.storage_format not in ("npz", "jsonl"):
+            raise ValueError(
+                f"oryx.batch.storage.format must be npz or jsonl, got {self.storage_format!r}"
+            )
         self.max_model_age_hours = (
             config.get_optional_int("oryx.batch.storage.max-age-model-hours") or -1
         )
@@ -120,10 +125,11 @@ class BatchLayer(AbstractLayer):
                     break
                 new_data.extend(batch)
 
-        # 2. all surviving past data (materialized here so the read-past
-        # phase metric actually measures storage I/O, not generator setup)
+        # 2. past data as a lazy columnar view — blocks stream from storage
+        # during the update itself (one stored micro-batch in memory at a
+        # time), so the phase metric covers only discovery
         with phase("read-past"):
-            past_data = list(data_store.read_past_data(self.data_dir))
+            past_data = data_store.FileRecords(self.data_dir)
 
         # 3. user update, with a producer for the update topic
         ub = self.update_broker()
@@ -139,7 +145,9 @@ class BatchLayer(AbstractLayer):
 
         # 4. persist the micro-batch
         with phase("save"):
-            data_store.save_micro_batch(self.data_dir, timestamp_ms, new_data)
+            data_store.save_micro_batch(
+                self.data_dir, timestamp_ms, new_data, fmt=self.storage_format
+            )
 
         # 5. commit offsets (UpdateOffsetsFn.java:57-65)
         if self.id:

@@ -23,21 +23,45 @@ import time
 from pathlib import Path
 from typing import Iterator
 
+import numpy as np
+
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common import storage
+from oryx_tpu.lambda_.records import RecordBlock, Records
 
-_DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.data$")
+_DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.(data|npz)$")
 _MODEL_DIR_RE = re.compile(r"^(\d+)$")
 
 
 def save_micro_batch(
-    data_dir: str | Path, timestamp_ms: int, records: list[KeyMessage]
+    data_dir: str | Path,
+    timestamp_ms: int,
+    records: list[KeyMessage],
+    fmt: str = "npz",
 ) -> str | None:
     """Append one micro-batch; empty batches write nothing
-    (SaveToHDFSFunction.java:60-66)."""
+    (SaveToHDFSFunction.java:60-66).
+
+    fmt "npz" (default) stores columnar numpy byte-string arrays — the
+    binary analogue of the reference's SequenceFile<Text,Text>, read back
+    as whole arrays with zero per-record Python. fmt "jsonl" keeps the
+    line-per-record text form (`.data`); both are read transparently."""
     if not records:
         return None
     storage.mkdirs(data_dir)
+    if fmt == "npz":
+        path = storage.join(data_dir, f"oryx-{timestamp_ms}.npz")
+        block = RecordBlock.from_key_messages(records)
+        arrays = {"messages": block.messages}
+        if block.keys is not None:
+            arrays["keys"] = block.keys
+        if block.none_keys is not None:
+            arrays["none_keys"] = block.none_keys
+        with storage.open_write(path, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        return path
+    if fmt != "jsonl":
+        raise ValueError(f"unknown micro-batch format {fmt!r} (want npz or jsonl)")
     path = storage.join(data_dir, f"oryx-{timestamp_ms}.data")
     with storage.open_write(path, "wb") as f:
         for rec in records:
@@ -47,17 +71,52 @@ def save_micro_batch(
     return path
 
 
-def read_past_data(data_dir: str | Path) -> Iterator[KeyMessage]:
-    """Stream all surviving historical records, oldest file first."""
+def _data_file_names(data_dir: str | Path) -> list[str]:
     names = [n for n in storage.list_names(data_dir) if _DATA_FILE_RE.match(n)]
     names.sort(key=lambda n: int(_DATA_FILE_RE.match(n).group(1)))
-    for name in names:
-        with storage.open_read(storage.join(data_dir, name), "rb") as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    rec = json.loads(line)
-                    yield KeyMessage(rec.get("k"), rec.get("m", ""))
+    return names
+
+
+def _read_block(path) -> RecordBlock:
+    if str(path).endswith(".npz"):
+        with storage.open_read(path, "rb") as f:
+            with np.load(f, allow_pickle=False) as z:
+                return RecordBlock(
+                    z["keys"] if "keys" in z else None,
+                    z["messages"],
+                    z["none_keys"] if "none_keys" in z else None,
+                )
+    records: list[KeyMessage] = []
+    with storage.open_read(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rec = json.loads(line)
+                records.append(KeyMessage(rec.get("k"), rec.get("m", "")))
+    if not records:
+        return RecordBlock(None, np.empty(0, dtype="S1"))
+    return RecordBlock.from_key_messages(records)
+
+
+class FileRecords(Records):
+    """Lazy view over a data dir's surviving micro-batches, oldest first:
+    one stored block in memory at a time (the re-read path of
+    BatchUpdateFunction.java:103-130, without materializing history)."""
+
+    def __init__(self, data_dir: str | Path) -> None:
+        self._dir = data_dir
+
+    def is_empty(self) -> bool:
+        return not _data_file_names(self._dir)
+
+    def blocks(self) -> Iterator[RecordBlock]:
+        for name in _data_file_names(self._dir):
+            yield _read_block(storage.join(self._dir, name))
+
+
+def read_past_data(data_dir: str | Path) -> Iterator[KeyMessage]:
+    """Stream all surviving historical records, oldest file first."""
+    return iter(FileRecords(data_dir))
 
 
 def delete_old_data(

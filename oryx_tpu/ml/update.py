@@ -33,6 +33,7 @@ from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng, storage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import collect_in_parallel
+from oryx_tpu.lambda_.records import ChainRecords, ListRecords, as_records
 from oryx_tpu.ml import param as hp
 
 log = logging.getLogger(__name__)
@@ -68,12 +69,14 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
     @abc.abstractmethod
     def build_model(
         self,
-        train_data: list[KeyMessage],
+        train_data: Iterable[KeyMessage],
         hyper_parameters: Sequence,
         candidate_path: Path,
     ) -> Element:
         """Train and return the model as a PMML element tree; large side
-        artifacts (e.g. factor matrices) go under candidate_path."""
+        artifacts (e.g. factor matrices) go under candidate_path.
+        train_data is re-iterable and may be a lambda_.records.Records
+        (columnar blocks for vectorized consumers)."""
 
     @abc.abstractmethod
     def evaluate(
@@ -81,7 +84,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         model: Element,
         model_parent_path: Path,
         test_data: list[KeyMessage],
-        train_data: list[KeyMessage],
+        train_data: Iterable[KeyMessage],
     ) -> float:
         """Higher is better (MLUpdate.java evaluation contract)."""
 
@@ -89,7 +92,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         self,
         pmml: Element,
         new_data: list[KeyMessage],
-        past_data: list[KeyMessage],
+        past_data: Iterable[KeyMessage],
         model_parent_path: Path,
         model_update_topic: TopicProducer | None,
     ) -> None:
@@ -121,13 +124,16 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         model_update_topic: TopicProducer | None,
     ) -> None:
         new_data = list(new_data)
-        past_data = list(past_data)
-        if not new_data and not past_data:
+        past_records = as_records(past_data)
+        if not new_data and past_records.is_empty():
             log.info("no data at all; nothing to do")
             return
 
         train_new, test_new = self.split_new_data_to_train_test(new_data)
-        all_train = past_data + train_new
+        # lazy concat: past data streams from storage block by block
+        # (BatchUpdateFunction's union of past RDD + new RDD), so training
+        # at 100M-rating scale never holds history as one Python list
+        all_train = ChainRecords([past_records, ListRecords(train_new)])
 
         combos = hp.choose_hyper_parameter_combos(
             self.get_hyper_parameter_values(),
@@ -182,7 +188,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                         "MODEL-REF", storage.join(final_dir, MODEL_FILE_NAME)
                     )
                 self.publish_additional_model_data(
-                    best_pmml, new_data, past_data, final_dir, model_update_topic
+                    best_pmml, new_data, past_records, final_dir, model_update_topic
                 )
         finally:
             shutil.rmtree(candidates_root, ignore_errors=True)
@@ -191,7 +197,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         self,
         candidates_root: Path,
         combos: list[list],
-        all_train: list[KeyMessage],
+        all_train: Iterable[KeyMessage],
         test_data: list[KeyMessage],
     ) -> tuple[Path, Element] | None:
         def build_and_eval(i: int) -> tuple[float, Path, Element] | None:

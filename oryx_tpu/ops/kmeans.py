@@ -31,9 +31,18 @@ def _lloyd_run(points, centers0, mask, iterations):
     """points [n, d], centers0 [k, d], mask [n] bool (False = padding row)."""
 
     def assign(points_, centers_, mask_):
+        # HIGHEST: the TPU default would compute distances in bf16 passes,
+        # flipping borderline argmin assignments vs the Pallas sweep (which
+        # accumulates in f32) and drifting the centers apart
         d2 = (
             jnp.sum(points_ * points_, axis=1, keepdims=True)
-            - 2.0 * points_ @ centers_.T
+            - 2.0
+            * jnp.dot(
+                points_,
+                centers_.T,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
             + jnp.sum(centers_ * centers_, axis=1)[None, :]
         )
         a = jnp.argmin(d2, axis=1)

@@ -80,17 +80,22 @@ def _sweep_kernel(pts_ref, ctr_ref, sums_ref, counts_ref, cost_ref, *, n_items, 
     pcounts = jnp.sum(onehot, axis=0)[None, :]  # [1, kp]
     pcost = jnp.sum(jnp.where(valid, jnp.maximum(mind2, 0.0), 0.0))
 
+    # Mosaic can't store a bare scalar into VMEM ("Cannot store scalars to
+    # VMEM" on hardware; the interpreter accepts it) — keep the cost as a
+    # (1, 1) tile end to end.
+    pcost_tile = jnp.reshape(pcost, (1, 1))
+
     @pl.when(i == 0)
     def _():
         sums_ref[:] = psums
         counts_ref[:] = pcounts
-        cost_ref[0, 0] = pcost
+        cost_ref[:, :] = pcost_tile
 
     @pl.when(i > 0)
     def _():
         sums_ref[:] += psums
         counts_ref[:] += pcounts
-        cost_ref[0, 0] += pcost
+        cost_ref[:, :] += pcost_tile
 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "k_real", "interpret"))

@@ -66,22 +66,36 @@ def upload(
     return mat, norms
 
 
+def _dot_precision(dtype):
+    """f32 scoring gets true f32 MXU accumulation — the TPU default would
+    silently drop f32 matmuls to bf16 passes, making the "exact" XLA path
+    *less* precise than the Pallas kernel it is the reference twin for.
+    bf16 inputs stay on the intentional fast path."""
+    return jax.lax.Precision.HIGHEST if dtype == jnp.float32 else jax.lax.Precision.DEFAULT
+
+
 @functools.partial(jax.jit, static_argnums=2)
 def _dot_topk(mat, query, k):
-    scores = (mat @ query).astype(jnp.float32)
+    scores = jnp.dot(
+        mat, query, preferred_element_type=jnp.float32, precision=_dot_precision(mat.dtype)
+    )
     return jax.lax.top_k(scores, k)
 
 
 @functools.partial(jax.jit, static_argnums=3)
 def _cosine_topk(mat, norms, query, k):
     qn = jnp.linalg.norm(query.astype(jnp.float32))
-    scores = (mat @ query).astype(jnp.float32) / jnp.maximum(norms * qn, 1e-12)
+    scores = jnp.dot(
+        mat, query, preferred_element_type=jnp.float32, precision=_dot_precision(mat.dtype)
+    ) / jnp.maximum(norms * qn, 1e-12)
     return jax.lax.top_k(scores, k)
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _dot_topk_batch(mat, norms, queries, k, cosine):
-    scores = (queries @ mat.T).astype(jnp.float32)  # [b, n]
+    scores = jnp.dot(
+        queries, mat.T, preferred_element_type=jnp.float32, precision=_dot_precision(mat.dtype)
+    )  # [b, n]
     if cosine:
         qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
         scores = scores / jnp.maximum(norms[None, :] * qn, 1e-12)

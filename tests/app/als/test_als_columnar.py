@@ -1,0 +1,159 @@
+"""Columnar (vectorized) ALS data path: equivalence with the per-line
+reference implementations in app/als/data.py, plus the npz micro-batch
+format and lazy FileRecords streaming (VERDICT r3 #5)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als import data as als_data
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.lambda_ import data as data_store
+from oryx_tpu.lambda_.records import (
+    ChainRecords,
+    ListRecords,
+    RecordBlock,
+    as_records,
+)
+
+
+def _lines_to_block(lines):
+    return np.array([ln.encode("utf-8") for ln in lines], dtype="S")
+
+
+def _cols_as_tuples(cols):
+    return [
+        (u.decode(), i.decode(), v, t)
+        for u, i, v, t in zip(
+            cols.users.tolist(), cols.items.tolist(), cols.values, cols.timestamps
+        )
+    ]
+
+
+PLAIN_LINES = [
+    "u1,i1,5,100",
+    "u2,i2,3.5,200",
+    "u1,i2,,300",  # delete marker
+    "u3,i1,2",  # no timestamp
+    "u2,i1,1.25,400",
+]
+
+
+def test_parse_block_matches_per_line():
+    cols = als_data.parse_interaction_block(_lines_to_block(PLAIN_LINES))
+    ref = als_data.parse_interactions(PLAIN_LINES)
+    got = _cols_as_tuples(cols)
+    assert len(got) == len(ref)
+    for (gu, gi, gv, gt), r in zip(got, ref):
+        assert (gu, gi) == (r.user, r.item)
+        assert gt == r.timestamp_ms
+        assert (math.isnan(gv) and math.isnan(r.value)) or gv == pytest.approx(r.value)
+
+
+def test_parse_block_quoted_and_json_fall_back():
+    lines = ['"a,b",i1,2,5', '["u2","i2",3,7]']
+    cols = als_data.parse_interaction_block(_lines_to_block(lines))
+    assert _cols_as_tuples(cols)[0][:2] == ("a,b", "i1")
+    assert _cols_as_tuples(cols)[1][:2] == ("u2", "i2")
+
+
+def test_parse_block_bad_line_raises():
+    with pytest.raises(ValueError):
+        als_data.parse_interaction_block(_lines_to_block(["only-one-field"]))
+
+
+@pytest.mark.parametrize("implicit", [True, False])
+def test_rating_matrix_from_columns_matches_reference(implicit):
+    lines = [
+        "u1,i1,2,100",
+        "u1,i1,3,50",  # same pair: implicit sums, explicit last-by-ts wins
+        "u2,i1,1,10",
+        "u2,i2,,20",  # delete => pair dropped entirely
+        "u3,i3,4,30",
+    ]
+    cols = als_data.parse_interaction_block(_lines_to_block(lines))
+    got = als_data.rating_matrix_from_columns(cols, implicit)
+    inter = als_data.parse_interactions(lines)
+    want = als_data.to_rating_matrix(als_data.aggregate(inter, implicit))
+    assert got.user_ids == want.user_ids
+    assert got.item_ids == want.item_ids
+    got_map = {
+        (got.user_ids[u], got.item_ids[i]): v
+        for u, i, v in zip(got.user_idx, got.item_idx, got.values)
+    }
+    want_map = {
+        (want.user_ids[u], want.item_ids[i]): v
+        for u, i, v in zip(want.user_idx, want.item_idx, want.values)
+    }
+    assert got_map == pytest.approx(want_map)
+
+
+def test_decay_columns_matches_reference():
+    lines = ["u1,i1,4,0", "u2,i2,0.001,0", "u3,i3,,0"]
+    now = 2 * 86_400_000  # two days later
+    cols = als_data.decay_columns(
+        als_data.parse_interaction_block(_lines_to_block(lines)),
+        factor=0.5,
+        zero_threshold=0.01,
+        now_ms=now,
+    )
+    ref = als_data.decay_interactions(
+        als_data.parse_interactions(lines), 0.5, 0.01, now_ms=now
+    )
+    got = _cols_as_tuples(cols)
+    assert len(got) == len(ref) == 2  # 0.001 decayed below threshold, pruned
+    assert got[0][2] == pytest.approx(4 * 0.5**2)
+    assert math.isnan(got[1][2])
+
+
+def test_npz_micro_batch_round_trip(tmp_path):
+    recs = [KeyMessage("k1", "hello"), KeyMessage(None, "world,2,3")]
+    path = data_store.save_micro_batch(tmp_path / "d", 123, recs)
+    assert path.endswith("oryx-123.npz")
+    back = list(data_store.read_past_data(tmp_path / "d"))
+    assert back == recs
+
+
+def test_file_records_streams_blocks_lazily(tmp_path):
+    d = tmp_path / "d"
+    data_store.save_micro_batch(d, 1, [KeyMessage(None, "a,b,1")])
+    data_store.save_micro_batch(d, 2, [KeyMessage(None, "c,d,2")], fmt="jsonl")
+    fr = data_store.FileRecords(d)
+    assert not fr.is_empty()
+    blocks = list(fr.blocks())
+    assert len(blocks) == 2  # one per stored file, npz + jsonl mixed
+    assert [m.message for m in fr] == ["a,b,1", "c,d,2"]
+    # re-iterable: a second pass sees the same data
+    assert [m.message for m in fr] == ["a,b,1", "c,d,2"]
+
+
+def test_chain_records_and_empty():
+    a = ListRecords([KeyMessage(None, "x,y,1")])
+    chain = ChainRecords([as_records([]), a])
+    assert not chain.is_empty()
+    assert [m.message for m in chain] == ["x,y,1"]
+    assert ChainRecords([ListRecords([])]).is_empty()
+
+
+def test_record_block_preserves_none_keys():
+    block = RecordBlock.from_key_messages(
+        [KeyMessage(None, "m1"), KeyMessage("k", "m2")]
+    )
+    back = list(block.iter_key_messages())
+    assert back[0].key is None
+    assert back[1].key == "k"
+
+
+def test_empty_string_key_survives_round_trip(tmp_path):
+    recs = [KeyMessage("", "m-empty"), KeyMessage(None, "m-none"), KeyMessage("k", "m-k")]
+    for fmt in ("npz", "jsonl"):
+        d = tmp_path / fmt
+        data_store.save_micro_batch(d, 1, recs, fmt=fmt)
+        back = list(data_store.read_past_data(d))
+        assert [r.key for r in back] == ["", None, "k"], fmt
+
+
+def test_unknown_format_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        data_store.save_micro_batch(tmp_path, 1, [KeyMessage(None, "m")], fmt="parquet")

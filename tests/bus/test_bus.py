@@ -137,3 +137,39 @@ def test_file_consumer_incremental_polls_no_dupes(tmp_path):
     assert len(seen) == 50
     assert len(set(seen)) == 50
     c.close()
+
+
+def test_send_many_round_trips(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", partitions=2)
+    with broker.producer("T") as p:
+        n = p.send_many((f"k{i}", f"m{i}") for i in range(50))
+    assert n == 50
+    got = broker.consumer("T", from_beginning=True).poll(max_records=100, timeout=1.0)
+    assert sorted(m.message for m in got) == sorted(f"m{i}" for i in range(50))
+    by_key = {m.key: m.message for m in got}
+    assert by_key["k7"] == "m7"
+
+
+def test_file_send_many_one_lock_per_partition_batch(tmp_path, monkeypatch):
+    """The batched producer must pay one flock acquisition per partition per
+    batch, not one per record (TopicProducerImpl.java:194-202 analogue)."""
+    from oryx_tpu.bus import filebus
+
+    loc = f"file:{tmp_path}/bus"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", partitions=1)
+    locks = []
+    real_enter = filebus._Flock.__enter__
+
+    def counting_enter(self):
+        locks.append(self._path)
+        return real_enter(self)
+
+    monkeypatch.setattr(filebus._Flock, "__enter__", counting_enter)
+    with broker.producer("T") as p:
+        p.send_many((None, f"m{i}") for i in range(1000))
+    assert len(locks) == 1
+    got = broker.consumer("T", from_beginning=True).poll(max_records=2000, timeout=1.0)
+    assert len(got) == 1000
+    assert got[0].message == "m0" and got[-1].message == "m999"

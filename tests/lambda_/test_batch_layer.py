@@ -109,6 +109,6 @@ def test_old_data_gc(tmp_path):
     data_store.save_micro_batch(d, 1000, [KeyMessage(None, "old")])
     data_store.save_micro_batch(d, 10_000_000, [KeyMessage(None, "new")])
     deleted = data_store.delete_old_data(d, max_age_hours=1, now_ms=10_000_000 + 3_600_000)
-    assert [p.rsplit("/", 1)[-1] for p in deleted] == ["oryx-1000.data"]
+    assert [p.rsplit("/", 1)[-1] for p in deleted] == ["oryx-1000.npz"]
     assert [r.message for r in data_store.read_past_data(d)] == ["new"]
     assert data_store.delete_old_data(d, max_age_hours=-1) == []

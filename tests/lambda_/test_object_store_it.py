@@ -70,7 +70,7 @@ def test_batch_persists_and_publishes_via_object_store():
     assert "MODEL" in keys  # small PMML ships inline
     assert any(k == "UP" for k in keys)
     # data and model landed on the object store
-    assert storage.list_names("memory://oryx-it/data/") == ["oryx-1700000000000.data"]
+    assert storage.list_names("memory://oryx-it/data/") == ["oryx-1700000000000.npz"]
     names = storage.list_names("memory://oryx-it/model/1700000000000")
     assert "model.pmml" in names and "X" in names and "Y" in names
     # a second generation reads past data back from the store: the model

@@ -27,7 +27,8 @@ class MockMLUpdate(MLUpdate):
         return [hp.unordered([1, 2, 3])]
 
     def build_model(self, train_data, hyper_parameters, candidate_path):
-        self.train_counts.append(len(train_data))
+        # train_data is re-iterable (Records), not a list — count by iterating
+        self.train_counts.append(sum(1 for _ in train_data))
         root = pmml_io.build_skeleton_pmml()
         pmml_io.sub(root, "Extension", {"name": "param", "value": str(hyper_parameters[0])})
         return root
