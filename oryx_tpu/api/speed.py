@@ -30,6 +30,15 @@ class SpeedModelManager(abc.ABC):
         in-memory model state; runs on a dedicated thread
         (SpeedLayer.java:107-131)."""
 
+    def consume_blocks(self, block_iterator) -> None:
+        """Columnar form of consume: an iterator of RecordBlocks. The
+        default adapts to the per-record consume(); managers on the hot
+        self-consume path (ALS at 100K+ deltas/s) override this to parse
+        whole blocks vectorized."""
+        self.consume(
+            km for block in block_iterator for km in block.iter_key_messages()
+        )
+
     @abc.abstractmethod
     def build_updates(self, new_data: Iterable[KeyMessage]) -> Iterable[str]:
         """Given one micro-batch of input, return serialized model updates;

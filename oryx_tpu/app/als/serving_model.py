@@ -85,6 +85,10 @@ class ALSServingModel(ServingModel):
         self._y_matrix = None  # device array [n, k]
         self._y_host: np.ndarray | None = None  # host copy, LSH path only
         self._y_partitions: np.ndarray | None = None  # LSH partition per row
+        # incremental refresh state: ids written since the last build, and
+        # whether membership may have shrunk (rotation) forcing a rebuild
+        self._dirty_ids: set[str] = set()
+        self._y_full_rebuild = True
 
     # -- vectors -------------------------------------------------------------
 
@@ -107,6 +111,7 @@ class ALSServingModel(ServingModel):
             self._yty_solver = None
         with self._cache_lock:
             self._y_dirty = True
+            self._dirty_ids.add(item)
 
     # -- known items (ALSServingModel.java:189-258) --------------------------
 
@@ -171,6 +176,7 @@ class ALSServingModel(ServingModel):
             self._yty_solver = None  # rotation invalidates the cached YtY
         with self._cache_lock:
             self._y_dirty = True
+            self._y_full_rebuild = True  # membership may have shrunk
 
     def retain_recent_and_known_items(self, user_ids: set[str]) -> None:
         with self._known_lock.write():
@@ -187,25 +193,58 @@ class ALSServingModel(ServingModel):
 
     # -- device-side scoring ---------------------------------------------------
 
+    def _try_incremental_refresh(self, dirty: list[str]) -> bool:
+        """Scatter-update only the dirty rows of the device-resident Y
+        (caller holds the cache lock). Returns False when a full rebuild
+        is required: membership shrank, a dirty vector vanished, new ids
+        exceed padded capacity, or the LSH host path is active."""
+        vals, valid = self.y.get_batch(dirty, dim=self.features)
+        if not np.all(valid):
+            return False  # a dirty id has no vector anymore
+        new_ids = [d for d in dirty if d not in self._y_index]
+        if len(self._y_ids) + len(new_ids) > topn_ops.capacity(self._y_matrix):
+            return False
+        for d in new_ids:  # append into the padded region
+            self._y_index[d] = len(self._y_ids)
+            self._y_ids.append(d)
+        rows = np.fromiter(
+            (self._y_index[d] for d in dirty), dtype=np.int32, count=len(dirty)
+        )
+        self._y_matrix = topn_ops.update_rows(
+            self._y_matrix, rows, vals, n_items=len(self._y_ids)
+        )
+        return True
+
     def _ensure_y_matrix(self, force: bool = False):
         with self._cache_lock:
             now = time.monotonic()
             if self._y_dirty and (force or now - self._y_built_at >= self._refresh_sec):
-                ids, mat = self.y.to_matrix()
-                self._y_ids = ids
-                self._y_index = {id_: i for i, id_ in enumerate(ids)}
-                if len(ids):
-                    import jax.numpy as jnp
+                dirty = list(self._dirty_ids)
+                refreshed = (
+                    self._y_matrix is not None
+                    and not self._y_full_rebuild
+                    and self.lsh is None
+                    and bool(dirty)
+                    and self._try_incremental_refresh(dirty)
+                )
+                if not refreshed:
+                    ids, mat = self.y.to_matrix()
+                    self._y_ids = ids
+                    self._y_index = {id_: i for i, id_ in enumerate(ids)}
+                    if len(ids):
+                        import jax.numpy as jnp
 
-                    dtype = jnp.bfloat16 if self.score_dtype == "bfloat16" else jnp.float32
-                    self._y_matrix = topn_ops.upload(mat, dtype=dtype)
-                else:
-                    self._y_matrix = None
-                if self.lsh is not None:
-                    self._y_host = mat
-                    self._y_partitions = (
-                        self.lsh.partitions_for(mat) if len(ids) else None
-                    )
+                        dtype = jnp.bfloat16 if self.score_dtype == "bfloat16" else jnp.float32
+                        self._y_matrix = topn_ops.upload(mat, dtype=dtype)
+                    else:
+                        self._y_matrix = None
+                    if self.lsh is not None:
+                        self._y_host = mat
+                        self._y_partitions = (
+                            self.lsh.partitions_for(mat) if len(ids) else None
+                        )
+                    self._y_full_rebuild = False
+                self._dirty_ids.clear()
                 self._y_dirty = False
                 self._y_built_at = now
             # host/partition arrays are returned under the lock so one

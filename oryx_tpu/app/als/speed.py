@@ -23,6 +23,7 @@ from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.records import Records
 from oryx_tpu.common.text import json_str as _json_str, read_json
 from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
 from oryx_tpu.native.store import (
@@ -60,6 +61,25 @@ class ALSSpeedModel(SpeedModel):
     def set_item_vector(self, item: str, vector: np.ndarray) -> None:
         self.y.set_vector(item, vector)
         self._expected_items.discard(item)
+        with self._solver_lock:
+            self._yty_solver = None
+
+    def set_user_vectors(self, users: list[str], vectors: np.ndarray) -> None:
+        """Batched set: one expected-set update + one solver invalidation
+        for the whole batch (the per-record form pays both per delta —
+        ruinous at 100K+ self-consumed deltas/s)."""
+        x = self.x
+        for user, vec in zip(users, vectors):
+            x.set_vector(user, vec)
+        self._expected_users.difference_update(users)
+        with self._solver_lock:
+            self._xtx_solver = None
+
+    def set_item_vectors(self, items: list[str], vectors: np.ndarray) -> None:
+        y = self.y
+        for item, vec in zip(items, vectors):
+            y.set_vector(item, vec)
+        self._expected_items.difference_update(items)
         with self._solver_lock:
             self._yty_solver = None
 
@@ -104,6 +124,74 @@ class ALSSpeedModelManager(SpeedModelManager):
 
     # -- update-topic consumption (ALSSpeedModelManager.consume:74-126) ------
 
+    def consume_blocks(self, block_iterator) -> None:
+        """Columnar consume: contiguous runs of "UP" records parse as one
+        vectorized batch (ids sliced with bytes ops, all float components
+        converted in a single numpy astype) and apply via the batched
+        setters. Everything else — MODEL/MODEL-REF, escaped ids, malformed
+        lines — falls back to the per-record consume in order."""
+        for block in block_iterator:
+            if self.model is None or block.keys is None:
+                self.consume(block.iter_key_messages())
+                continue
+            keys = block.keys.tolist()
+            msgs = block.messages.tolist()
+            n = len(msgs)
+            i = 0
+            while i < n:
+                if keys[i] == b"UP":
+                    j = i
+                    while j < n and keys[j] == b"UP":
+                        j += 1
+                    self._apply_up_batch(msgs[i:j])
+                    i = j
+                else:
+                    self.consume(iter([KeyMessage(
+                        keys[i].decode("utf-8", "replace"),
+                        msgs[i].decode("utf-8", "replace"),
+                    )]))
+                    i += 1
+
+    def _apply_up_batch(self, lines: list[bytes]) -> None:
+        model = self.model
+        k = model.features
+        groups = {
+            b'["X","': ([], [], [], model.set_user_vectors),
+            b'["Y","': ([], [], [], model.set_item_vectors),
+        }
+        slow: list[bytes] = []
+        for ln in lines:
+            group = groups.get(ln[:6])
+            if group is None:
+                slow.append(ln)
+                continue
+            at = ln.find(b'",[', 6)
+            end = ln.find(b"]", at + 3) if at != -1 else -1
+            if at == -1 or end == -1 or b"\\" in ln[:at]:
+                slow.append(ln)  # escaped/odd id or shape: per-record path
+                continue
+            group[0].append(ln[6:at].decode("utf-8"))
+            group[1].append(ln[at + 3 : end])
+            group[2].append(ln)
+        for ids, vecs, origs, setter in groups.values():
+            if not ids:
+                continue
+            parts = b",".join(vecs).split(b",")
+            mat = None
+            if len(parts) == len(ids) * k:
+                try:
+                    mat = np.array(parts, dtype="S").astype(np.float32).reshape(len(ids), k)
+                except ValueError:
+                    mat = None
+            if mat is None:
+                slow.extend(origs)  # oddball numerics: whole group per-record
+            else:
+                setter(ids, mat)
+        if slow:
+            self.consume(
+                KeyMessage("UP", ln.decode("utf-8", "replace")) for ln in slow
+            )
+
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         for km in update_iterator:
             key, message = km.key, km.message
@@ -144,9 +232,25 @@ class ALSSpeedModelManager(SpeedModelManager):
         model = self.model
         if model is None:
             return []
-        interactions = als_data.parse_interactions(new_data)
-        agg = als_data.aggregate(interactions, self.implicit)
-        if not agg:
+        # columnar parse + aggregate: one numpy pass over the micro-batch
+        # (same semantics as parse_interactions + aggregate; the indexed
+        # form gives aggregated (user, item, value) triples directly).
+        # Records input (the layer's poll_block drain) stays columnar end
+        # to end; plain iterables pay one encode per record.
+        if isinstance(new_data, Records):
+            cols = als_data.concat_columns(
+                [als_data.parse_interaction_block(b.messages) for b in new_data.blocks()]
+            )
+        else:
+            msgs = [
+                (km if isinstance(km, str) else km.message).encode("utf-8")
+                for km in new_data
+            ]
+            if not msgs:
+                return []
+            cols = als_data.parse_interaction_block(msgs)
+        rm = als_data.rating_matrix_from_columns(cols, self.implicit)
+        if len(rm.values) == 0:
             return []
         try:
             yty = model.get_yty_solver()
@@ -164,12 +268,12 @@ class ALSSpeedModelManager(SpeedModelManager):
         # native call each) — the per-event hot path has no Python in it.
         from oryx_tpu.ops import als as als_ops
 
-        n = len(agg)
-        users = [u for (u, _) in agg]
-        items = [i for (_, i) in agg]
+        n = len(rm.values)
+        users = [rm.user_ids[j] for j in rm.user_idx]
+        items = [rm.item_ids[j] for j in rm.item_idx]
         xu, xu_valid = model.x.get_batch(users, dim=model.features)
         yi, yi_valid = model.y.get_batch(items, dim=model.features)
-        values = np.fromiter((v for v in agg.values()), dtype=np.float32, count=n)
+        values = rm.values
         new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
             yty.matrix, xtx.matrix, xu, xu_valid, yi, yi_valid, values,
             self.implicit, backend=self.fold_backend,
@@ -189,7 +293,7 @@ class ALSSpeedModelManager(SpeedModelManager):
         out: list[str] = []
         x_json = dict(zip(x_rows, format_vectors_json(new_xu[x_rows])))
         y_json = dict(zip(y_rows, format_vectors_json(new_yi[y_rows])))
-        for j, (user, item) in enumerate(agg):
+        for j, (user, item) in enumerate(zip(users, items)):
             vec = x_json.get(j)
             if vec is not None:
                 out.append(self._assemble("X", user, vec, item))

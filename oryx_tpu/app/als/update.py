@@ -34,7 +34,7 @@ from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng
 from oryx_tpu.common import storage
-from oryx_tpu.lambda_.records import ChainRecords, Records, as_records
+from oryx_tpu.common.records import ChainRecords, Records, as_records
 from oryx_tpu.common.config import Config
 from oryx_tpu.ml import param as hp
 from oryx_tpu.ml.update import MLUpdate
@@ -71,7 +71,7 @@ class ALSUpdate(MLUpdate):
 
     def _prepare(self, data: Iterable[KeyMessage]) -> als_data.RatingMatrix:
         """Columnar parse -> decay -> aggregate -> indexed COO, one
-        micro-batch block at a time (lambda_.records streams stored
+        micro-batch block at a time (common.records streams stored
         blocks, so nothing materializes a giant per-line Python list)."""
         parts: list[als_data.InteractionColumns] = []
         if isinstance(data, Records):

@@ -73,6 +73,18 @@ class TopicConsumer(abc.ABC):
     @abc.abstractmethod
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]: ...
 
+    def poll_block(self, max_records: int = 1000, timeout: float = 0.1):
+        """Columnar poll: one RecordBlock of byte-string arrays (None when
+        nothing arrived). High-rate consumers (the speed layer at 100K+
+        events/s) use this to skip per-record object construction; brokers
+        override it to skip per-record decoding entirely."""
+        from oryx_tpu.common.records import RecordBlock
+
+        records = self.poll(max_records, timeout)
+        if not records:
+            return None
+        return RecordBlock.from_key_messages(records)
+
     @abc.abstractmethod
     def positions(self) -> dict[int, int]:
         """Current partition -> next-offset map."""

@@ -37,6 +37,8 @@ import os
 import time
 from pathlib import Path
 
+import numpy as np
+
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, partition_for
 
 _OFFSETS_DIR = "__offsets__"
@@ -363,9 +365,11 @@ class _FileConsumer(TopicConsumer):
         # name, so the cached byte stays valid for the same content.
         self._cursor: dict[int, tuple[int, int]] = {}
 
-    def _read_partition(self, i: int, budget: int, out: list[KeyMessage]) -> None:
-        """Append up to `budget` records from partition i, walking the
-        segment chain from self._pos[i]."""
+    def _read_partition_raw(self, i: int, budget: int, out: list[bytes]) -> None:
+        """Append up to `budget` complete raw record lines (bytes, newline
+        stripped) from partition i, walking the segment chain from
+        self._pos[i]. Decoding is the caller's job — the hot consume path
+        (poll_block) decodes whole batches columnar instead."""
         broker = self._broker
         while budget > 0:
             segs = broker._segments(self._topic, i)
@@ -397,13 +401,9 @@ class _FileConsumer(TopicConsumer):
                         break  # partial tail of an in-flight append; retry
                     got += 1
                     self._cursor[i] = (seg_base, f.tell())
-                    line = raw.decode("utf-8", errors="replace").strip()
+                    line = raw[:-1]
                     if line:
-                        try:
-                            rec = json.loads(line)
-                        except json.JSONDecodeError:
-                            continue  # corrupt complete line: skip it for good
-                        out.append(KeyMessage(rec.get("k"), rec.get("m", "")))
+                        out.append(line)
                         budget -= 1
             self._pos[i] += got
             if is_active or got == 0:
@@ -411,6 +411,30 @@ class _FileConsumer(TopicConsumer):
                 # (roll race: re-resolve next poll instead of spinning)
                 return
             # archived segment exhausted: fall through to the next one
+
+    @staticmethod
+    def _decode_line(line: bytes) -> KeyMessage | None:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return None  # corrupt complete line: skip it for good
+        return KeyMessage(rec.get("k"), rec.get("m", ""))
+
+    def _read_partition(self, i: int, budget: int, out: list[KeyMessage]) -> None:
+        """Append up to `budget` records from partition i."""
+        while budget > 0:
+            raw: list[bytes] = []
+            self._read_partition_raw(i, budget, raw)
+            if not raw:
+                return
+            exhausted = len(raw) < budget  # raw gave all it currently has
+            for line in raw:
+                rec = self._decode_line(line)
+                if rec is not None:
+                    out.append(rec)
+                    budget -= 1
+            if exhausted:
+                return
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
         deadline = time.monotonic() + timeout
@@ -423,6 +447,83 @@ class _FileConsumer(TopicConsumer):
             if out or self._closed or time.monotonic() >= deadline:
                 return out
             time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+    # wire-format affixes for the no-escape fast path in poll_block
+    _NULLKEY_PREFIX = b'{"k":null,"m":"'
+    _STRKEY_PREFIX = b'{"k":"'
+    _KEY_MSG_SEP = b'","m":"'
+    _SUFFIX = b'"}'
+
+    def poll_block(self, max_records: int = 1000, timeout: float = 0.1):
+        """Columnar poll: raw record lines are sliced with bytes ops — no
+        per-record json.loads, str decode, or KeyMessage construction.
+        Records whose JSON contains escapes (a quote, non-ASCII, control
+        chars — json.dumps would emit a backslash) take the per-line
+        fallback; the wire fast path covers every record the framework's
+        own producers emit for plain CSV payloads. This is what lets one
+        consumer thread keep up with 100K+ events/s."""
+        from oryx_tpu.common.records import RecordBlock
+
+        deadline = time.monotonic() + timeout
+        while True:
+            raw: list[bytes] = []
+            for i in sorted(self._pos):
+                self._read_partition_raw(i, max_records - len(raw), raw)
+                if len(raw) >= max_records:
+                    break
+            if raw:
+                return self._lines_to_block(raw, RecordBlock)
+            if self._closed or time.monotonic() >= deadline:
+                return None
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+    def _lines_to_block(self, raw: list[bytes], RecordBlock):
+        msgs: list[bytes] = []
+        keys: list[bytes] = []
+        nones: list[bool] = []
+        any_key = False
+        npfx, spfx, sep, sfx = (
+            self._NULLKEY_PREFIX,
+            self._STRKEY_PREFIX,
+            self._KEY_MSG_SEP,
+            self._SUFFIX,
+        )
+        for line in raw:
+            if b"\\" not in line and line.endswith(sfx):
+                if line.startswith(npfx):
+                    msgs.append(line[len(npfx) : -2])
+                    keys.append(b"")
+                    nones.append(True)
+                    continue
+                if line.startswith(spfx):
+                    at = line.find(sep, len(spfx))
+                    if at != -1:
+                        keys.append(line[len(spfx) : at])
+                        msgs.append(line[at + len(sep) : -2])
+                        nones.append(False)
+                        any_key = True
+                        continue
+            rec = self._decode_line(line)  # escaped or corrupt: slow path
+            if rec is None:
+                continue
+            if rec.key is None:
+                keys.append(b"")
+                nones.append(True)
+            else:
+                keys.append(rec.key.encode("utf-8"))
+                nones.append(False)
+                any_key = True
+            msgs.append(rec.message.encode("utf-8"))
+        if not msgs:
+            return None
+        np_msgs = np.array(msgs, dtype="S")
+        if not any_key:
+            return RecordBlock(None, np_msgs)
+        return RecordBlock(
+            np.array(keys, dtype="S"),
+            np_msgs,
+            np.array(nones, dtype=bool) if any(nones) else None,
+        )
 
     def positions(self) -> dict[int, int]:
         return dict(self._pos)

@@ -111,6 +111,23 @@ class ListRecords(Records):
         return len(self._records)
 
 
+class BlockRecords(Records):
+    """A materialized list of columnar blocks (e.g. drained poll_block
+    batches)."""
+
+    def __init__(self, blocks: Sequence[RecordBlock]) -> None:
+        self._blocks = list(blocks)
+
+    def blocks(self) -> Iterator[RecordBlock]:
+        return iter(self._blocks)
+
+    def is_empty(self) -> bool:
+        return not any(len(b) for b in self._blocks)
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._blocks)
+
+
 class ChainRecords(Records):
     """Concatenation of collections, kept lazy (past + new train data)."""
 

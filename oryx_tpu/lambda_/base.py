@@ -89,3 +89,14 @@ def blocking_iterator(consumer: TopicConsumer, stop_event: threading.Event) -> I
     while not stop_event.is_set() and not consumer.closed():
         for rec in consumer.poll(timeout=0.2):
             yield rec
+
+
+def blocking_block_iterator(consumer: TopicConsumer, stop_event: threading.Event):
+    """Endless RecordBlock iterator over a consumer (columnar poll),
+    ending on close/stop. The high-rate twin of blocking_iterator: model
+    consumers that can apply whole blocks at once (vectorized UP parsing)
+    drain the update topic without per-record decoding."""
+    while not stop_event.is_set() and not consumer.closed():
+        block = consumer.poll_block(max_records=10_000, timeout=0.2)
+        if block is not None:
+            yield block

@@ -27,7 +27,7 @@ import numpy as np
 
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common import storage
-from oryx_tpu.lambda_.records import RecordBlock, Records
+from oryx_tpu.common.records import RecordBlock, Records
 
 _DATA_FILE_RE = re.compile(r"^oryx-(\d+)\.(data|npz)$")
 _MODEL_DIR_RE = re.compile(r"^(\d+)$")

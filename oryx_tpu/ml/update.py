@@ -20,6 +20,7 @@ Rebuild of framework/oryx-ml/.../MLUpdate.java:59-373. Per generation:
 from __future__ import annotations
 
 import abc
+import contextlib
 import logging
 import math
 import shutil
@@ -33,7 +34,7 @@ from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng, storage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import collect_in_parallel
-from oryx_tpu.lambda_.records import ChainRecords, ListRecords, as_records
+from oryx_tpu.common.records import ChainRecords, ListRecords, as_records
 from oryx_tpu.ml import param as hp
 
 log = logging.getLogger(__name__)
@@ -75,7 +76,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
     ) -> Element:
         """Train and return the model as a PMML element tree; large side
         artifacts (e.g. factor matrices) go under candidate_path.
-        train_data is re-iterable and may be a lambda_.records.Records
+        train_data is re-iterable and may be a common.records.Records
         (columnar blocks for vectorized consumers)."""
 
     @abc.abstractmethod
@@ -200,12 +201,32 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         all_train: Iterable[KeyMessage],
         test_data: list[KeyMessage],
     ) -> tuple[Path, Element] | None:
+        # Disjoint sub-meshes: with N>1 parallel candidates and enough
+        # devices, each candidate trains on its own contiguous device
+        # subset — genuinely concurrent accelerator work, the analogue of
+        # MLUpdate.java:256-288's parallel Spark jobs. With one device (or
+        # parallelism 1) every group is the full device set: the serial
+        # fallback costs nothing.
+        from oryx_tpu.parallel import mesh as mesh_mod
+
+        groups = (
+            mesh_mod.partition_devices(self.eval_parallelism)
+            if self.eval_parallelism > 1 and len(combos) > 1
+            else None
+        )
+
         def build_and_eval(i: int) -> tuple[float, Path, Element] | None:
             candidate_path = candidates_root / str(i)
             candidate_path.mkdir(parents=True, exist_ok=True)
             hyper_parameters = combos[i]
+            scope = (
+                mesh_mod.device_scope(groups[i % len(groups)])
+                if groups
+                else contextlib.nullcontext()
+            )
             try:
-                model = self.build_model(all_train, hyper_parameters, candidate_path)
+                with scope:
+                    model = self.build_model(all_train, hyper_parameters, candidate_path)
             except Exception:
                 log.exception("failed to build candidate %d (%s)", i, hyper_parameters)
                 return None

@@ -128,6 +128,72 @@ def top_k_scores_batch(uploaded, queries: np.ndarray, k: int, cosine: bool = Fal
     return np.asarray(i), np.asarray(s)
 
 
+# -- incremental updates ------------------------------------------------------
+
+
+# No donation: in-flight top-k requests may still hold the previous
+# handle, and donating would delete their buffers mid-request. The
+# device-side copy this costs is HBM-internal (no host transfer — the
+# thing incremental refresh exists to avoid) and transient.
+@jax.jit
+def _scatter_rows_t(mat_t, norms, rows, vals, new_norms):
+    """Feature-major scatter: mat_t[:, rows] <- vals.T, norms[0, rows] <- n."""
+    mat_t = mat_t.at[:, rows].set(vals.T.astype(mat_t.dtype))
+    norms = norms.at[0, rows].set(new_norms)
+    return mat_t, norms
+
+
+@jax.jit
+def _scatter_rows(mat, norms, rows, vals, new_norms):
+    mat = mat.at[rows].set(vals.astype(mat.dtype))
+    norms = norms.at[rows].set(new_norms)
+    return mat, norms
+
+
+def capacity(uploaded) -> int:
+    """Row capacity of the handle (padding included); rows beyond
+    ``n_items`` can be appended in place on the streaming layout."""
+    if isinstance(uploaded, StreamingItemMatrix):
+        return uploaded.mat_t.shape[1]
+    mat, _ = uploaded
+    return mat.shape[0]
+
+
+def update_rows(uploaded, rows: np.ndarray, values: np.ndarray, n_items: int | None = None):
+    """Scatter-update `rows` of an uploaded item matrix with `values`
+    [len(rows), k] — the incremental-refresh path (SURVEY §7
+    'incremental serving state vs immutable device arrays'): a handful
+    of dirty vectors ship a few KB host->device instead of the whole
+    matrix. For the streaming layout, `n_items` may grow into the padded
+    capacity (append of new items without realloc).
+
+    The row-count is bucketed to a power of two (padding repeats the last
+    row) so jit retraces O(log n) scatter shapes, not one per batch size.
+    """
+    rows = np.asarray(rows, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    m = len(rows)
+    if m == 0:
+        return uploaded
+    bucket = 1 << (m - 1).bit_length()
+    if bucket != m:
+        pad = bucket - m
+        rows = np.concatenate([rows, np.repeat(rows[-1:], pad)])
+        values = np.concatenate([values, np.repeat(values[-1:], pad, axis=0)])
+    new_norms = np.linalg.norm(values, axis=1)
+    if isinstance(uploaded, StreamingItemMatrix):
+        mat_t, norms = _scatter_rows_t(
+            uploaded.mat_t, uploaded.norms, rows, values, new_norms
+        )
+        return StreamingItemMatrix(
+            mat_t=mat_t,
+            norms=norms,
+            n_items=uploaded.n_items if n_items is None else n_items,
+        )
+    mat, norms = uploaded
+    return _scatter_rows(mat, norms, rows, values, new_norms)
+
+
 @dataclass
 class TopNHandle:
     """In-flight async top-k request; ``result()`` blocks and returns

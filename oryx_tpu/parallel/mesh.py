@@ -9,7 +9,9 @@ object of axis-name -> size.
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import Mapping
 
 import jax
@@ -18,10 +20,43 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 
+# Per-thread device-subset override: hyperparameter candidates train
+# concurrently on disjoint sub-meshes (MLUpdate.java:256-288 runs them as
+# parallel Spark jobs; here each candidate thread scopes its own devices).
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def device_scope(devices):
+    """Restrict mesh construction in this thread to `devices`."""
+    prev = getattr(_scope, "devices", None)
+    _scope.devices = list(devices)
+    try:
+        yield
+    finally:
+        _scope.devices = prev
+
+
+def scoped_devices() -> list:
+    """Devices visible to mesh construction in this thread."""
+    devs = getattr(_scope, "devices", None)
+    return list(devs) if devs is not None else list(jax.devices())
+
+
+def partition_devices(groups: int) -> list[list]:
+    """Split the local devices into `groups` disjoint contiguous subsets
+    (empty-safe: at most one group per device). Contiguity keeps each
+    sub-mesh on neighboring ICI links."""
+    devices = scoped_devices()
+    groups = max(1, min(groups, len(devices)))
+    per = len(devices) // groups
+    return [devices[g * per : (g + 1) * per] for g in range(groups)]
+
 
 def get_mesh(spec: Mapping[str, int] | None = None, devices=None) -> Mesh:
-    """Build a Mesh. Default: all devices on one 'data' axis."""
-    devices = jax.devices() if devices is None else devices
+    """Build a Mesh over the thread's scoped devices (all local devices
+    unless a device_scope is active). Default: one 'data' axis."""
+    devices = scoped_devices() if devices is None else devices
     if not spec:
         return Mesh(np.asarray(devices), (DATA_AXIS,))
     names = tuple(spec.keys())
@@ -58,7 +93,7 @@ def mesh_from_config(config) -> Mesh | None:
     (single device: skip sharding machinery entirely)."""
     spec = config.get("oryx.batch.compute.mesh", None)
     if spec is None:
-        if len(jax.devices()) > 1:
+        if len(scoped_devices()) > 1:
             return get_mesh()
         return None
     return get_mesh(spec)

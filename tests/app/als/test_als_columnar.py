@@ -10,7 +10,7 @@ import pytest
 from oryx_tpu.app.als import data as als_data
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.lambda_ import data as data_store
-from oryx_tpu.lambda_.records import (
+from oryx_tpu.common.records import (
     ChainRecords,
     ListRecords,
     RecordBlock,

@@ -307,3 +307,47 @@ def test_score_dtype_config_reaches_model():
     model.set_user_vector("u1", np.array([1, 0, 0, 0], np.float32))
     out = model.top_n(np.array([1, 0, 0, 0], np.float32), 1)
     assert out and out[0][0] == "i1"
+
+
+def test_incremental_refresh_avoids_full_reupload(monkeypatch):
+    """A small dirty set scatter-updates the device-resident Y instead of
+    re-uploading the whole matrix (VERDICT r3 #7); rotation forces a
+    genuine rebuild."""
+    from oryx_tpu.app.als import serving_model as sm_mod
+    from oryx_tpu.ops import topn as topn_ops
+
+    # the padded streaming layout is the TPU serving path; force it here
+    # (interpreter on CPU) so append-into-padding is exercised everywhere
+    monkeypatch.setattr(topn_ops, "_default_streaming", lambda: True)
+    m = ALSServingModel(2, implicit=True, refresh_sec=0.0)
+    for j in range(200):
+        m.set_item_vector(f"i{j}", np.asarray([1.0, float(j % 7)], np.float32))
+    m.top_n(np.asarray([1.0, 0.0], np.float32), 1)  # first (full) build
+
+    uploads = []
+    real_upload = topn_ops.upload
+    monkeypatch.setattr(
+        sm_mod.topn_ops, "upload", lambda *a, **k: uploads.append(1) or real_upload(*a, **k)
+    )
+
+    # update one existing vector: no upload, new value visible
+    m.set_item_vector("i5", np.asarray([50.0, 0.0], np.float32))
+    res = m.top_n(np.asarray([1.0, 0.0], np.float32), 1)
+    assert res[0][0] == "i5" and uploads == []
+
+    # brand-new item appends into the padded region: still no upload
+    m.set_item_vector("brand-new", np.asarray([99.0, 0.0], np.float32))
+    res = m.top_n(np.asarray([1.0, 0.0], np.float32), 1)
+    assert res[0][0] == "brand-new" and uploads == []
+
+    # rotation forces a full rebuild. Writes since the last rotation are
+    # retained by design (retainRecentAndIds), so rotate twice with no
+    # writes in between: the second pass keeps exactly `keep`.
+    keep = {f"i{j}" for j in range(100)}
+    m.retain_recent_and_item_ids(keep)
+    assert uploads == []  # rebuild is lazy until the next scoring call
+    m.retain_recent_and_item_ids(keep)
+    res = m.top_n(np.asarray([1.0, 0.0], np.float32), 3)
+    assert uploads == [1]
+    assert all(r[0] in keep for r in res)
+    assert sorted(m.all_item_ids()) == sorted(keep)

@@ -1,0 +1,210 @@
+"""Full speed-layer benchmark: sustained events/sec through the REAL
+SpeedLayer over the file bus — not the build_updates microbench.
+
+Path measured per event (SpeedLayer.java:56-214 analogue, lambda_/speed.py):
+producer process -> file-bus input topic (4 partitions) -> consumer poll +
+JSON decode -> columnar parse/aggregate -> batched two-sided ALS fold-in ->
+update serialization -> batched publish to the file-bus update topic.
+
+A separate OS process produces events continuously (send_many batches)
+while this process runs SpeedLayer.run_one_batch in a loop for --seconds.
+Throughput = events consumed / elapsed, i.e. the sustained rate the layer
+keeps up with, bus I/O included. BASELINE.json target: 100K events/s.
+
+Usage:
+    python tools/speed_layer_benchmark.py --seconds 20 [--out evidence.txt]
+    (spawns its own producer; no setup needed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def produce(locator: str, users: int, items: int, stop_path: str) -> None:
+    """Producer-process body: pump synthetic rating events until stopped."""
+    from oryx_tpu import bus
+
+    broker = bus.get_broker(locator)
+    gen = np.random.default_rng(os.getpid())
+    t = 0
+    with broker.producer("OryxInput") as p:
+        while not os.path.exists(stop_path):
+            n = 20_000
+            u = gen.integers(0, users, n)
+            i = gen.integers(0, items, n)
+            v = 1.0 + gen.random(n)
+            base = t
+            p.send_many(
+                (None, f"u{uu},i{ii},{vv:.3f},{base + j}")
+                for j, (uu, ii, vv) in enumerate(zip(u, i, v))
+            )
+            t += n
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--features", type=int, default=50)
+    ap.add_argument("--users", type=int, default=50_000)
+    ap.add_argument("--items", type=int, default=10_000)
+    ap.add_argument("--producers", type=int, default=2)
+    ap.add_argument("--backend", default="auto", choices=["auto", "host", "device"])
+    ap.add_argument("--out", default=None, help="append an evidence block here")
+    args = ap.parse_args()
+
+    root = Path(tempfile.mkdtemp(prefix="oryx-speedbench-"))
+    locator = f"file:{root}/bus"
+    stop_path = str(root / "STOP")
+
+    from oryx_tpu import bus
+    from oryx_tpu.app.pmml import add_extension, add_extension_content
+    from oryx_tpu.common import config as C
+    from oryx_tpu.common import pmml as pmml_io
+    from oryx_tpu.lambda_.speed import SpeedLayer
+
+    broker = bus.get_broker(locator)
+    broker.create_topic("OryxInput", 4)
+    broker.create_topic("OryxUpdate", 1)
+
+    # a synthetic MODEL on the update topic for the layer to replay
+    gen = np.random.default_rng(42)
+    root_pmml = pmml_io.build_skeleton_pmml()
+    add_extension(root_pmml, "features", args.features)
+    add_extension(root_pmml, "implicit", "true")
+    add_extension_content(root_pmml, "XIDs", [f"u{j}" for j in range(args.users)])
+    add_extension_content(root_pmml, "YIDs", [f"i{j}" for j in range(args.items)])
+    with broker.producer("OryxUpdate") as p:
+        p.send("MODEL", pmml_io.to_string(root_pmml))
+
+    cfg = C.get_default().with_overlay(
+        f"""
+        oryx.id = "SpeedBench"
+        oryx.speed.model-manager-class = "oryx_tpu.app.als.speed:ALSSpeedModelManager"
+        oryx.als.implicit = true
+        oryx.als.no-known-items = true
+        oryx.speed.fold-in-backend = "{args.backend}"
+        oryx.input-topic.broker = "{locator}"
+        oryx.update-topic.broker = "{locator}"
+        oryx.speed.streaming.generation-interval-sec = 3600
+        oryx.speed.streaming.max-batch-events = 200000
+        """
+    )
+    layer = SpeedLayer(cfg)
+    layer.start()
+
+    t0 = time.perf_counter()
+    while True:
+        m = layer.manager.model
+        if m is not None:
+            break
+        if time.perf_counter() - t0 > 120:
+            sys.exit("model never loaded")
+        time.sleep(0.05)
+    # seed factor vectors so fold-ins solve against a real Gramian
+    x = gen.standard_normal((args.users, args.features)).astype(np.float32)
+    y = gen.standard_normal((args.items, args.features)).astype(np.float32)
+    for j in range(args.users):
+        m.x.set_vector(f"u{j}", x[j])
+    for j in range(args.items):
+        m.y.set_vector(f"i{j}", y[j])
+    print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    producers = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--produce",
+                locator,
+                "--produce-stop",
+                stop_path,
+                "--users",
+                str(args.users),
+                "--items",
+                str(args.items),
+            ]
+        )
+        for _ in range(args.producers)
+    ]
+    try:
+        time.sleep(1.0)  # let the bus fill so the layer never starves
+        # warm-up batch compiles the device path before timing starts
+        layer.run_one_batch()
+
+        from oryx_tpu.common.metrics import registry
+
+        events_counter = registry.counter("speed.events")
+        events = updates = batches = 0
+        start = time.perf_counter()
+        deadline = start + args.seconds
+        while time.perf_counter() < deadline:
+            before = int(events_counter.value)
+            sent = layer.run_one_batch()
+            events += int(events_counter.value) - before
+            updates += sent
+            batches += 1
+        elapsed = time.perf_counter() - start
+    finally:
+        Path(stop_path).touch()
+        for p in producers:
+            p.wait(timeout=30)
+        layer.close()
+
+    eps = events / elapsed
+    lines = [
+        f"=== speed_layer_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
+        f"model {args.users}u x {args.items}i x {args.features}f implicit; "
+        f"{args.producers} producer processes over {locator.split(':', 1)[0]}: bus",
+        f"{events} events in {elapsed:.2f}s over {batches} micro-batches "
+        f"-> {eps:,.0f} events/sec sustained ({updates} deltas published)",
+    ]
+    print("\n".join(lines), flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"speed layer sustained fold-in over file bus "
+                    f"({args.features} feat, {args.users // 1000}K users, "
+                    f"{args.items // 1000}K items)"
+                ),
+                "value": round(eps, 0),
+                "unit": "events/sec",
+                "vs_baseline": round(eps / 100_000.0, 2),
+            }
+        )
+    )
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    # internal flag for the producer subprocess
+    if "--produce-stop" in sys.argv:
+        i = sys.argv.index("--produce-stop")
+        stop = sys.argv[i + 1]
+        del sys.argv[i : i + 2]
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--produce")
+        ap.add_argument("--users", type=int, default=50_000)
+        ap.add_argument("--items", type=int, default=10_000)
+        a = ap.parse_args()
+        produce(a.produce, a.users, a.items, stop)
+    else:
+        main()
