@@ -44,6 +44,16 @@ class FeatureVectors:
             self._vectors[id_] = vector
             self._recent_ids.add(id_)
 
+    def set_batch(self, ids: list[str], vectors: np.ndarray) -> None:
+        """Insert/update many vectors under one write lock."""
+        vectors = np.asarray(vectors, dtype=np.float32)
+        with self._lock.write():
+            for id_, vec in zip(ids, vectors):
+                # copy: a row view would pin the whole batch matrix alive
+                # for as long as any single id keeps its vector
+                self._vectors[id_] = np.array(vec)
+            self._recent_ids.update(ids)
+
     def get_batch(
         self, ids: list[str], dim: int | None = None
     ) -> tuple[np.ndarray, np.ndarray]:

@@ -30,6 +30,7 @@ from oryx_tpu.native.store import (
     format_update_messages,
     format_vectors_json,
     make_feature_vectors,
+    parse_float_csv,
 )
 
 log = logging.getLogger(__name__)
@@ -65,20 +66,17 @@ class ALSSpeedModel(SpeedModel):
             self._yty_solver = None
 
     def set_user_vectors(self, users: list[str], vectors: np.ndarray) -> None:
-        """Batched set: one expected-set update + one solver invalidation
-        for the whole batch (the per-record form pays both per delta —
-        ruinous at 100K+ self-consumed deltas/s)."""
-        x = self.x
-        for user, vec in zip(users, vectors):
-            x.set_vector(user, vec)
+        """Batched set: one native store call, one expected-set update and
+        one solver invalidation for the whole batch (the per-record form
+        pays all three per delta — ruinous at 100K+ self-consumed
+        deltas/s)."""
+        self.x.set_batch(users, vectors)
         self._expected_users.difference_update(users)
         with self._solver_lock:
             self._xtx_solver = None
 
     def set_item_vectors(self, items: list[str], vectors: np.ndarray) -> None:
-        y = self.y
-        for item, vec in zip(items, vectors):
-            y.set_vector(item, vec)
+        self.y.set_batch(items, vectors)
         self._expected_items.difference_update(items)
         with self._solver_lock:
             self._yty_solver = None
@@ -176,17 +174,19 @@ class ALSSpeedModelManager(SpeedModelManager):
         for ids, vecs, origs, setter in groups.values():
             if not ids:
                 continue
-            parts = b",".join(vecs).split(b",")
-            mat = None
-            if len(parts) == len(ids) * k:
-                try:
-                    mat = np.array(parts, dtype="S").astype(np.float32).reshape(len(ids), k)
-                except ValueError:
-                    mat = None
-            if mat is None:
+            payload = b",".join(vecs)
+            flat = parse_float_csv(payload, len(ids) * k)  # native strtof
+            if flat is None:  # library absent / count mismatch: numpy twin
+                parts = payload.split(b",")
+                if len(parts) == len(ids) * k:
+                    try:
+                        flat = np.array(parts, dtype="S").astype(np.float32)
+                    except ValueError:
+                        flat = None
+            if flat is None:
                 slow.extend(origs)  # oddball numerics: whole group per-record
             else:
-                setter(ids, mat)
+                setter(ids, flat.reshape(len(ids), k))
         if slow:
             self.consume(
                 KeyMessage("UP", ln.decode("utf-8", "replace")) for ln in slow

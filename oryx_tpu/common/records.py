@@ -29,7 +29,9 @@ class RecordBlock:
 
     S arrays cannot hold None, so None keys travel as an explicit boolean
     mask — ``key=""`` and ``key=None`` survive a storage round-trip as
-    distinct values, like the reference's nullable Text keys.
+    distinct values, like the reference's nullable Text keys. (One
+    S-dtype caveat: numpy strips *trailing* NUL bytes, so keys/messages
+    ending in "\\x00" are not representable columnar.)
     """
 
     __slots__ = ("keys", "messages", "none_keys")

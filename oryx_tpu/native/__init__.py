@@ -109,6 +109,14 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.POINTER(c.c_int64), c.c_char_p, c.c_int64,
         c.POINTER(c.c_float), c.POINTER(c.c_uint8),
     ]
+    lib.fs_set_batch.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int64), c.c_char_p, c.c_int64,
+        c.POINTER(c.c_float),
+    ]
+    lib.parse_float_csv.restype = c.c_int64
+    lib.parse_float_csv.argtypes = [
+        c.c_char_p, c.c_int64, c.POINTER(c.c_float), c.c_int64,
+    ]
     lib.json_format_vectors.restype = c.c_int64
     lib.json_format_vectors.argtypes = [
         c.POINTER(c.c_float), c.c_int64, c.c_int64,

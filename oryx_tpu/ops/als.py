@@ -792,6 +792,41 @@ def _bucket(n: int) -> int:
     return max(256, 1 << (n - 1).bit_length())
 
 
+_auto_fold_choice: str | None = None
+
+
+def _calibrate_fold_backend(yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit):
+    """Time host vs device on this real batch, lock in the winner, return
+    the host result (already computed — no work wasted). The device is
+    timed on a second call so compile time doesn't poison the measurement."""
+    global _auto_fold_choice
+    import logging
+    import time as _time
+
+    t0 = _time.perf_counter()
+    host_result = fold_in_batch(
+        yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit, backend="host"
+    )
+    t_host = _time.perf_counter() - t0
+    try:
+        fold_in_batch(  # compile + first dispatch, untimed
+            yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit, backend="device"
+        )
+        t0 = _time.perf_counter()
+        fold_in_batch(
+            yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit, backend="device"
+        )
+        t_device = _time.perf_counter() - t0
+    except Exception:  # device backend unusable: host it is
+        t_device = float("inf")
+    _auto_fold_choice = "device" if t_device < t_host else "host"
+    logging.getLogger(__name__).info(
+        "fold-in auto backend: host %.3fs vs device %.3fs at n=%d -> %s",
+        t_host, t_device, len(values), _auto_fold_choice,
+    )
+    return host_result
+
+
 def fold_in_batch(
     yty: np.ndarray,
     xtx: np.ndarray,
@@ -811,13 +846,22 @@ def fold_in_batch(
     ALSUtils.computeUpdatedXu).
 
     backend: 'device' (jit, batch padded to power-of-two buckets),
-    'host' (float64 BLAS), or 'auto' — device once the batch is big
-    enough that the k x k solves dominate host<->device transfer."""
+    'host' (float64 BLAS), or 'auto' — measured, not guessed: the first
+    large enough batch runs both backends once, times them, and locks in
+    the winner for the process. A size heuristic cannot know the
+    deployment's dispatch latency — a locally-attached TPU and a
+    tunneled one differ by ~100x per call, and guessing wrong costs 2-3x
+    sustained speed-layer throughput."""
     n, k = xu.shape
     if backend == "auto":
-        # the k x k solves are tiny; device only pays off once the batch is
-        # large enough that MXU throughput beats host BLAS plus transfer
-        backend = "device" if n * max(k, 1) >= 8_000_000 else "host"
+        if _auto_fold_choice is not None:
+            backend = _auto_fold_choice
+        elif n * max(k, 1) < 500_000:
+            backend = "host"  # too small to learn from; host wins when tiny
+        else:
+            return _calibrate_fold_backend(
+                yty, xtx, xu, xu_valid, yi, yi_valid, values, implicit
+            )
     if backend == "host":
         new_xu, x_upd = _fold_half_host(yty, xu, xu_valid, yi, yi_valid, values, implicit)
         new_yi, y_upd = _fold_half_host(xtx, yi, yi_valid, xu, xu_valid, values, implicit)

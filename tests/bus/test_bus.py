@@ -173,3 +173,34 @@ def test_file_send_many_one_lock_per_partition_batch(tmp_path, monkeypatch):
     got = broker.consumer("T", from_beginning=True).poll(max_records=2000, timeout=1.0)
     assert len(got) == 1000
     assert got[0].message == "m0" and got[-1].message == "m999"
+
+
+def test_file_wire_format_escapes_round_trip(tmp_path):
+    """Tab framing with backslash escapes: hostile keys/messages survive,
+    and legacy JSON-per-line records still decode."""
+    loc = f"file:{tmp_path}/bus"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    nasty = [
+        ("k\twith\ttabs", "m\nwith\nnewlines"),
+        ("back\\slash", "tab\tand\\mix\r\n"),
+        # NUL is escaped on the wire; embedded (not trailing — numpy S
+        # arrays strip trailing NULs in the columnar path)
+        ("\x00k", "looks-like-none-key"),
+        (None, "json-ish {\"k\":\"UP\"} message"),
+        ('{"k":', "key that mimics the legacy prefix"),
+        ("ünïcode-κλειδί", "ünïcode message ✓"),
+        ("UP", '["X","u1",[1.5,2.5],["i1"]]'),
+    ]
+    with broker.producer("T") as p:
+        p.send_many(nasty)
+    # legacy-format line appended by hand still reads
+    with open(tmp_path / "bus" / "T" / "partition-0.log", "a", encoding="utf-8") as f:
+        f.write('{"k":"legacy","m":"old format"}\n')
+    got = broker.consumer("T", from_beginning=True).poll(max_records=100, timeout=1.0)
+    assert [(m.key, m.message) for m in got] == nasty + [("legacy", "old format")]
+    # columnar poll agrees
+    blk = broker.consumer("T", from_beginning=True).poll_block(max_records=100, timeout=1.0)
+    assert [(m.key, m.message) for m in blk.iter_key_messages()] == nasty + [
+        ("legacy", "old format")
+    ]

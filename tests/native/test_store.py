@@ -1,0 +1,19 @@
+
+
+def test_set_batch_matches_per_record():
+    import numpy as np
+    from oryx_tpu.native.store import make_feature_vectors
+
+    a, b = make_feature_vectors(), make_feature_vectors()
+    gen = np.random.default_rng(5)
+    ids = [f"id{j}" for j in range(500)] + ["id3", "id7"]  # dup ids: later wins
+    mat = gen.standard_normal((len(ids), 8)).astype(np.float32)
+    for i, v in zip(ids, mat):
+        a.set_vector(i, v)
+    b.set_batch(ids, mat)
+    assert a.size() == b.size() == 500
+    for j in (0, 3, 7, 499):
+        np.testing.assert_array_equal(a.get_vector(f"id{j}"), b.get_vector(f"id{j}"))
+    # recency marked: rotation to an empty keep-set retains all batch ids
+    b.retain_recent_and_ids(set())
+    assert b.size() == 500

@@ -60,6 +60,14 @@ def main() -> None:
     ap.add_argument("--users", type=int, default=50_000)
     ap.add_argument("--items", type=int, default=10_000)
     ap.add_argument("--producers", type=int, default=2)
+    ap.add_argument(
+        "--prefill",
+        type=int,
+        default=0,
+        help="pre-produce this many events and time draining the backlog "
+        "instead of racing live producers (layer capacity; the honest mode "
+        "on a 1-core host where producers and the layer share the core)",
+    )
     ap.add_argument("--backend", default="auto", choices=["auto", "host", "device"])
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
@@ -121,25 +129,42 @@ def main() -> None:
         m.y.set_vector(f"i{j}", y[j])
     print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
 
-    producers = [
-        subprocess.Popen(
-            [
-                sys.executable,
-                os.path.abspath(__file__),
-                "--produce",
-                locator,
-                "--produce-stop",
-                stop_path,
-                "--users",
-                str(args.users),
-                "--items",
-                str(args.items),
-            ]
-        )
-        for _ in range(args.producers)
-    ]
-    try:
+    if args.prefill:
+        producers = []
+        t0 = time.perf_counter()
+        with broker.producer("OryxInput") as p:
+            left = args.prefill
+            while left > 0:
+                n = min(200_000, left)
+                u = gen.integers(0, args.users, n)
+                i = gen.integers(0, args.items, n)
+                v = 1.0 + gen.random(n)
+                p.send_many(
+                    (None, f"u{uu},i{ii},{vv:.3f},{j}")
+                    for j, (uu, ii, vv) in enumerate(zip(u, i, v))
+                )
+                left -= n
+        print(f"prefilled {args.prefill} events in {time.perf_counter() - t0:.1f}s", flush=True)
+    else:
+        producers = [
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.abspath(__file__),
+                    "--produce",
+                    locator,
+                    "--produce-stop",
+                    stop_path,
+                    "--users",
+                    str(args.users),
+                    "--items",
+                    str(args.items),
+                ]
+            )
+            for _ in range(args.producers)
+        ]
         time.sleep(1.0)  # let the bus fill so the layer never starves
+    try:
         # warm-up batch compiles the device path before timing starts
         layer.run_one_batch()
 
@@ -152,9 +177,12 @@ def main() -> None:
         while time.perf_counter() < deadline:
             before = int(events_counter.value)
             sent = layer.run_one_batch()
-            events += int(events_counter.value) - before
+            got = int(events_counter.value) - before
+            events += got
             updates += sent
             batches += 1
+            if args.prefill and got == 0:
+                break  # backlog drained
         elapsed = time.perf_counter() - start
     finally:
         Path(stop_path).touch()
@@ -163,10 +191,15 @@ def main() -> None:
         layer.close()
 
     eps = events / elapsed
+    mode = (
+        f"{args.prefill}-event prefilled backlog"
+        if args.prefill
+        else f"{args.producers} live producer processes"
+    )
     lines = [
         f"=== speed_layer_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
         f"model {args.users}u x {args.items}i x {args.features}f implicit; "
-        f"{args.producers} producer processes over {locator.split(':', 1)[0]}: bus",
+        f"{mode} over a file: bus; host cores: {os.cpu_count()}",
         f"{events} events in {elapsed:.2f}s over {batches} micro-batches "
         f"-> {eps:,.0f} events/sec sustained ({updates} deltas published)",
     ]
