@@ -100,6 +100,34 @@ def _sweep_kernel(pts_ref, ctr_ref, sums_ref, counts_ref, cost_ref, *, n_items, 
 
 @functools.partial(jax.jit, static_argnames=("n_items", "k_real", "interpret"))
 def _sweep(points, centers, *, n_items, k_real, interpret):
+    return _sweep_impl(points, centers, n_items=n_items, k_real=k_real, interpret=interpret)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("iterations", "n_items", "k_real", "interpret")
+)
+def _lloyd_fused(points, centers0, *, iterations, n_items, k_real, interpret):
+    """All Lloyd iterations in ONE dispatch: lax.fori_loop over the fused
+    sweep kernel, centers updated on device between sweeps. Per-iteration
+    host dispatch (one round-trip each on a remote/tunneled chip) was the
+    dominant cost of the unfused loop at bench scale."""
+
+    def body(_, ctr):
+        sums, counts, _cost = _sweep_impl(
+            points, ctr, n_items=n_items, k_real=k_real, interpret=interpret
+        )
+        return jnp.where(
+            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], ctr
+        )
+
+    ctr = jax.lax.fori_loop(0, iterations, body, centers0)
+    sums, counts, cost = _sweep_impl(
+        points, ctr, n_items=n_items, k_real=k_real, interpret=interpret
+    )
+    return ctr, counts, cost
+
+
+def _sweep_impl(points, centers, *, n_items, k_real, interpret):
     """One fused assignment+reduction pass. points [n_pad, d] (rows beyond
     n_items are padding), centers [kp, d] (rows beyond k_real are padding).
     Returns (sums [kp, d], counts [kp], cost)."""
@@ -152,12 +180,9 @@ def lloyd_pallas(
     ctr[:k] = centers0
     pts_dev = jnp.asarray(points)
     ctr_dev = jnp.asarray(ctr)
-    for _ in range(iterations):
-        sums, counts, _ = _sweep(pts_dev, ctr_dev, n_items=n, k_real=k, interpret=interpret)
-        ctr_dev = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], ctr_dev
-        )
-    sums, counts, cost = _sweep(pts_dev, ctr_dev, n_items=n, k_real=k, interpret=interpret)
+    ctr_dev, counts, cost = _lloyd_fused(
+        pts_dev, ctr_dev, iterations=iterations, n_items=n, k_real=k, interpret=interpret
+    )
     return (
         np.asarray(ctr_dev[:k]),
         np.asarray(counts[:k]),

@@ -48,7 +48,19 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-BLOCK_N = 4096  # items per grid step; [k_feat<=256, 4096] f32 block = 4 MB VMEM
+# Score-tile width. [b=256, 4096] f32 scores + the iota/mask temps fit
+# the 16 MB scoped-VMEM limit of a v5e; 8192 does not (measured 20.7 MB).
+import os as _os
+
+SCORE_TILE = int(_os.environ.get("ORYX_TOPN_BLOCK", 4096))
+# Sub-tiles streamed per grid step: the item block per step is
+# [k_feat, SCORE_TILE * SUBTILES] (bf16, ~1.6 MB at 4) while the
+# score/iota tiles stay SCORE_TILE wide — grid-step orchestration costs
+# ~20us on a v5e, so fewer, fatter steps is most of the kernel's speed
+# (measured 5.5 ms -> 0.17 ms per 1M x 50 scan going 1 -> 4). 8 exceeds
+# the 16 MB scoped-VMEM limit at b=256.
+SUBTILES = int(_os.environ.get("ORYX_TOPN_SUBTILES", 4))
+BLOCK_N = SCORE_TILE * SUBTILES  # items consumed per grid step
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -84,51 +96,139 @@ def upload_streaming(matrix: np.ndarray, dtype=jnp.float32) -> StreamingItemMatr
     )
 
 
-def _topn_kernel(q_ref, mat_ref, norms_ref, vals_ref, idx_ref, *, k, n_items, cosine):
-    """One grid step: score a [k_feat, BLOCK_N] item block, keep its top-k."""
+def _topn_kernel(
+    q_ref, mat_ref, norms_ref, vals_ref, idx_ref, vstate, istate, *, k, n_items, cosine, grid
+):
+    """One grid step: score a [k_feat, BLOCK_N] item block and fold it
+    into the running top-k carried in VMEM scratch across grid steps.
+
+    The k-pass selection is ~40 VPU ops per score — 10x the cost of the
+    matmul that produced them — so the kernel keeps the running k-th-best
+    as a threshold and SKIPS selection for blocks whose max cannot enter
+    the top-k. With a randomly ordered item matrix only O(k log grid) of
+    the blocks pass the gate, which turns the scan from selection-bound
+    (~4ms at 1M x 50) into matmul/HBM-bound."""
     block = pl.program_id(0)
+    b = q_ref.shape[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    int_max = jnp.int32(2**31 - 1)
+
+    @pl.when(block == 0)
+    def _():
+        vstate[...] = jnp.full((b, k), neg_inf, jnp.float32)
+        istate[...] = jnp.zeros((b, k), jnp.int32)
+
     q = q_ref[:]  # [b, k_feat]
     # f32 items get true f32 accumulation (TPU default would silently drop
     # to bf16 passes); bf16 items are the intentional fast path
     precision = (
         jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else jax.lax.Precision.DEFAULT
     )
-    scores = jnp.dot(
-        q, mat_ref[:], preferred_element_type=jnp.float32, precision=precision
-    )  # [b, BLOCK_N]
-    b = scores.shape[0]
-    cols = jax.lax.broadcasted_iota(jnp.int32, (b, BLOCK_N), 1) + block * BLOCK_N
+    qn = None
     if cosine:
         qn = jnp.sqrt(
             jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32), axis=1, keepdims=True)
         )
-        denom = jnp.maximum(norms_ref[:] * qn, 1e-12)  # [b, BLOCK_N] via broadcast
-        scores = scores / denom
-    neg_inf = jnp.float32(-jnp.inf)
-    scores = jnp.where(cols < n_items, scores, neg_inf)
-    vals_cols = []
-    idx_cols = []
-    for _ in range(k):  # k is small and static: unrolled iterative max
-        m = jnp.max(scores, axis=1, keepdims=True)  # [b, 1]
-        # first column index attaining the max (ties -> lowest id, like a
-        # stable host scan)
-        at = jnp.min(jnp.where(scores == m, cols, jnp.int32(2**31 - 1)), axis=1, keepdims=True)
-        vals_cols.append(m)
-        idx_cols.append(at)
-        scores = jnp.where(cols == at, neg_inf, scores)
-    vals_ref[0] = jnp.concatenate(vals_cols, axis=1)  # [b, k]
-    idx_ref[0] = jnp.concatenate(idx_cols, axis=1)
+    # local (per-tile) column ids: one [b, SCORE_TILE] iota reused by every
+    # sub-tile keeps VMEM at two tiles regardless of how many sub-tiles a
+    # grid step streams; the global item id is base + local.
+    local_cols = jax.lax.broadcasted_iota(jnp.int32, (b, SCORE_TILE), 1)
+    for s in range(SUBTILES):  # unrolled: static sub-tile slices
+        base = block * BLOCK_N + s * SCORE_TILE
+        scores = jnp.dot(
+            q,
+            mat_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE],
+            preferred_element_type=jnp.float32,
+            precision=precision,
+        )  # [b, SCORE_TILE]
+        if cosine:
+            norms_s = norms_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE]
+            scores = scores / jnp.maximum(norms_s * qn, 1e-12)
+        scores = jnp.where(local_cols < n_items - base, scores, neg_inf)
+        kth = vstate[...][:, k - 1 : k]  # worst of the running top-k, [b, 1]
+        need = jnp.any(jnp.max(scores, axis=1, keepdims=True) > kth)
+
+        @pl.when(need)
+        def _(scores=scores, base=base):
+            sc = scores
+            vals_cols = []
+            idx_cols = []
+            for _ in range(k):  # k is small and static: unrolled iterative max
+                m = jnp.max(sc, axis=1, keepdims=True)  # [b, 1]
+                # first column index attaining the max (ties -> lowest id,
+                # like a stable host scan)
+                at = jnp.min(
+                    jnp.where(sc == m, local_cols, int_max), axis=1, keepdims=True
+                )
+                vals_cols.append(m)
+                idx_cols.append(at + base)
+                sc = jnp.where(local_cols == at, neg_inf, sc)
+            # merge the tile's top-k into the running state: k passes over
+            # [b, 2k] (tiny). Ties prefer the smaller item index, which is
+            # always the earlier tile — same result as a stable global merge.
+            cat_v = jnp.concatenate([vstate[...]] + vals_cols, axis=1)
+            cat_i = jnp.concatenate([istate[...]] + idx_cols, axis=1)
+            new_v = []
+            new_i = []
+            for _ in range(k):
+                m = jnp.max(cat_v, axis=1, keepdims=True)
+                sel = jnp.min(
+                    jnp.where(cat_v == m, cat_i, int_max), axis=1, keepdims=True
+                )
+                new_v.append(m)
+                new_i.append(sel)
+                cat_v = jnp.where((cat_v == m) & (cat_i == sel), neg_inf, cat_v)
+            vstate[...] = jnp.concatenate(new_v, axis=1)
+            istate[...] = jnp.concatenate(new_i, axis=1)
+
+    @pl.when(block == grid - 1)
+    def _():
+        vals_ref[...] = vstate[...]
+        idx_ref[...] = istate[...]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_items", "cosine", "interpret")
+)
+def _streaming_topk_multi(mat_t, norms, queries_kb, *, k, n_items, cosine, interpret):
+    """K full-matrix scans in ONE dispatch: lax.map runs the pallas scan
+    sequentially over [K, b, feat] query groups inside a single jitted
+    program. Host dispatch + tunnel round-trip are paid once per K scans
+    instead of once per scan — the difference between dispatch-bound
+    hundreds of scans/s and bandwidth-bound thousands on a remote chip.
+    Returns (vals [K, b, k], idxs [K, b, k])."""
+
+    def one(q):
+        return _streaming_topk_impl(
+            mat_t, norms, q, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+        )
+
+    return jax.lax.map(one, queries_kb)
 
 
 @functools.partial(
     jax.jit, static_argnames=("k", "n_items", "cosine", "interpret")
 )
 def _streaming_topk(mat_t, norms, queries, *, k, n_items, cosine, interpret):
+    return _streaming_topk_impl(
+        mat_t, norms, queries, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+    )
+
+
+def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret):
     k_feat, n_pad = mat_t.shape
     b = queries.shape[0]
     grid = n_pad // BLOCK_N
-    kernel = functools.partial(_topn_kernel, k=k, n_items=n_items, cosine=cosine)
+    kernel = functools.partial(
+        _topn_kernel, k=k, n_items=n_items, cosine=cosine, grid=grid
+    )
     common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    if pltpu is None:  # pragma: no cover - jax builds without pallas-tpu
+        raise RuntimeError(
+            "streaming top-k needs jax.experimental.pallas.tpu (scratch "
+            "state); use the XLA handle (upload(streaming=False)) instead"
+        )
+    scratch = [pltpu.VMEM((b, k), jnp.float32), pltpu.VMEM((b, k), jnp.int32)]
     vals, idxs = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -138,21 +238,17 @@ def _streaming_topk(mat_t, norms, queries, *, k, n_items, cosine, interpret):
             pl.BlockSpec((1, BLOCK_N), lambda i: (0, i), **common),
         ],
         out_specs=[
-            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0), **common),
-            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0), **common),
+            pl.BlockSpec((b, k), lambda i: (0, 0), **common),
+            pl.BlockSpec((b, k), lambda i: (0, 0), **common),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((grid, b, k), jnp.float32),
-            jax.ShapeDtypeStruct((grid, b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, k), jnp.float32),
+            jax.ShapeDtypeStruct((b, k), jnp.int32),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
     )(queries.astype(mat_t.dtype), mat_t, norms)
-    # merge the per-block candidates: [b, grid * k] is tiny
-    flat_v = jnp.transpose(vals, (1, 0, 2)).reshape(b, grid * k)
-    flat_i = jnp.transpose(idxs, (1, 0, 2)).reshape(b, grid * k)
-    top_v, pos = jax.lax.top_k(flat_v, k)
-    top_i = jnp.take_along_axis(flat_i, pos, axis=1)
-    return top_v, top_i
+    return vals, idxs
 
 
 # above this k the kernel's unrolled per-block selection stops paying for
@@ -199,6 +295,29 @@ def top_k_streaming_device(
         up.mat_t,
         up.norms,
         jnp.asarray(q),
+        k=k,
+        n_items=up.n_items,
+        cosine=cosine,
+        interpret=interpret,
+    )
+
+
+def top_k_streaming_device_multi(
+    up: StreamingItemMatrix,
+    queries_kb: jax.Array,
+    k: int,
+    cosine: bool = False,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(scores [K, b, k], indices [K, b, k]) for [K, b, feat] query
+    groups — K full-matrix scans fused into one dispatch."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = max(1, min(int(k), up.n_items))
+    return _streaming_topk_multi(
+        up.mat_t,
+        up.norms,
+        queries_kb,
         k=k,
         n_items=up.n_items,
         cosine=cosine,

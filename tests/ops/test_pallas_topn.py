@@ -79,3 +79,19 @@ def test_upload_dispatch_and_async_handle():
     # single-query form agrees with the batch form
     i1, v1 = topn_ops.top_k_scores(up, q[0], 5)
     np.testing.assert_array_equal(i1, aidx[0])
+
+
+def test_submit_top_k_multi_matches_single():
+    import numpy as np
+    from oryx_tpu.ops import topn as topn_ops
+
+    gen = np.random.default_rng(11)
+    y = gen.standard_normal((3000, 16)).astype(np.float32)
+    q = gen.standard_normal((70, 16)).astype(np.float32)  # ragged vs scan_batch
+    for streaming in (False, True):
+        up = topn_ops.upload(y, streaming=streaming)
+        mi, mv = topn_ops.submit_top_k_multi(up, q, 5, scan_batch=32).result()
+        si, sv = topn_ops.submit_top_k(up, q, 5).result()
+        assert mi.shape == (70, 5)
+        np.testing.assert_array_equal(mi, si)
+        np.testing.assert_allclose(mv, sv, rtol=1e-5, atol=1e-5)
