@@ -147,3 +147,44 @@ def test_build_updates_after_rotation_to_empty_store():
     assert mgr.model.x.size() == 0 and mgr.model.y.size() == 0
     updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
     assert updates == []
+
+
+def test_consume_blocks_matches_per_record():
+    """Columnar consume (vectorized UP parse + batched setters) must land
+    the same state as the per-record path, with MODEL messages between UP
+    runs handled in order, escaped ids on the slow path, and malformed
+    vectors falling back per-record."""
+    from oryx_tpu.common.records import RecordBlock
+
+    msgs = [
+        KeyMessage("MODEL", model_message(x_ids=("U1", 'u"quote'), y_ids=("I1", "I2"))),
+        KeyMessage("UP", '["X","U1",[1.0,2.0]]'),
+        KeyMessage("UP", '["X","u\\"quote",[5.0,6.0]]'),  # escaped id: slow path
+        KeyMessage("UP", '["Y","I1",[3.0,4.0]]'),
+        # rotation mid-stream, then more UPs — order matters
+        KeyMessage("MODEL", model_message(x_ids=("U1",), y_ids=("I1",))),
+        KeyMessage("UP", '["Y","I1",[9.0,9.0]]'),
+    ]
+    per = make_manager()
+    feed(per, msgs)
+    blk = make_manager()
+    blk.consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))
+    for mgr in (per, blk):
+        np.testing.assert_array_equal(mgr.model.x.get_vector("U1"), [1.0, 2.0])
+        np.testing.assert_array_equal(mgr.model.x.get_vector('u"quote'), [5.0, 6.0])
+        np.testing.assert_array_equal(mgr.model.y.get_vector("I1"), [9.0, 9.0])
+    assert blk.model.x.size() == per.model.x.size()
+    assert blk.model.y.size() == per.model.y.size()
+
+
+def test_consume_blocks_malformed_vector_raises_like_per_record():
+    from oryx_tpu.common.records import RecordBlock
+
+    msgs = [
+        KeyMessage("MODEL", model_message()),
+        KeyMessage("UP", '["X","U1",[1.0,notanumber]]'),
+    ]
+    with pytest.raises(ValueError):
+        feed(make_manager(), msgs)
+    with pytest.raises(ValueError):
+        make_manager().consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))

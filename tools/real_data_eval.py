@@ -1,0 +1,136 @@
+"""Real-dataset quality parity (VERDICT r3 #6): held-out RMSE on the
+real MovieLens-100K and held-out accuracy on the real UCI covtype,
+through the SAME training paths the framework's apps use.
+
+Requires `python tools/fetch_datasets.py` first (needs network; this
+build sandbox has none — which is why docs/performance.md labels its
+committed quality numbers as synthetic stand-ins).
+
+Parity bars (the MLlib-trained reference's ballpark at comparable
+settings): ML-100K held-out RMSE ~0.90-0.95 (rank 25, lam 0.1,
+time-ordered 90/10); covtype held-out accuracy ~0.72-0.75 at 20 trees
+depth 10 (deeper forests reach higher; this matches rdf-example scale).
+
+Usage:
+    python tools/real_data_eval.py [--data data/real] [--out FILE]
+
+Prints one JSON line per dataset.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def eval_ml100k(data_dir: Path) -> dict:
+    from oryx_tpu.ops import als as als_ops
+
+    raw = np.loadtxt(data_dir / "ml-100k" / "u.data", dtype=np.int64)  # u i r ts
+    order = np.argsort(raw[:, 3], kind="stable")  # time-ordered split
+    raw = raw[order]
+    uq, u = np.unique(raw[:, 0], return_inverse=True)
+    iq, i = np.unique(raw[:, 1], return_inverse=True)
+    v = raw[:, 2].astype(np.float32)
+    split = int(len(v) * 0.9)
+    t0 = time.perf_counter()
+    model = als_ops.train_als(
+        u[:split].astype(np.int32),
+        i[:split].astype(np.int32),
+        v[:split],
+        len(uq),
+        len(iq),
+        features=25,
+        lam=0.1,
+        implicit=False,
+        iterations=10,
+        seed=42,
+    )
+    wall = time.perf_counter() - t0
+    rmse = als_ops.rmse(
+        model.x, model.y, u[split:].astype(np.int32), i[split:].astype(np.int32), v[split:]
+    )
+    return {
+        "metric": "ALS held-out RMSE, REAL MovieLens-100K (rank 25, lam 0.1, "
+        "time-ordered 90/10, 10 sweeps)",
+        "value": round(float(rmse), 4),
+        "unit": "rmse",
+        "vs_baseline": round(0.93 / float(rmse), 2),  # MLlib ballpark ~0.93
+        "wall_sec": round(wall, 1),
+    }
+
+
+def eval_covtype(data_dir: Path) -> dict:
+    from oryx_tpu.ops import forest as forest_ops
+
+    raw = np.loadtxt(data_dir / "covtype.data", delimiter=",", dtype=np.float32)
+    x, y = raw[:, :-1], raw[:, -1].astype(np.int32) - 1  # classes 1..7 -> 0..6
+    gen = np.random.default_rng(13)
+    perm = gen.permutation(len(y))
+    x, y = x[perm], y[perm]
+    n_test = 50_000
+    xtr, ytr, xte, yte = x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]
+    num_bins = 32
+    cuts = [
+        np.quantile(xtr[:, j], np.linspace(0, 1, num_bins)[1:-1]) for j in range(10)
+    ]
+
+    def binize(m):
+        out = np.zeros(m.shape, np.int32)
+        for j in range(10):
+            out[:, j] = np.searchsorted(cuts[j], m[:, j], side="left")
+        out[:, 10:] = m[:, 10:].astype(np.int32)
+        return out
+
+    t0 = time.perf_counter()
+    forest = forest_ops.train_forest(
+        binize(xtr), ytr, num_bins=num_bins, num_classes=7,
+        num_trees=20, max_depth=10, impurity="entropy", seed=77,
+    )
+    wall = time.perf_counter() - t0
+    votes = forest_ops.predict_forest_binned(forest, binize(xte))
+    acc = float((votes.argmax(axis=1) == yte).mean())
+    return {
+        "metric": "RDF held-out accuracy, REAL UCI covtype (581K rows, 20 trees "
+        "depth 10)",
+        "value": round(acc, 4),
+        "unit": "accuracy",
+        "vs_baseline": round(acc / 0.73, 2),  # MLlib RF ballpark at this depth
+        "wall_sec": round(wall, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default="data/real")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    data_dir = Path(args.data)
+    results = []
+    if (data_dir / "ml-100k" / "u.data").exists():
+        results.append(eval_ml100k(data_dir))
+    else:
+        print("ml-100k missing — run tools/fetch_datasets.py first", file=sys.stderr)
+    if (data_dir / "covtype.data").exists():
+        results.append(eval_covtype(data_dir))
+    else:
+        print("covtype missing — run tools/fetch_datasets.py first", file=sys.stderr)
+    for r in results:
+        print(json.dumps(r), flush=True)
+    if args.out and results:
+        with open(args.out, "a", encoding="utf-8") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    if not results:
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

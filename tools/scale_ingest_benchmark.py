@@ -1,0 +1,157 @@
+"""100M-rating ingest -> train demonstration (VERDICT r3 #5).
+
+Exercises the REAL batch data path at north-star-adjacent scale on one
+host: synthetic ratings are written as columnar npz micro-batches into a
+data dir (the ingest side of SaveToHDFSFunction), then ALSUpdate runs a
+full MLUpdate generation over them — lazy FileRecords streaming,
+vectorized parse/decay/aggregate, train_als on the device, factor-shard
+export and model promotion — recording per-phase wall and peak RSS.
+
+Usage:
+    python tools/scale_ingest_benchmark.py [--ratings 100000000]
+        [--users 2000000] [--items 200000] [--rank 16] [--iterations 1]
+        [--out tools/scale_ingest_evidence.txt]
+
+The micro-batches and model land under --workdir (a temp dir by
+default) and are deleted afterwards unless --keep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def rss_gb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ratings", type=int, default=100_000_000)
+    ap.add_argument("--users", type=int, default=2_000_000)
+    ap.add_argument("--items", type=int, default=200_000)
+    ap.add_argument("--rank", type=int, default=16)
+    ap.add_argument("--iterations", type=int, default=1)
+    ap.add_argument("--batches", type=int, default=10)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+
+    root = Path(args.workdir or tempfile.mkdtemp(prefix="oryx-scale-"))
+    data_dir = root / "data"
+    model_dir = root / "model"
+    data_dir.mkdir(parents=True, exist_ok=True)
+
+    gen = np.random.default_rng(7)
+    per = args.ratings // args.batches
+
+    # -- ingest: vectorized message synthesis + columnar micro-batches ------
+    t0 = time.perf_counter()
+    total_bytes = 0
+    for bi in range(args.batches):
+        # mild power-law over users/items via squared uniforms
+        u = (gen.random(per) ** 2 * args.users).astype(np.int64)
+        i = (gen.random(per) ** 2 * args.items).astype(np.int64)
+        v = (1.0 + 4.0 * gen.random(per)).astype(np.float32)
+        ts = np.arange(bi * per, bi * per + per, dtype=np.int64)
+        # "u<id>,i<id>,<val>,<ts>" built with a handful of C-level passes
+        comma = np.full(per, b",", dtype="S1")
+        msgs = np.char.add(
+            np.char.add(
+                np.char.add(
+                    np.char.add(
+                        np.char.add(np.char.add(b"u", u.astype("S")), comma),
+                        np.char.add(b"i", i.astype("S")),
+                    ),
+                    comma,
+                ),
+                v.astype("S8"),
+            ),
+            np.char.add(comma, ts.astype("S")),
+        )
+        path = data_dir / f"oryx-{1000 + bi}.npz"
+        with open(path, "wb") as f:
+            np.savez(f, messages=msgs)  # uncompressed: 1-core zlib would dominate
+        total_bytes += path.stat().st_size
+        print(
+            f"ingest: batch {bi + 1}/{args.batches} written "
+            f"({total_bytes / 1e9:.1f} GB total, rss {rss_gb():.1f} GB)",
+            flush=True,
+        )
+        del u, i, v, ts, msgs
+    ingest_wall = time.perf_counter() - t0
+
+    # -- train: one full MLUpdate generation over the stored history ---------
+    from oryx_tpu.app.als.update import ALSUpdate
+    from oryx_tpu.common import config as C
+    from oryx_tpu.lambda_.data import FileRecords
+
+    cfg = C.get_default().with_overlay(
+        f"""
+        oryx.id = "ScaleIngest"
+        oryx.als.implicit = true
+        oryx.als.no-known-items = true
+        oryx.als.iterations = {args.iterations}
+        oryx.als.hyperparams.features = {args.rank}
+        oryx.ml.eval.test-fraction = 0
+        oryx.ml.eval.candidates = 1
+        """
+    )
+    update = ALSUpdate(cfg)
+    past = FileRecords(data_dir)
+    t0 = time.perf_counter()
+    update.run_update(2_000_000_000, [], past, str(model_dir), None)
+    train_wall = time.perf_counter() - t0
+
+    promoted = model_dir / "2000000000"
+    ok = (promoted / "model.pmml").exists() and (promoted / "Y").is_dir()
+    peak = rss_gb()
+    lines = [
+        f"=== scale_ingest_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
+        f"{args.ratings} ratings, {args.users} users x {args.items} items, "
+        f"rank {args.rank}, {args.iterations} sweep(s); host cores: {os.cpu_count()}",
+        f"ingest: {args.batches} npz micro-batches, {total_bytes / 1e9:.1f} GB, "
+        f"{ingest_wall:.0f}s ({args.ratings / ingest_wall / 1e6:.1f}M ratings/s)",
+        f"train (parse->decay->aggregate->ALS->export->promote): {train_wall:.0f}s "
+        f"({args.ratings / train_wall / 1e6:.2f}M ratings/s end-to-end)",
+        f"peak RSS: {peak:.1f} GB; model promoted: {ok}",
+    ]
+    print("\n".join(lines), flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"ALS ingest->train end-to-end ({args.ratings / 1e6:.0f}M "
+                    f"ratings, rank {args.rank}, peak RSS {peak:.1f} GB)"
+                ),
+                "value": round(args.ratings / train_wall, 0),
+                "unit": "ratings/sec",
+                "vs_baseline": 0.0,
+            }
+        ),
+        flush=True,
+    )
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+    if not args.keep:
+        shutil.rmtree(root, ignore_errors=True)
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
