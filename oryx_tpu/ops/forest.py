@@ -287,6 +287,16 @@ def train_forest(
         else:
             stats_chan = jnp.asarray(stats_w)
             node_of_dev = jnp.asarray(node_of)
+        # Levels dispatch asynchronously: each level's grow consumes the
+        # previous level's device-resident node assignment, so the whole
+        # tree pipeline runs without a host sync per level (a blocking
+        # round-trip per level dominated wall-clock on remote devices —
+        # 20 trees x 11 levels of ~dispatch-latency each). The
+        # grown-to-leaves early exit checks the PREVIOUS level's splits:
+        # one level may dispatch redundantly, but an all-leaf level writes
+        # the same -1/zero values the output arrays start with.
+        level_out = []
+        prev_sf = None
         for depth in range(max_depth + 1):
             level_start = 2**depth - 1
             num_level = 2**depth
@@ -306,14 +316,23 @@ def train_forest(
                 np.float32(min_info_gain),
                 depth == max_depth,
             )
+            for a in (sf, sb, gains, node_tot):
+                try:
+                    a.copy_to_host_async()
+                except AttributeError:  # pragma: no cover - older array types
+                    pass
+            level_out.append((level_start, num_level, sf, sb, gains, node_tot))
+            if prev_sf is not None and np.all(np.asarray(prev_sf) < 0):
+                break
+            prev_sf = sf
+        for level_start, num_level, sf, sb, gains, node_tot in level_out:
             sl = slice(level_start, level_start + num_level)
+            node_tot = np.asarray(node_tot)
             t_feat[t, sl] = np.asarray(sf)
             t_bin[t, sl] = np.asarray(sb)
-            t_stats[t, sl] = np.asarray(node_tot)
-            t_counts[t, sl] = np.asarray(node_tot)[:, 0] if num_classes is None else np.asarray(node_tot).sum(axis=1)
+            t_stats[t, sl] = node_tot
+            t_counts[t, sl] = node_tot[:, 0] if num_classes is None else node_tot.sum(axis=1)
             t_gains[t, sl] = np.asarray(gains)
-            if np.all(np.asarray(sf) < 0):
-                break
     return ForestArrays(t_feat, t_bin, t_stats, t_counts, t_gains, num_classes)
 
 

@@ -76,7 +76,12 @@ class TopNBatcher:
     device calls. Thread-safe; one instance serves any number of models
     (entries carry their own uploaded-matrix handle)."""
 
-    def __init__(self, max_batch: int = 256, max_inflight: int = 32) -> None:
+    # coalesced groups past this many rows go through submit_top_k_multi:
+    # one device dispatch running ceil(n/256) fused full-matrix scans,
+    # paying per-dispatch cost once instead of per 256-row scan
+    MULTI_THRESHOLD = 256
+
+    def __init__(self, max_batch: int = 2048, max_inflight: int = 32) -> None:
         self.max_batch = max_batch
         self._queue: queue.Queue[_Entry | None] = queue.Queue()
         self._pending: queue.Queue = queue.Queue()
@@ -149,15 +154,26 @@ class TopNBatcher:
         self._inflight.acquire()
         try:
             queries = np.stack([e.query for e in entries])
-            pad_rows = _b_bucket(len(entries)) - len(entries)
-            if pad_rows:
-                queries = np.concatenate(
-                    [queries, np.zeros((pad_rows, queries.shape[1]), queries.dtype)]
-                )
             kk = _k_bucket(max(e.k for e in entries))
-            handle = topn_ops.submit_top_k(
-                entries[0].uploaded, queries, kk, cosine=cosine
-            )
+            if len(entries) > self.MULTI_THRESHOLD:
+                # fused multi-scan: pads to a multiple of scan_batch
+                # internally, so compiled shapes stay one-per-K
+                handle = topn_ops.submit_top_k_multi(
+                    entries[0].uploaded,
+                    queries,
+                    kk,
+                    cosine=cosine,
+                    scan_batch=self.MULTI_THRESHOLD,
+                )
+            else:
+                pad_rows = _b_bucket(len(entries)) - len(entries)
+                if pad_rows:
+                    queries = np.concatenate(
+                        [queries, np.zeros((pad_rows, queries.shape[1]), queries.dtype)]
+                    )
+                handle = topn_ops.submit_top_k(
+                    entries[0].uploaded, queries, kk, cosine=cosine
+                )
             self._pending.put((handle, entries))
         except BaseException as exc:  # deliver the failure to the waiters
             self._inflight.release()

@@ -93,3 +93,54 @@ def test_closed_batcher_raises_and_default_revives():
     b2 = batcher_mod.get_default_batcher()
     assert b2 is not b and not b2._closed
     b2.close()
+
+
+def test_large_group_routes_through_fused_multi(monkeypatch):
+    """Coalesced groups past MULTI_THRESHOLD take the fused multi-scan
+    dispatch; answers stay identical to the direct path."""
+    y, up = _make(n=600, kf=10, seed=5)
+    calls = {"multi": 0, "single": 0}
+    real_multi = topn_ops.submit_top_k_multi
+    real_single = topn_ops.submit_top_k
+    monkeypatch.setattr(
+        batcher_mod.topn_ops, "submit_top_k_multi",
+        lambda *a, **k: calls.__setitem__("multi", calls["multi"] + 1) or real_multi(*a, **k),
+    )
+    monkeypatch.setattr(
+        batcher_mod.topn_ops, "submit_top_k",
+        lambda *a, **k: calls.__setitem__("single", calls["single"] + 1) or real_single(*a, **k),
+    )
+    b = TopNBatcher()
+    b.MULTI_THRESHOLD = 8  # force the multi path with a small fleet
+    gen = np.random.default_rng(6)
+    queries = gen.standard_normal((40, 10)).astype(np.float32)
+    results = [None] * len(queries)
+    # hold the dispatcher back so all 40 requests coalesce into one batch
+    gate = threading.Event()
+    orig_take = b._take_batch
+
+    def gated_take():
+        gate.wait(5)
+        return orig_take()
+
+    b._take_batch = gated_take
+    try:
+        def run(j):
+            results[j] = b.score(up, queries[j], 4)
+
+        threads = [threading.Thread(target=run, args=(j,)) for j in range(len(queries))]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.3)  # let every request enqueue
+        gate.set()
+        for t in threads:
+            t.join(timeout=30)
+        for j in range(len(queries)):
+            ridx, rvals = topn_ops.top_k_scores(up, queries[j], 4)
+            np.testing.assert_array_equal(results[j][0], ridx)
+            np.testing.assert_allclose(results[j][1], rvals, atol=1e-5)
+        assert calls["multi"] >= 1
+    finally:
+        b.close()
