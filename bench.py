@@ -348,7 +348,9 @@ def _run_child(env: dict, timeout: float) -> tuple[int, list[str], str]:
 def main() -> None:
     attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 3))
     init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 150))
-    child_timeout = init_timeout + 900
+    # generous: metrics stream as they complete, so a watchdog kill only
+    # costs whatever is still running (RDF, the slowest, goes last)
+    child_timeout = init_timeout + 1800
 
     base_env = dict(os.environ)
     base_env["ORYX_BENCH_CHILD"] = "1"

@@ -108,10 +108,20 @@ class RatingMatrix:
 
     @property
     def known_items(self) -> dict[str, set[str]]:
-        known: dict[str, set[str]] = {}
-        for u, i in zip(self.user_idx, self.item_idx):
-            known.setdefault(self.user_ids[u], set()).add(self.item_ids[i])
-        return known
+        """user -> item-id set, grouped with one argsort instead of a
+        Python dict op per interaction."""
+        if not len(self.user_idx):
+            return {}
+        order = np.argsort(self.user_idx, kind="stable")
+        u_sorted = self.user_idx[order]
+        bounds = np.flatnonzero(np.diff(u_sorted)) + 1
+        groups = np.split(self.item_idx[order], bounds)
+        firsts = u_sorted[np.concatenate(([0], bounds))]
+        item_ids = self.item_ids
+        return {
+            self.user_ids[u]: {item_ids[j] for j in g.tolist()}
+            for u, g in zip(firsts.tolist(), groups)
+        }
 
 
 def to_rating_matrix(agg: dict[tuple[str, str], float]) -> RatingMatrix:
