@@ -129,6 +129,135 @@ def top_k_scores_batch(uploaded, queries: np.ndarray, k: int, cosine: bool = Fal
     return np.asarray(i), np.asarray(s)
 
 
+# -- mesh-sharded scan --------------------------------------------------------
+
+
+@dataclass
+class ShardedItemMatrix:
+    """Item matrix row-sharded over a device mesh: each device holds an
+    [n/d, k] slice plus its norms. The multi-chip serving layout — a
+    40M x 200 f32 model is 32 GB replicated but 2 GB/chip on a v5e-16
+    (SURVEY §2.12 request parallelism; the reference shards the same way
+    across LSH thread partitions on one host)."""
+
+    mat: jax.Array  # [n_pad, k], rows sharded over 'data'
+    norms: jax.Array  # [n_pad], sharded alike
+    n_items: int
+    mesh: object
+
+
+def upload_sharded(matrix: np.ndarray, mesh, dtype=None) -> ShardedItemMatrix:
+    """Shard a packed [n, k] item matrix row-wise over `mesh`'s devices
+    (padded so every device gets an equal slice)."""
+    from oryx_tpu.parallel.mesh import data_sharding, pad_to_multiple, shard_rows
+
+    n, k = matrix.shape
+    d = mesh.devices.size
+    n_pad = pad_to_multiple(max(n, d), d)
+    mat = np.zeros((n_pad, k), dtype=np.float32)
+    mat[:n] = matrix
+    norms = np.linalg.norm(mat, axis=1)
+    return ShardedItemMatrix(
+        mat=jax.device_put(jnp.asarray(mat, dtype=dtype or jnp.float32), data_sharding(mesh, 2)),
+        norms=jax.device_put(jnp.asarray(norms), shard_rows(mesh)),
+        n_items=n,
+        mesh=mesh,
+    )
+
+
+def _sharded_topk_fn(mesh, k: int, cosine: bool):
+    """shard_map'd scan: each device scores and top-k's its row shard,
+    then the tiny [b, k]-per-device candidates all-gather and a final
+    top-k merges them — the [b, n] score matrix never materializes
+    globally and no full-matrix collective ever runs."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    from oryx_tpu.parallel.mesh import DATA_AXIS
+
+    def local(mat, norms, queries, qn, shard_base, n_items_arr):
+        # mat: [n_local, k_feat]; shard_base: [1] global row offset
+        scores = jnp.dot(
+            queries, mat.T, preferred_element_type=jnp.float32,
+            precision=_dot_precision(mat.dtype),
+        )  # [b, n_local]
+        if cosine:
+            scores = scores / jnp.maximum(norms[None, :] * qn, 1e-12)
+        # mask padding by global row position — NOT by zero norms, which
+        # would also drop genuine zero-vector items (cold rows score 0,
+        # same as the single-device path)
+        gcol = shard_base[0] + jnp.arange(mat.shape[0], dtype=jnp.int32)
+        scores = jnp.where(gcol[None, :] < n_items_arr[0], scores, -jnp.inf)
+        kk = min(k, mat.shape[0])
+        v, i = jax.lax.top_k(scores, kk)
+        i = i + shard_base[0]
+        # gather every device's candidates and merge: [b, d*kk] is tiny
+        v_all = jax.lax.all_gather(v, DATA_AXIS, axis=1, tiled=True)
+        i_all = jax.lax.all_gather(i, DATA_AXIS, axis=1, tiled=True)
+        vm, pos = jax.lax.top_k(v_all, min(k, v_all.shape[1]))
+        im = jnp.take_along_axis(i_all, pos, axis=1)
+        return vm, im
+
+    in_specs = (
+        P(DATA_AXIS, None),
+        P(DATA_AXIS),
+        P(),  # queries replicated
+        P(),
+        P(DATA_AXIS),
+        P(),  # n_items replicated
+    )
+    out_specs = (P(), P())
+    # after the all_gather every device computes the same merge, but the
+    # replication checker can't infer that through top_k — disable it
+    # (kwarg renamed check_rep -> check_vma across jax versions)
+    try:
+        smapped = shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # pragma: no cover - older jax
+        smapped = shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+    return jax.jit(smapped)
+
+
+def top_k_sharded(
+    up: ShardedItemMatrix, queries: np.ndarray, k: int, cosine: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """(indices [b, k], scores [b, k]) over the mesh-sharded matrix."""
+    q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+    k = max(1, min(int(k), up.n_items))
+    qn = np.linalg.norm(q, axis=1, keepdims=True).astype(np.float32)
+    d = up.mesh.devices.size
+    per = up.mat.shape[0] // d
+    shard_base = jnp.arange(d, dtype=jnp.int32) * per
+    fn = _sharded_topk_cache(up.mesh, k, bool(cosine))
+    vals, idxs = fn(
+        up.mat,
+        up.norms,
+        jnp.asarray(q, dtype=up.mat.dtype),
+        jnp.asarray(qn),
+        shard_base,
+        jnp.asarray([up.n_items], dtype=jnp.int32),
+    )
+    return np.asarray(idxs), np.asarray(vals)
+
+
+_sharded_fns: dict = {}
+
+
+def _sharded_topk_cache(mesh, k: int, cosine: bool):
+    key = (id(mesh), k, cosine)
+    fn = _sharded_fns.get(key)
+    if fn is None:
+        fn = _sharded_fns[key] = _sharded_topk_fn(mesh, k, cosine)
+    return fn
+
+
 # -- incremental updates ------------------------------------------------------
 
 

@@ -95,3 +95,44 @@ def test_submit_top_k_multi_matches_single():
         assert mi.shape == (70, 5)
         np.testing.assert_array_equal(mi, si)
         np.testing.assert_allclose(mv, sv, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_topk_matches_single_device():
+    import numpy as np
+    from oryx_tpu.ops import topn as topn_ops
+    from oryx_tpu.parallel.mesh import get_mesh
+
+    gen = np.random.default_rng(21)
+    y = gen.standard_normal((5000, 12)).astype(np.float32)
+    q = gen.standard_normal((9, 12)).astype(np.float32)
+    mesh = get_mesh()  # 8 virtual CPU devices
+    up = topn_ops.upload_sharded(y, mesh)
+    si, sv = topn_ops.top_k_sharded(up, q, 7)
+    ref = topn_ops.upload(y, streaming=False)
+    ri, rv = topn_ops.top_k_scores_batch(ref, q, 7)
+    np.testing.assert_allclose(sv, rv, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.sort(si, axis=1), np.sort(ri, axis=1))
+    # cosine variant
+    si2, sv2 = topn_ops.top_k_sharded(up, q, 5, cosine=True)
+    ri2, rv2 = topn_ops.top_k_scores_batch(ref, q, 5, cosine=True)
+    np.testing.assert_allclose(np.sort(sv2, axis=1), np.sort(rv2, axis=1), rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_topk_keeps_zero_vector_items():
+    """Zero-embedding (cold) items rank by their true 0.0 score, exactly
+    like the single-device path — padding is masked by row position, not
+    by zero norms."""
+    import numpy as np
+    from oryx_tpu.ops import topn as topn_ops
+    from oryx_tpu.parallel.mesh import get_mesh
+
+    y = -np.abs(np.random.default_rng(3).standard_normal((20, 4))).astype(np.float32)
+    y[3] = 0.0  # zero vector: dot score 0 beats all-negative scores
+    q = np.ones((1, 4), dtype=np.float32)
+    up = topn_ops.upload_sharded(y, get_mesh())
+    si, sv = topn_ops.top_k_sharded(up, q, 3)
+    ref = topn_ops.upload(y, streaming=False)
+    ri, rv = topn_ops.top_k_scores_batch(ref, q, 3)
+    np.testing.assert_array_equal(si, ri)
+    assert si[0, 0] == 3 and sv[0, 0] == 0.0
+    assert np.isfinite(sv).all()
