@@ -49,9 +49,13 @@ class ALSServingModel(ServingModel):
         refresh_sec: float = 0.2,
         sample_rate: float = 1.0,
         score_dtype: str = "float32",
+        shard_items: bool = False,
     ) -> None:
         self.features = features
         self.implicit = implicit
+        # row-shard Y over all local devices (per-device top-k +
+        # all_gather merge): the >1-HBM serving mode
+        self.shard_items = shard_items
         # item-matrix dtype for device scoring: bfloat16 halves HBM traffic
         # (the serving bottleneck at millions of items) at ~1e-2 relative
         # score precision — near-tie ranks may swap, like LSH's trade-off
@@ -224,6 +228,7 @@ class ALSServingModel(ServingModel):
                     self._y_matrix is not None
                     and not self._y_full_rebuild
                     and self.lsh is None
+                    and not self.shard_items  # sharded layout rebuilds whole
                     and bool(dirty)
                     and self._try_incremental_refresh(dirty)
                 )
@@ -235,7 +240,14 @@ class ALSServingModel(ServingModel):
                         import jax.numpy as jnp
 
                         dtype = jnp.bfloat16 if self.score_dtype == "bfloat16" else jnp.float32
-                        self._y_matrix = topn_ops.upload(mat, dtype=dtype)
+                        if self.shard_items:
+                            from oryx_tpu.parallel.mesh import get_mesh
+
+                            self._y_matrix = topn_ops.upload_sharded(
+                                mat, get_mesh(), dtype=dtype
+                            )
+                        else:
+                            self._y_matrix = topn_ops.upload(mat, dtype=dtype)
                     else:
                         self._y_matrix = None
                     if self.lsh is not None:
@@ -293,6 +305,10 @@ class ALSServingModel(ServingModel):
             k = min(margin, num_candidates)
             if lsh_rows is not None:
                 idx, scores = _host_top_k(y_host, lsh_rows, query, k, cosine=cosine)
+            elif isinstance(y_mat, topn_ops.ShardedItemMatrix):
+                # mesh-sharded scan: per-device top-k + all_gather merge
+                bi, bv = topn_ops.top_k_sharded(y_mat, query, k, cosine=cosine)
+                idx, scores = bi[0], bv[0]
             else:
                 # continuous batching: concurrent requests against the same
                 # Y snapshot coalesce into one device call
@@ -362,6 +378,7 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.sample_rate = config.get_float("oryx.als.sample-rate")
         self.score_dtype = config.get_string("oryx.als.serving.score-dtype")
+        self.shard_items = config.get_bool("oryx.als.serving.shard-items")
         if self.score_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"oryx.als.serving.score-dtype must be float32 or bfloat16, "
@@ -405,6 +422,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                         implicit,
                         sample_rate=self.sample_rate,
                         score_dtype=self.score_dtype,
+                        shard_items=self.shard_items,
                     )
                     self.model.set_expected(x_ids, y_ids)
                 else:

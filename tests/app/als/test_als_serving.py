@@ -351,3 +351,23 @@ def test_incremental_refresh_avoids_full_reupload(monkeypatch):
     assert uploads == [1]
     assert all(r[0] in keep for r in res)
     assert sorted(m.all_item_ids()) == sorted(keep)
+
+
+def test_shard_items_serving_scan_over_mesh():
+    """shard-items=true: the Y cache row-shards over all local devices
+    and top_n answers match the single-device model exactly."""
+    single = build_model()
+    sharded = ALSServingModel(2, implicit=True, refresh_sec=0.0, shard_items=True)
+    for u, v in USER_VECS.items():
+        sharded.set_user_vector(u, np.asarray(v, dtype=np.float32))
+    for i, v in ITEM_VECS.items():
+        sharded.set_item_vector(i, np.asarray(v, dtype=np.float32))
+    q = np.asarray([1.0, 0.0], dtype=np.float32)
+    assert sharded.top_n(q, 2) == single.top_n(q, 2)
+    assert sharded.top_n(q, 2, exclude={"I0"}) == single.top_n(q, 2, exclude={"I0"})
+    from oryx_tpu.ops.topn import ShardedItemMatrix
+
+    assert isinstance(sharded._ensure_y_matrix()[2], ShardedItemMatrix)
+    # streaming UP updates still land (full rebuild per refresh)
+    sharded.set_item_vector("I9", np.asarray([7.0, 0.0], np.float32))
+    assert sharded.top_n(q, 1)[0][0] == "I9"
