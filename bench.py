@@ -109,6 +109,7 @@ def bench_serving() -> None:
 
     served = 0
     inflight: deque = deque()
+    latencies: list[float] = []
     num_groups = max(1, users // group)
     start = time.perf_counter()
     deadline = start + seconds
@@ -124,17 +125,25 @@ def bench_serving() -> None:
                         uploaded, queries, how_many, scan_batch=scan_batch
                     ),
                     len(queries),
+                    time.perf_counter(),
                 )
             )
             i += 1
         elif inflight:
-            handle, rows = inflight.popleft()
+            handle, rows, t_submit = inflight.popleft()
             handle.result()
+            latencies.append(time.perf_counter() - t_submit)
             served += rows
         else:
             break
     elapsed = time.perf_counter() - start
     qps = served / elapsed
+    lat = np.percentile(np.array(latencies) * 1000, [50, 99]) if latencies else [0, 0]
+    print(
+        f"bench[serving]: request latency p50 {lat[0]:.0f} ms / p99 {lat[1]:.0f} ms "
+        f"(queued-behind-pipeline latency at depth {depth})",
+        file=sys.stderr,
+    )
     bytes_per_scan = items * features * (2 if dtype_name == "bfloat16" else 4)
     gbps = i * scans_per_dispatch * bytes_per_scan / elapsed / 1e9
     print(
@@ -146,8 +155,9 @@ def bench_serving() -> None:
     _emit(
         f"ALS recommend top-{how_many} exact scan ({features} feat x {items} "
         f"items, {dtype_name}, {scans_per_dispatch} fused scans x {scan_batch} "
-        f"queries x depth {depth}, ~{gbps:.0f} GB/s effective{tag}) "
-        f"vs published 437 qps (LSH 0.3, 32-core Xeon)",
+        f"queries x depth {depth}, ~{gbps:.0f} GB/s effective, "
+        f"p50 {lat[0]:.0f}ms/p99 {lat[1]:.0f}ms{tag}) "
+        f"vs published 437 qps / 7 ms (LSH 0.3, 32-core Xeon)",
         qps,
         "queries/sec",
         qps / SERVING_BASELINE_QPS,
