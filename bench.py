@@ -355,12 +355,47 @@ def _run_child(env: dict, timeout: float) -> tuple[int, list[str], str]:
     return rc, json_lines, err
 
 
+def _probe_backend(timeout: float) -> bool:
+    """Quick subprocess probe: can the device backend actually run an op?
+    A wedged tunnel makes jax HANG (not error) in init, so without this
+    a dead TPU costs a full child-watchdog cycle per attempt before the
+    CPU fallback ever runs."""
+    code = "import jax, jax.numpy as jnp; jnp.ones(3).sum().block_until_ready(); print('PROBE-OK')"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=dict(os.environ),
+        )
+        return "PROBE-OK" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 3))
     init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 150))
     # generous: metrics stream as they complete, so a watchdog kill only
     # costs whatever is still running (RDF, the slowest, goes last)
     child_timeout = init_timeout + 1800
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        for p in range(2):
+            if _probe_backend(init_timeout):
+                break
+            print(
+                f"bench[parent]: backend probe {p + 1}/2 failed (hung init?)",
+                file=sys.stderr,
+            )
+            time.sleep(20)
+        else:
+            print(
+                "bench[parent]: device backend unreachable — CPU fallback",
+                file=sys.stderr,
+            )
+            os.environ["JAX_PLATFORMS"] = "cpu"
 
     base_env = dict(os.environ)
     base_env["ORYX_BENCH_CHILD"] = "1"
