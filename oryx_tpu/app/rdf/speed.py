@@ -66,11 +66,12 @@ class RDFSpeedModelManager(SpeedModelManager):
             new_data, self.schema, model.encodings, skip_unknown=True
         )
         tfi = self.schema.target_feature_index
-        # (treeID, nodeID) -> stats
+        # (treeID, nodeID) -> stats; one vectorized descent per tree
+        # (find_terminals_batch), not a Python walk per (example, tree)
         by_leaf: dict[tuple[int, str], list] = {}
-        for row, target in zip(features, targets):
-            for tree_id, tree in enumerate(model.forest.trees):
-                leaf = tree.find_terminal(row)
+        for tree_id, tree in enumerate(model.forest.trees):
+            leaves = tree.find_terminals_batch(features)
+            for leaf, target in zip(leaves, targets):
                 key = (tree_id, leaf.id)
                 if self.classification:
                     counts = by_leaf.setdefault(key, [{}])[0]

@@ -166,6 +166,40 @@ class DecisionTree:
             node = node.positive if node.decision.is_positive(features) else node.negative
         return node
 
+    def find_terminals_batch(self, features) -> list[TerminalNode]:
+        """Terminal node for every row of a [n, P] float array (NaN =
+        missing) — each tree node is visited once per batch with its
+        predicate evaluated vectorized over the rows that reached it,
+        instead of a Python walk per example (the speed layer's leaf
+        refresh runs whole micro-batches through this)."""
+        import numpy as np
+
+        features = np.asarray(features, dtype=np.float64)
+        n = len(features)
+        out: list[TerminalNode | None] = [None] * n
+        stack: list = [(self.root, np.arange(n))]
+        while stack:
+            node, rows = stack.pop()
+            if not len(rows):
+                continue
+            if node.is_terminal():
+                for r in rows.tolist():
+                    out[r] = node
+                continue
+            d = node.decision
+            col = features[rows, d.feature]
+            missing = np.isnan(col)
+            if isinstance(d, NumericDecision):
+                with np.errstate(invalid="ignore"):
+                    pos = col >= d.threshold
+            else:
+                ids = np.where(missing, -1, col).astype(np.int64)
+                pos = np.isin(ids, np.fromiter(d.category_ids, dtype=np.int64))
+            pos = np.where(missing, d.default_decision, pos)
+            stack.append((node.positive, rows[pos]))
+            stack.append((node.negative, rows[~pos]))
+        return out
+
     def find_by_id(self, node_id: str) -> DecisionNode | TerminalNode | None:
         """Walk by ID structure: '-'/'+' suffixes encode the path."""
         node = self.root
