@@ -381,7 +381,9 @@ def main() -> None:
     # costs whatever is still running (RDF, the slowest, goes last)
     child_timeout = init_timeout + 1800
 
-    if os.environ.get("JAX_PLATFORMS") != "cpu":
+    # attempts=1 is the documented fail-fast-TPU contract: no probe-driven
+    # CPU fallback there either
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and attempts > 1:
         for p in range(2):
             if _probe_backend(init_timeout):
                 break
@@ -389,7 +391,8 @@ def main() -> None:
                 f"bench[parent]: backend probe {p + 1}/2 failed (hung init?)",
                 file=sys.stderr,
             )
-            time.sleep(20)
+            if p == 0:
+                time.sleep(20)
         else:
             print(
                 "bench[parent]: device backend unreachable — CPU fallback",
@@ -408,8 +411,8 @@ def main() -> None:
     while attempt < attempts:
         last = attempt == attempts - 1
         env = dict(base_env)
-        label = "tpu"
-        if last and cpu_fallback and os.environ.get("JAX_PLATFORMS") != "cpu":
+        label = "cpu" if env.get("JAX_PLATFORMS") == "cpu" else "tpu"
+        if last and cpu_fallback and env.get("JAX_PLATFORMS") != "cpu":
             env["JAX_PLATFORMS"] = "cpu"
             label = "cpu-fallback"
         print(f"bench[parent]: attempt {attempt + 1}/{attempts} ({label})", file=sys.stderr)

@@ -110,9 +110,7 @@ class KMeansSpeedModelManager(SpeedModelManager):
 
         pts = np.stack(points)
         centers = np.stack([c.center for c in clusters])
-        assign, _ = assign_clusters(
-            pts.astype(np.float32), centers.astype(np.float32)
-        )
+        assign, _ = assign_clusters(pts, centers)  # float64 end to end
         out = []
         for slot in np.unique(assign):
             rows = assign == slot

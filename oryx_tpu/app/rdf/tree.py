@@ -172,8 +172,6 @@ class DecisionTree:
         predicate evaluated vectorized over the rows that reached it,
         instead of a Python walk per example (the speed layer's leaf
         refresh runs whole micro-batches through this)."""
-        import numpy as np
-
         features = np.asarray(features, dtype=np.float64)
         n = len(features)
         out: list[TerminalNode | None] = [None] * n
