@@ -73,13 +73,16 @@ def _emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
 # --------------------------------------------------------------------------
 
 
-def bench_serving() -> None:
+def bench_serving(features_override: int | None = None, baseline_qps: float | None = None) -> None:
     items = int(os.environ.get("ORYX_BENCH_ITEMS", 1_000_000))
-    features = int(os.environ.get("ORYX_BENCH_FEATURES", 50))
+    features = features_override or int(os.environ.get("ORYX_BENCH_FEATURES", 50))
     users = int(os.environ.get("ORYX_BENCH_USERS", 8192))
     seconds = float(os.environ.get("ORYX_BENCH_SECONDS", 10.0))
     group = int(os.environ.get("ORYX_BENCH_GROUP", 2048))  # queries/dispatch
-    scan_batch = int(os.environ.get("ORYX_BENCH_SCAN_BATCH", 256))  # per scan
+    # narrower scans for wide features keep the kernel inside scoped VMEM
+    scan_batch = int(
+        os.environ.get("ORYX_BENCH_SCAN_BATCH", 256 if features <= 64 else 128)
+    )
     depth = int(os.environ.get("ORYX_BENCH_DEPTH", 12))  # dispatches in flight
     dtype_name = os.environ.get("ORYX_BENCH_DTYPE", "bfloat16")
     how_many = 10
@@ -152,16 +155,23 @@ def bench_serving() -> None:
         file=sys.stderr,
     )
     tag = "" if backend == "tpu" else f", {backend} FALLBACK"
+    base = baseline_qps or SERVING_BASELINE_QPS
     _emit(
         f"ALS recommend top-{how_many} exact scan ({features} feat x {items} "
         f"items, {dtype_name}, {scans_per_dispatch} fused scans x {scan_batch} "
         f"queries x depth {depth}, ~{gbps:.0f} GB/s effective, "
         f"p50 {lat[0]:.0f}ms/p99 {lat[1]:.0f}ms{tag}) "
-        f"vs published 437 qps / 7 ms (LSH 0.3, 32-core Xeon)",
+        f"vs published {base:.0f} qps (LSH 0.3, 32-core Xeon)",
         qps,
         "queries/sec",
-        qps / SERVING_BASELINE_QPS,
+        qps / base,
     )
+
+
+def bench_serving_250() -> None:
+    """The reference table's heavier shape: 250 feat x 1M items
+    (151 qps published at LSH 0.3; performance.md:113)."""
+    bench_serving(features_override=250, baseline_qps=151.0)
 
 
 def bench_kmeans() -> None:
@@ -257,6 +267,7 @@ def bench_speed() -> None:
 
 BENCHES = [
     ("serving", bench_serving),
+    ("serving-250", bench_serving_250),
     ("kmeans", bench_kmeans),
     ("als", bench_als),
     ("als-scale", bench_als_scale),

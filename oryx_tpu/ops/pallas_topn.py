@@ -97,7 +97,8 @@ def upload_streaming(matrix: np.ndarray, dtype=jnp.float32) -> StreamingItemMatr
 
 
 def _topn_kernel(
-    q_ref, mat_ref, norms_ref, vals_ref, idx_ref, vstate, istate, *, k, n_items, cosine, grid
+    q_ref, mat_ref, norms_ref, vals_ref, idx_ref, vstate, istate, *,
+    k, n_items, cosine, grid, subtiles
 ):
     """One grid step: score a [k_feat, BLOCK_N] item block and fold it
     into the running top-k carried in VMEM scratch across grid steps.
@@ -133,8 +134,8 @@ def _topn_kernel(
     # sub-tile keeps VMEM at two tiles regardless of how many sub-tiles a
     # grid step streams; the global item id is base + local.
     local_cols = jax.lax.broadcasted_iota(jnp.int32, (b, SCORE_TILE), 1)
-    for s in range(SUBTILES):  # unrolled: static sub-tile slices
-        base = block * BLOCK_N + s * SCORE_TILE
+    for s in range(subtiles):  # unrolled: static sub-tile slices
+        base = block * (SCORE_TILE * subtiles) + s * SCORE_TILE
         scores = jnp.dot(
             q,
             mat_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE],
@@ -215,12 +216,34 @@ def _streaming_topk(mat_t, norms, queries, *, k, n_items, cosine, interpret):
     )
 
 
+_VMEM_BUDGET = 16 * 2**20  # v5e scoped-vmem limit (measured)
+
+
+def _subtiles_for(k_feat: int, b: int, dtype_bytes: int) -> int:
+    """Largest power-of-two sub-tile count (<= SUBTILES, divides BLOCK_N)
+    whose working set fits scoped VMEM. Calibrated against measured
+    compile outcomes: ~ b*TILE*8 (score+iota tiles) + 2*k_feat*TILE*s*
+    dtype (double-buffered item block) + ~4MB of temps."""
+    s = SUBTILES
+    while s > 1 and (
+        b * SCORE_TILE * 8 + 2 * k_feat * SCORE_TILE * s * dtype_bytes + 4 * 2**20
+        > _VMEM_BUDGET - 256 * 1024  # headroom: the calibration is +/- a few %
+    ):
+        s //= 2
+    return s
+
+
 def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret):
     k_feat, n_pad = mat_t.shape
     b = queries.shape[0]
-    grid = n_pad // BLOCK_N
+    # adapt sub-tiles to the feature width so wide models (250-feat) still
+    # fit scoped VMEM; n_pad is a BLOCK_N multiple, so any power-of-two
+    # divisor of SUBTILES keeps the grid exact
+    subtiles = _subtiles_for(k_feat, b, mat_t.dtype.itemsize)
+    step = SCORE_TILE * subtiles
+    grid = n_pad // step
     kernel = functools.partial(
-        _topn_kernel, k=k, n_items=n_items, cosine=cosine, grid=grid
+        _topn_kernel, k=k, n_items=n_items, cosine=cosine, grid=grid, subtiles=subtiles
     )
     common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
     if pltpu is None:  # pragma: no cover - jax builds without pallas-tpu
@@ -234,8 +257,8 @@ def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((b, k_feat), lambda i: (0, 0), **common),
-            pl.BlockSpec((k_feat, BLOCK_N), lambda i: (0, i), **common),
-            pl.BlockSpec((1, BLOCK_N), lambda i: (0, i), **common),
+            pl.BlockSpec((k_feat, step), lambda i: (0, i), **common),
+            pl.BlockSpec((1, step), lambda i: (0, i), **common),
         ],
         out_specs=[
             pl.BlockSpec((b, k), lambda i: (0, 0), **common),
