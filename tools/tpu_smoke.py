@@ -77,6 +77,20 @@ def main() -> None:
     assert overlap > 0.8, f"bf16 top-k overlap too low: {overlap}"
     lines.append(f"pallas_topn[bf16]: OK (top-{k} overlap vs fp32 = {overlap:.2f})")
 
+    # 1b. fused multi-scan dispatch == single-scan results
+    mi, mv = topn_ops.submit_top_k_multi(handle, q, k, scan_batch=32).result()
+    np.testing.assert_array_equal(mi, pi)
+    np.testing.assert_allclose(mv, pv, rtol=1e-5, atol=1e-4)
+    lines.append(f"pallas_topn[multi]: OK ({batch // 32 or 1}+ fused scans == single)")
+
+    # 1c. incremental scatter update: dirty rows re-ship, ranking follows
+    y2 = y.copy()
+    y2[123] = np.abs(y2[123]) * 50.0  # make row 123 dominate
+    upd = topn_ops.update_rows(handle, np.array([123]), y2[123:124])
+    ui, _ = topn_ops.top_k_scores_batch(upd, np.abs(q[:4]), k)
+    assert (ui[:, 0] == 123).all(), f"scatter-updated row should win: {ui[:, 0]}"
+    lines.append("pallas_topn[update_rows]: OK (scatter-updated row ranks first)")
+
     # 2. fused Lloyd sweep vs XLA lloyd run
     from oryx_tpu.ops import kmeans as km
     from oryx_tpu.ops.pallas_kmeans import fits_vmem, lloyd_pallas
