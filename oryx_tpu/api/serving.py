@@ -37,6 +37,15 @@ class ServingModelManager(abc.ABC):
         daemon thread started by the serving runtime
         (ModelManagerListener.java:134-145)."""
 
+    def consume_blocks(self, block_iterator) -> None:
+        """Columnar form of consume (iterator of RecordBlocks). Default
+        adapts to the per-record consume(); managers with heavy replay
+        traffic (ALS factor publishes are one UP per vector) override it
+        to parse whole blocks vectorized."""
+        self.consume(
+            km for block in block_iterator for km in block.iter_key_messages()
+        )
+
     @abc.abstractmethod
     def get_config(self) -> Config: ...
 

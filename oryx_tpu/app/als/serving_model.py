@@ -117,6 +117,23 @@ class ALSServingModel(ServingModel):
             self._y_dirty = True
             self._dirty_ids.add(item)
 
+    def set_user_vectors(self, users: list[str], vectors: np.ndarray) -> None:
+        """Batched set: one native store call + one lock round for the
+        whole batch (update-topic replay is one UP per factor row)."""
+        self.x.set_batch(users, vectors)
+        with self._expected_lock:
+            self._expected_users.difference_update(users)
+
+    def set_item_vectors(self, items: list[str], vectors: np.ndarray) -> None:
+        self.y.set_batch(items, vectors)
+        with self._expected_lock:
+            self._expected_items.difference_update(items)
+        with self._solver_lock:
+            self._yty_solver = None
+        with self._cache_lock:
+            self._y_dirty = True
+            self._dirty_ids.update(items)
+
     # -- known items (ALSServingModel.java:189-258) --------------------------
 
     def add_known_items(self, user: str, items: Iterable[str]) -> None:
@@ -125,6 +142,14 @@ class ALSServingModel(ServingModel):
             return
         with self._known_lock.write():
             self._known_items.setdefault(user, set()).update(items)
+
+    def add_known_items_many(self, pairs: Iterable[tuple[str, list[str]]]) -> None:
+        """Batched known-items merge under one write lock."""
+        with self._known_lock.write():
+            known = self._known_items
+            for user, items in pairs:
+                if items:
+                    known.setdefault(user, set()).update(items)
 
     def get_known_items(self, user: str) -> set[str]:
         with self._known_lock.read():
@@ -387,6 +412,99 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.rescorer_provider = _load_rescorer_providers(config)
         self.model: ALSServingModel | None = None
         self._consumed = 0
+
+    def consume_blocks(self, block_iterator) -> None:
+        """Columnar consume: contiguous "UP" runs parse vectorized and
+        apply via the batched setters (replay of a factor publish is one
+        UP per row — a million-record startup replay). X rows carrying
+        known-item lists parse those too; anything escaped or unusual
+        falls back to per-record consume in order."""
+        for block in block_iterator:
+            if self.model is None or block.keys is None:
+                self.consume(block.iter_key_messages())
+                continue
+            keys = block.keys.tolist()
+            msgs = block.messages.tolist()
+            n = len(msgs)
+            i = 0
+            while i < n:
+                if keys[i] == b"UP":
+                    j = i
+                    while j < n and keys[j] == b"UP":
+                        j += 1
+                    self._apply_up_batch(msgs[i:j])
+                    i = j
+                else:
+                    self.consume(iter([KeyMessage(
+                        keys[i].decode("utf-8", "replace"),
+                        msgs[i].decode("utf-8", "replace"),
+                    )]))
+                    i += 1
+
+    def _apply_up_batch(self, lines: list[bytes]) -> None:
+        from oryx_tpu.native.store import parse_float_csv
+
+        model = self.model
+        k = model.features
+        groups = {
+            b'["X","': ([], [], [], [], model.set_user_vectors),
+            b'["Y","': ([], [], [], [], model.set_item_vectors),
+        }
+        slow: list[bytes] = []
+        for ln in lines:
+            group = groups.get(ln[:6])
+            if group is None:
+                slow.append(ln)
+                continue
+            at = ln.find(b'",[', 6)
+            end = ln.find(b"]", at + 3) if at != -1 else -1
+            if at == -1 or end == -1 or b"\\" in ln:
+                slow.append(ln)  # escaped/odd shape: per-record path
+                continue
+            tail = ln[end + 1 :]
+            known: list[str] | None = None
+            if tail != b"]":
+                # optional known-ids list: ,["i1","i2"]] (used for X only)
+                if not (tail.startswith(b',[') and tail.endswith(b"]]")):
+                    slow.append(ln)
+                    continue
+                inner = tail[2:-2]
+                if inner == b"":
+                    known = []
+                elif inner.startswith(b'"') and inner.endswith(b'"'):
+                    known = [s.decode("utf-8") for s in inner[1:-1].split(b'","')]
+                else:
+                    slow.append(ln)
+                    continue
+            group[0].append(ln[6:at].decode("utf-8"))
+            group[1].append(ln[at + 3 : end])
+            group[2].append(ln)
+            group[3].append(known)
+        for which, (ids, vecs, origs, knowns, setter) in groups.items():
+            if not ids:
+                continue
+            payload = b",".join(vecs)
+            flat = parse_float_csv(payload, len(ids) * k)
+            if flat is None:
+                parts = payload.split(b",")
+                if len(parts) == len(ids) * k:
+                    try:
+                        flat = np.array(parts, dtype="S").astype(np.float32)
+                    except ValueError:
+                        flat = None
+            if flat is None:
+                slow.extend(origs)  # oddball numerics: whole group per-record
+                continue
+            setter(ids, flat.reshape(len(ids), k))
+            if which == b'["X","' and not self.no_known_items:
+                model.add_known_items_many(
+                    (u, kn) for u, kn in zip(ids, knowns) if kn
+                )
+        if slow:
+            self.consume(
+                KeyMessage("UP", ln.decode("utf-8", "replace")) for ln in slow
+            )
+        self._consumed += len(lines) - len(slow)  # slow path self-counts
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         for km in update_iterator:

@@ -36,7 +36,6 @@ from oryx_tpu.bus.core import get_broker
 from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
-from oryx_tpu.lambda_.base import blocking_iterator
 from oryx_tpu.serving.web import (
     OryxServingException,
     Request,
@@ -302,9 +301,11 @@ class ServingLayer:
         log.info("ServingLayer listening on :%d%s", self.port, self.context_path or "/")
 
     def _consume_updates(self) -> None:
+        from oryx_tpu.lambda_.base import blocking_block_iterator
+
         try:
-            self.model_manager.consume(
-                blocking_iterator(self._update_consumer, self._stop_event)
+            self.model_manager.consume_blocks(
+                blocking_block_iterator(self._update_consumer, self._stop_event)
             )
         except Exception:
             log.exception("serving model consume thread failed")

@@ -371,3 +371,35 @@ def test_shard_items_serving_scan_over_mesh():
     # streaming UP updates still land (full rebuild per refresh)
     sharded.set_item_vector("I9", np.asarray([7.0, 0.0], np.float32))
     assert sharded.top_n(q, 1)[0][0] == "I9"
+
+
+def test_serving_consume_blocks_matches_per_record():
+    """Serving columnar consume lands identical state to per-record —
+    including known-item lists, empty lists, escaped ids, and a MODEL
+    rotation mid-stream."""
+    from oryx_tpu.common.records import RecordBlock
+
+    msgs = [
+        KeyMessage("MODEL", model_message(["U0", 'u"q'], ["I0", "I1"])),
+        KeyMessage("UP", '["Y","I0",[1.0,0.5]]'),
+        KeyMessage("UP", '["Y","I1",[0.5,1.0],["whoever"]]'),  # Y extras ignored
+        KeyMessage("UP", '["X","U0",[1.0,0.0],["I0","I1"]]'),
+        KeyMessage("UP", '["X","u\\"q",[0.25,0.25],["I0"]]'),  # escaped id: slow
+        KeyMessage("UP", '["X","U2",[0.0,1.0],[]]'),  # empty known list
+        KeyMessage("MODEL", model_message(["U0"], ["I0"])),
+        KeyMessage("UP", '["Y","I0",[9.0,9.0]]'),
+    ]
+    per = ALSServingModelManager(serving_config("inproc://unused-a"))
+    per.consume(iter(msgs))
+    blk = ALSServingModelManager(serving_config("inproc://unused-b"))
+    blk.consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))
+    for mgr in (per, blk):
+        m = mgr.get_model()
+        np.testing.assert_array_equal(m.get_item_vector("I0"), [9.0, 9.0])
+        np.testing.assert_array_equal(m.get_user_vector("U0"), [1.0, 0.0])
+        np.testing.assert_array_equal(m.get_user_vector('u"q'), [0.25, 0.25])
+        assert m.get_known_items("U0") == {"I0", "I1"}
+        assert m.get_known_items('u"q') == {"I0"}
+        assert m.get_known_items("U2") == set()
+    assert per.get_model().y.size() == blk.get_model().y.size()
+    assert per.get_model().x.size() == blk.get_model().x.size()
