@@ -446,65 +446,86 @@ class ALSServingModelManager(AbstractServingModelManager):
 
         model = self.model
         k = model.features
-        groups = {
-            b'["X","': ([], [], [], [], model.set_user_vectors),
-            b'["Y","': ([], [], [], [], model.set_item_vectors),
-        }
-        slow: list[bytes] = []
+
+        def fresh():
+            return {
+                b'["X","': ([], [], [], [], model.set_user_vectors),
+                b'["Y","': ([], [], [], [], model.set_item_vectors),
+            }
+
+        groups = fresh()
+        applied = 0
+
+        def flush() -> None:
+            nonlocal groups, applied
+            for which, (ids, vecs, origs, knowns, setter) in groups.items():
+                if not ids:
+                    continue
+                payload = b",".join(vecs)
+                flat = parse_float_csv(payload, len(ids) * k)
+                if flat is None:
+                    parts = payload.split(b",")
+                    if len(parts) == len(ids) * k:
+                        try:
+                            flat = np.array(parts, dtype="S").astype(np.float32)
+                        except ValueError:
+                            flat = None
+                if flat is None:
+                    # oddball numerics: whole group per-record, in order
+                    self.consume(
+                        KeyMessage("UP", ln.decode("utf-8", "replace"))
+                        for ln in origs
+                    )
+                    continue
+                setter(ids, flat.reshape(len(ids), k))
+                applied += len(ids)
+                if which == b'["X","' and not self.no_known_items:
+                    model.add_known_items_many(
+                        (u, kn) for u, kn in zip(ids, knowns) if kn
+                    )
+            groups = fresh()
+
         for ln in lines:
+            slow = False
             group = groups.get(ln[:6])
-            if group is None:
-                slow.append(ln)
-                continue
-            at = ln.find(b'",[', 6)
-            end = ln.find(b"]", at + 3) if at != -1 else -1
-            if at == -1 or end == -1 or b"\\" in ln:
-                slow.append(ln)  # escaped/odd shape: per-record path
-                continue
-            tail = ln[end + 1 :]
             known: list[str] | None = None
-            if tail != b"]":
-                # optional known-ids list: ,["i1","i2"]] (used for X only)
-                if not (tail.startswith(b',[') and tail.endswith(b"]]")):
-                    slow.append(ln)
-                    continue
-                inner = tail[2:-2]
-                if inner == b"":
-                    known = []
-                elif inner.startswith(b'"') and inner.endswith(b'"'):
-                    known = [s.decode("utf-8") for s in inner[1:-1].split(b'","')]
+            at = end = -1
+            if group is None or b"\\" in ln:
+                slow = True
+            else:
+                at = ln.find(b'",[', 6)
+                end = ln.find(b"]", at + 3) if at != -1 else -1
+                if at == -1 or end == -1:
+                    slow = True
                 else:
-                    slow.append(ln)
-                    continue
-            group[0].append(ln[6:at].decode("utf-8"))
+                    tail = ln[end + 1 :]
+                    if tail != b"]":
+                        # optional known-ids list: ,["i1","i2"]] (X only)
+                        if not (tail.startswith(b',[') and tail.endswith(b"]]")):
+                            slow = True
+                        else:
+                            inner = tail[2:-2]
+                            if inner == b"":
+                                known = []
+                            elif inner.startswith(b'"') and inner.endswith(b'"'):
+                                known = [
+                                    s.decode("utf-8", "replace")
+                                    for s in inner[1:-1].split(b'","')
+                                ]
+                            else:
+                                slow = True
+            if slow:
+                # flush first: a later fast update for the same id must
+                # not be overwritten by replaying this older record after it
+                flush()
+                self.consume(iter([KeyMessage("UP", ln.decode("utf-8", "replace"))]))
+                continue
+            group[0].append(ln[6:at].decode("utf-8", "replace"))
             group[1].append(ln[at + 3 : end])
             group[2].append(ln)
             group[3].append(known)
-        for which, (ids, vecs, origs, knowns, setter) in groups.items():
-            if not ids:
-                continue
-            payload = b",".join(vecs)
-            flat = parse_float_csv(payload, len(ids) * k)
-            if flat is None:
-                parts = payload.split(b",")
-                if len(parts) == len(ids) * k:
-                    try:
-                        flat = np.array(parts, dtype="S").astype(np.float32)
-                    except ValueError:
-                        flat = None
-            if flat is None:
-                slow.extend(origs)  # oddball numerics: whole group per-record
-                continue
-            setter(ids, flat.reshape(len(ids), k))
-            if which == b'["X","' and not self.no_known_items:
-                model.add_known_items_many(
-                    (u, kn) for u, kn in zip(ids, knowns) if kn
-                )
-        if slow:
-            self.consume(
-                KeyMessage("UP", ln.decode("utf-8", "replace")) for ln in slow
-            )
-        self._consumed += len(lines) - len(slow)  # slow path self-counts
+        flush()
+        self._consumed += applied  # slow path self-counts
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         for km in update_iterator:

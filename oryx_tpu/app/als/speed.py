@@ -153,44 +153,53 @@ class ALSSpeedModelManager(SpeedModelManager):
     def _apply_up_batch(self, lines: list[bytes]) -> None:
         model = self.model
         k = model.features
-        groups = {
-            b'["X","': ([], [], [], model.set_user_vectors),
-            b'["Y","': ([], [], [], model.set_item_vectors),
-        }
-        slow: list[bytes] = []
+
+        def fresh():
+            return {
+                b'["X","': ([], [], [], model.set_user_vectors),
+                b'["Y","': ([], [], [], model.set_item_vectors),
+            }
+
+        groups = fresh()
+
+        def flush() -> None:
+            nonlocal groups
+            for ids, vecs, origs, setter in groups.values():
+                if not ids:
+                    continue
+                payload = b",".join(vecs)
+                flat = parse_float_csv(payload, len(ids) * k)  # native strtof
+                if flat is None:  # library absent / mismatch: numpy twin
+                    parts = payload.split(b",")
+                    if len(parts) == len(ids) * k:
+                        try:
+                            flat = np.array(parts, dtype="S").astype(np.float32)
+                        except ValueError:
+                            flat = None
+                if flat is None:
+                    # oddball numerics: whole group per-record, in order
+                    self.consume(
+                        KeyMessage("UP", ln.decode("utf-8", "replace"))
+                        for ln in origs
+                    )
+                else:
+                    setter(ids, flat.reshape(len(ids), k))
+            groups = fresh()
+
         for ln in lines:
             group = groups.get(ln[:6])
-            if group is None:
-                slow.append(ln)
-                continue
-            at = ln.find(b'",[', 6)
+            at = ln.find(b'",[', 6) if group is not None else -1
             end = ln.find(b"]", at + 3) if at != -1 else -1
-            if at == -1 or end == -1 or b"\\" in ln[:at]:
-                slow.append(ln)  # escaped/odd id or shape: per-record path
+            if group is None or at == -1 or end == -1 or b"\\" in ln[:at]:
+                # flush first: a later fast update for the same id must not
+                # be overwritten by replaying this older record after it
+                flush()
+                self.consume(iter([KeyMessage("UP", ln.decode("utf-8", "replace"))]))
                 continue
-            group[0].append(ln[6:at].decode("utf-8"))
+            group[0].append(ln[6:at].decode("utf-8", "replace"))
             group[1].append(ln[at + 3 : end])
             group[2].append(ln)
-        for ids, vecs, origs, setter in groups.values():
-            if not ids:
-                continue
-            payload = b",".join(vecs)
-            flat = parse_float_csv(payload, len(ids) * k)  # native strtof
-            if flat is None:  # library absent / count mismatch: numpy twin
-                parts = payload.split(b",")
-                if len(parts) == len(ids) * k:
-                    try:
-                        flat = np.array(parts, dtype="S").astype(np.float32)
-                    except ValueError:
-                        flat = None
-            if flat is None:
-                slow.extend(origs)  # oddball numerics: whole group per-record
-            else:
-                setter(ids, flat.reshape(len(ids), k))
-        if slow:
-            self.consume(
-                KeyMessage("UP", ln.decode("utf-8", "replace")) for ln in slow
-            )
+        flush()
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         for km in update_iterator:

@@ -403,3 +403,22 @@ def test_serving_consume_blocks_matches_per_record():
         assert m.get_known_items("U2") == set()
     assert per.get_model().y.size() == blk.get_model().y.size()
     assert per.get_model().x.size() == blk.get_model().x.size()
+
+
+def test_consume_blocks_slow_fast_ordering_same_id():
+    """A slow-path record for an id followed by a fast-path record for the
+    same id in one block must end with the NEWER vector (the slow record
+    flushes in stream position, not after the batch)."""
+    from oryx_tpu.common.records import RecordBlock
+
+    msgs = [
+        KeyMessage("MODEL", model_message(["U7"], ["I0"])),
+        # older record for U7 takes the slow path (escaped known item)
+        KeyMessage("UP", '["X","U7",[1.0,2.0],["a\\"b"]]'),
+        # newer record for U7 takes the fast path
+        KeyMessage("UP", '["X","U7",[3.0,4.0],[]]'),
+    ]
+    blk = ALSServingModelManager(serving_config("inproc://unused-ord"))
+    blk.consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))
+    np.testing.assert_array_equal(blk.get_model().get_user_vector("U7"), [3.0, 4.0])
+    assert blk.get_model().get_known_items("U7") == {'a"b'}
