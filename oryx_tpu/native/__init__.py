@@ -131,3 +131,11 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_char, c.c_int, c.c_int64, c.POINTER(c.c_char),
         c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
     ]
+    lib.als_format_updates_multi.restype = c.c_int64
+    lib.als_format_updates_multi.argtypes = [
+        c.POINTER(c.c_float), c.c_int64, c.c_int64,
+        c.POINTER(c.c_int64), c.c_char_p,
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_char_p,
+        c.c_char, c.c_int64, c.POINTER(c.c_char),
+        c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+    ]

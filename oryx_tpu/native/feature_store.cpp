@@ -481,19 +481,14 @@ int64_t als_update_row_cap(int64_t k, int64_t max_id_len) {
   return 16 + 2 * (6 * max_id_len + 2) + 2 + k * 18;
 }
 
-// matrix_tag: 'X' or 'Y'. ids/other_ids arrive as (offsets[n+1], payload)
-// pairs. include_known: emit the trailing [otherId] element. out must hold
-// n * als_update_row_cap(k, max_id_len) bytes. Each thread writes its
-// rows back-to-back inside its own region; regions are then compacted so
-// the result is one contiguous byte run. Returns total bytes.
-int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
-                           const int64_t* id_offs, const char* id_payload,
-                           const int64_t* other_offs, const char* other_payload,
-                           char matrix_tag, int include_known,
-                           int64_t max_id_len, char* out,
-                           int64_t* starts, int64_t* ends, int64_t num_threads) {
+// Shared scaffold for the update formatters: each thread writes its rows
+// back-to-back inside its own stride-spaced region, then regions compact
+// into one contiguous byte run (row offsets shifted). write_row appends
+// row i at w and returns the new write head. Returns total bytes.
+static int64_t format_rows_parallel(
+    int64_t n, int64_t stride, char* out, int64_t* starts, int64_t* ends,
+    int64_t num_threads, const std::function<char*(int64_t, char*)>& write_row) {
   if (n == 0) return 0;
-  const int64_t stride = als_update_row_cap(k, max_id_len);
   if (num_threads < 1) num_threads = 1;
   if (num_threads > n) num_threads = n;
   const int64_t chunk = (n + num_threads - 1) / num_threads;
@@ -502,29 +497,7 @@ int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
     char* w = out + lo * stride;
     for (int64_t i = lo; i < hi; ++i) {
       starts[i] = w - out;
-      *w++ = '[';
-      *w++ = '"';
-      *w++ = matrix_tag;
-      *w++ = '"';
-      *w++ = ',';
-      w = json_escape_append(w, id_payload + id_offs[i],
-                             static_cast<uint32_t>(id_offs[i + 1] - id_offs[i]));
-      *w++ = ',';
-      *w++ = '[';
-      const float* row = mat + i * k;
-      for (int64_t j = 0; j < k; ++j) {
-        if (j) *w++ = ',';
-        w = float_append(w, row[j]);
-      }
-      *w++ = ']';
-      if (include_known) {
-        *w++ = ',';
-        *w++ = '[';
-        w = json_escape_append(w, other_payload + other_offs[i],
-                               static_cast<uint32_t>(other_offs[i + 1] - other_offs[i]));
-        *w++ = ']';
-      }
-      *w++ = ']';
+      w = write_row(i, w);
       ends[i] = w - out;
     }
     region_end[t] = w - out;
@@ -558,6 +531,81 @@ int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
     dst += len;
   }
   return dst;
+}
+
+// ["T","id",[v..]] row prefix shared by both formatter variants.
+static char* append_row_head(char* w, char matrix_tag, const float* row,
+                             int64_t k, const int64_t* id_offs,
+                             const char* id_payload, int64_t i) {
+  *w++ = '[';
+  *w++ = '"';
+  *w++ = matrix_tag;
+  *w++ = '"';
+  *w++ = ',';
+  w = json_escape_append(w, id_payload + id_offs[i],
+                         static_cast<uint32_t>(id_offs[i + 1] - id_offs[i]));
+  *w++ = ',';
+  *w++ = '[';
+  for (int64_t j = 0; j < k; ++j) {
+    if (j) *w++ = ',';
+    w = float_append(w, row[j]);
+  }
+  *w++ = ']';
+  return w;
+}
+
+// matrix_tag: 'X' or 'Y'. ids/other_ids arrive as (offsets[n+1], payload)
+// pairs. include_known: emit the trailing [otherId] element. out must hold
+// n * als_update_row_cap(k, max_id_len) bytes.
+int64_t als_format_updates(const float* mat, int64_t n, int64_t k,
+                           const int64_t* id_offs, const char* id_payload,
+                           const int64_t* other_offs, const char* other_payload,
+                           char matrix_tag, int include_known,
+                           int64_t max_id_len, char* out,
+                           int64_t* starts, int64_t* ends, int64_t num_threads) {
+  const int64_t stride = als_update_row_cap(k, max_id_len);
+  return format_rows_parallel(
+      n, stride, out, starts, ends, num_threads, [&](int64_t i, char* w) {
+        w = append_row_head(w, matrix_tag, mat + i * k, k, id_offs, id_payload, i);
+        if (include_known) {
+          *w++ = ',';
+          *w++ = '[';
+          w = json_escape_append(
+              w, other_payload + other_offs[i],
+              static_cast<uint32_t>(other_offs[i + 1] - other_offs[i]));
+          *w++ = ']';
+        }
+        *w++ = ']';
+        return w;
+      });
+}
+
+// Multi-known variant: row i carries the known-id list
+// known_ids[known_row_offs[i] .. known_row_offs[i+1]) where each known id
+// j is known_payload[known_offs[j] .. known_offs[j+1]). Emits
+// ["T","id",[v..],["k1","k2",...]] (empty list allowed). The caller
+// supplies the per-row stride (worst case including its widest known list).
+int64_t als_format_updates_multi(
+    const float* mat, int64_t n, int64_t k,
+    const int64_t* id_offs, const char* id_payload,
+    const int64_t* known_row_offs, const int64_t* known_offs,
+    const char* known_payload, char matrix_tag, int64_t stride,
+    char* out, int64_t* starts, int64_t* ends, int64_t num_threads) {
+  return format_rows_parallel(
+      n, stride, out, starts, ends, num_threads, [&](int64_t i, char* w) {
+        w = append_row_head(w, matrix_tag, mat + i * k, k, id_offs, id_payload, i);
+        *w++ = ',';
+        *w++ = '[';
+        for (int64_t g = known_row_offs[i]; g < known_row_offs[i + 1]; ++g) {
+          if (g > known_row_offs[i]) *w++ = ',';
+          w = json_escape_append(
+              w, known_payload + known_offs[g],
+              static_cast<uint32_t>(known_offs[g + 1] - known_offs[g]));
+        }
+        *w++ = ']';
+        *w++ = ']';
+        return w;
+      });
 }
 
 // Parse a comma-separated run of decimal floats ("1.5,-2,3e-4,nan") into

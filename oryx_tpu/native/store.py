@@ -257,6 +257,37 @@ def format_vectors_json(mat: np.ndarray) -> list[str]:
     return [s[off[i] : off[i + 1]] for i in range(n)]
 
 
+def _format_rows(
+    n: int,
+    stride: int,
+    all_ascii: bool,
+    num_threads: int | None,
+    invoke,
+) -> list[str] | None:
+    """Shared tail of the update formatters: allocate the stride-spaced
+    output + row-offset buffers, run the native call, slice rows out of
+    the compacted byte run (one ascii decode when every payload is ascii,
+    per-row utf-8 otherwise)."""
+    out = np.empty(n * stride, dtype=np.uint8)
+    starts = np.empty(n, dtype=np.int64)
+    ends = np.empty(n, dtype=np.int64)
+    threads = num_threads or min(8, os.cpu_count() or 1)
+    total = invoke(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        threads,
+    )
+    if total < 0:  # pragma: no cover - strides are computed right here
+        return None
+    st, en = starts.tolist(), ends.tolist()
+    if all_ascii:
+        s = str(memoryview(out)[:total], "ascii")
+        return [s[st[i] : en[i]] for i in range(n)]
+    buf = memoryview(out)[:total]
+    return [str(buf[st[i] : en[i]], "utf-8") for i in range(n)]
+
+
 def format_update_messages(
     mat: np.ndarray,
     ids: list[str],
@@ -289,34 +320,73 @@ def format_update_messages(
         int(np.diff(other_offs).max()) if n else 1,
     )
     stride = int(lib.als_update_row_cap(k, max_id_len))
-    out = np.empty(n * stride, dtype=np.uint8)
-    starts = np.empty(n, dtype=np.int64)
-    ends = np.empty(n, dtype=np.int64)
-    threads = num_threads or min(8, os.cpu_count() or 1)
-    total = lib.als_format_updates(
-        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        n,
-        k,
-        _offsets_ptr(id_offs),
-        id_payload,
-        _offsets_ptr(other_offs),
-        other_payload,
-        tag.encode("ascii"),
-        1 if include_known else 0,
-        max_id_len,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
-        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        threads,
+    return _format_rows(
+        n, stride, all_ascii, num_threads,
+        lambda out, starts, ends, threads: lib.als_format_updates(
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, k,
+            _offsets_ptr(id_offs), id_payload,
+            _offsets_ptr(other_offs), other_payload,
+            tag.encode("ascii"),
+            1 if include_known else 0,
+            max_id_len, out, starts, ends, threads,
+        ),
     )
-    if total < 0:  # pragma: no cover - streams are built right here
+
+
+def format_update_messages_multi(
+    mat: np.ndarray,
+    ids: list[str],
+    known_lists: list[list[str]],
+    tag: str,
+    num_threads: int | None = None,
+) -> list[str] | None:
+    """Update messages ["X"|"Y", id, [v..], [k1, k2, ...]] where each row
+    carries its own known-id LIST — the shape the speed layer needs after
+    coalescing a micro-batch's per-event updates into one message per id
+    (the known items of dropped duplicates merge into the survivor).
+    Returns None when the native library is unavailable."""
+    lib = get_library()
+    if lib is None:
         return None
-    st, en = starts.tolist(), ends.tolist()
-    if all_ascii:
-        s = str(memoryview(out)[:total], "ascii")
-        return [s[st[i] : en[i]] for i in range(n)]
-    buf = memoryview(out)[:total]
-    return [str(buf[st[i] : en[i]], "utf-8") for i in range(n)]
+    mat = np.ascontiguousarray(mat, dtype=np.float32)
+    n, k = mat.shape
+    if n == 0:
+        return []
+    if len(ids) != n or len(known_lists) != n:
+        return None
+    id_offs, id_payload = _offsets_payload(ids)
+    flat_known: list[str] = []
+    row_offs = np.empty(n + 1, dtype=np.int64)
+    row_offs[0] = 0
+    for i, kl in enumerate(known_lists):
+        flat_known.extend(kl)
+        row_offs[i + 1] = len(flat_known)
+    known_offs, known_payload = _offsets_payload(flat_known)
+    all_ascii = len(id_payload) == sum(map(len, ids)) and len(known_payload) == sum(
+        map(len, flat_known)
+    )
+    max_id_len = max(1, int(np.diff(id_offs).max()) if n else 1)
+    # widest known list's worst-case bytes: 6x escape + quotes + comma each
+    if len(flat_known):
+        per_known = np.diff(known_offs) * 6 + 3
+        cs = np.concatenate([[0], np.cumsum(per_known)])
+        max_known_extra = int((cs[row_offs[1:]] - cs[row_offs[:-1]]).max())
+    else:
+        max_known_extra = 0
+    stride = int(lib.als_update_row_cap(k, max_id_len)) + max_known_extra
+    return _format_rows(
+        n, stride, all_ascii, num_threads,
+        lambda out, starts, ends, threads: lib.als_format_updates_multi(
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, k,
+            _offsets_ptr(id_offs), id_payload,
+            _offsets_ptr(row_offs),
+            _offsets_ptr(known_offs), known_payload,
+            tag.encode("ascii"),
+            stride, out, starts, ends, threads,
+        ),
+    )
 
 
 def parse_float_csv(payload: bytes, expected: int) -> np.ndarray | None:

@@ -36,6 +36,10 @@ import jax.numpy as jnp
 import numpy as np
 
 
+# cap on one tree chunk's [tc, n, S] example-stats tensor (host + device)
+_TREE_CHUNK_BUDGET_BYTES = 1 << 30
+
+
 @dataclass
 class ForestArrays:
     """Flat heap-layout forest. -1 split_feature = leaf."""
@@ -160,16 +164,51 @@ def _grow_level_impl(
     return split_feature, split_bin, jnp.where(do_split, best_gain, 0.0), node_tot, new_node_of
 
 
-_grow_level = functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))(
-    _grow_level_impl
+def _grow_level_trees_impl(
+    binned,  # [n, p] int32 (shared by every tree)
+    stats_t,  # [T, n, S] per-tree weighted stat channels
+    node_t,  # [T, n] per-tree heap index or -1
+    mask_t,  # [T, L, p] per-tree mtry masks for this level
+    level_start: int,
+    num_level_nodes: int,
+    num_bins: int,
+    impurity: str,
+    min_node_size,
+    min_info_gain,
+    is_last_level: bool,
+    axis_name: str | None = None,
+):
+    """Whole-forest level pass: lax.scan over the tree axis around the
+    single-tree level kernel, so ALL trees advance one depth in ONE
+    device dispatch (the per-(tree, level) dispatch grid — 20 trees x 11
+    levels of ~round-trip latency each — dominated wall-clock on remote
+    devices). The scan keeps peak histogram memory at one tree's
+    [p, L, B, S] tensor; the [T, n, S] stats input, [T, n] routing, and
+    [T, L] split results are resident for the whole call — train_forest
+    bounds T per call so stats stay under a fixed budget."""
+
+    def one_tree(carry, args):
+        sc, no, fm = args
+        out = _grow_level_impl(
+            binned, sc, no, fm, level_start, num_level_nodes, num_bins,
+            impurity, min_node_size, min_info_gain, is_last_level, axis_name,
+        )
+        return carry, out
+
+    _, outs = jax.lax.scan(one_tree, 0, (stats_t, node_t, mask_t))
+    return outs  # (sf [T,L], sb [T,L], gain [T,L], node_tot [T,L,S], node_of [T,n])
+
+
+_grow_level_trees = functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))(
+    _grow_level_trees_impl
 )
 
 
 @functools.lru_cache(maxsize=8)
-def _grow_level_mesh(mesh, axis_name: str):
-    """shard_map'd level pass: example rows sharded over ``axis_name``,
-    local histograms psum'd, split decisions replicated (identical on
-    every device), routing local. One cached wrapper per mesh."""
+def _grow_level_trees_mesh(mesh, axis_name: str):
+    """shard_map'd whole-forest level pass: rows sharded over ``axis_name``
+    (tree axis replicated in layout, scanned in compute), histograms
+    psum'd per tree inside the scan."""
     try:
         from jax import shard_map
     except ImportError:  # pragma: no cover - older jax
@@ -177,14 +216,15 @@ def _grow_level_mesh(mesh, axis_name: str):
     from jax.sharding import PartitionSpec as P
 
     rows = P(axis_name, None)
-    row1 = P(axis_name)
+    trows = P(None, axis_name, None)
+    trow1 = P(None, axis_name)
     repl = P()
 
-    def wrapped(binned, stats_chan, node_of, feat_mask, level_start,
+    def wrapped(binned, stats_t, node_t, mask_t, level_start,
                 num_level_nodes, num_bins, impurity, min_node_size,
                 min_info_gain, is_last_level):
         fn = functools.partial(
-            _grow_level_impl,
+            _grow_level_trees_impl,
             level_start=level_start,
             num_level_nodes=num_level_nodes,
             num_bins=num_bins,
@@ -197,12 +237,10 @@ def _grow_level_mesh(mesh, axis_name: str):
         return shard_map(
             fn,
             mesh=mesh,
-            in_specs=(rows, rows, row1, repl),
-            out_specs=(repl, repl, repl, repl, row1),
-        )(binned, stats_chan, node_of, feat_mask)
+            in_specs=(rows, trows, trow1, repl),
+            out_specs=(repl, repl, repl, repl, trow1),
+        )(binned, stats_t, node_t, mask_t)
 
-    # thresholds are fixed per training run: static keeps them out of the
-    # shard_map closure (closing over tracers is version-fragile)
     return functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))(wrapped)
 
 
@@ -268,46 +306,71 @@ def train_forest(
                 [stats_base, np.zeros((n_pad - n, stats_base.shape[1]), np.float32)]
             )
         rows_sh = NamedSharding(mesh, P(DATA_AXIS, None))
-        row1_sh = NamedSharding(mesh, P(DATA_AXIS))
-        grow = _grow_level_mesh(mesh, DATA_AXIS)
+        trows_sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        trow1_sh = NamedSharding(mesh, P(None, DATA_AXIS))
+        grow = _grow_level_trees_mesh(mesh, DATA_AXIS)
         binned_dev = jax.device_put(binned, rows_sh)
     else:
-        grow = _grow_level
-        binned_dev = jnp.asarray(binned)  # uploaded once, reused every level/tree
+        grow = _grow_level_trees
+        binned_dev = jnp.asarray(binned)  # uploaded once, reused every level
 
-    for t in range(num_trees):
-        w = gen.poisson(1.0, n).astype(np.float32) if num_trees > 1 else np.ones(n, np.float32)
-        if mesh is not None and len(w) != binned.shape[0]:
-            w = np.concatenate([w, np.zeros(binned.shape[0] - len(w), np.float32)])
-        stats_w = stats_base * w[:, None]
-        node_of = np.where(w > 0, 0, -1).astype(np.int32)
-        if mesh is not None:
-            stats_chan = jax.device_put(stats_w, rows_sh)
-            node_of_dev = jax.device_put(node_of, row1_sh)
+    n_rows = binned.shape[0]  # == n unless mesh-padded
+
+    # Trees batch into chunks whose [tc, n_rows, S] stats tensor stays
+    # under a fixed budget: the whole-forest level pass would otherwise
+    # hold num_trees full stats copies resident at once (a 10M x 100-class
+    # run is ~4 GB per tree). One chunk covers every packaged config.
+    s_chan = stats_base.shape[1]
+    budget = int(_TREE_CHUNK_BUDGET_BYTES)
+    tc = max(1, min(num_trees, budget // max(1, n_rows * s_chan * 4)))
+
+    def chunk_weights(t0: int, t1: int) -> np.ndarray:
+        # drawn per chunk (in order, so the sequence matches an up-front
+        # [num_trees, n] draw) to keep peak weight memory chunk-bounded
+        if num_trees > 1:
+            w = gen.poisson(1.0, (t1 - t0, n)).astype(np.float32)
         else:
-            stats_chan = jnp.asarray(stats_w)
-            node_of_dev = jnp.asarray(node_of)
-        # Levels dispatch asynchronously: each level's grow consumes the
-        # previous level's device-resident node assignment, so the whole
-        # tree pipeline runs without a host sync per level (a blocking
-        # round-trip per level dominated wall-clock on remote devices —
-        # 20 trees x 11 levels of ~dispatch-latency each). The
-        # grown-to-leaves early exit checks the PREVIOUS level's splits:
-        # one level may dispatch redundantly, but an all-leaf level writes
-        # the same -1/zero values the output arrays start with.
+            w = np.ones((1, n), np.float32)
+        if n_rows != n:  # pad rows arrive inactive (node_of = -1, weight 0)
+            w = np.concatenate(
+                [w, np.zeros((t1 - t0, n_rows - n), np.float32)], axis=1
+            )
+        return w
+
+    # The chunk's whole forest advances one depth per dispatch (lax.scan
+    # over trees inside the level kernel), and levels dispatch
+    # asynchronously: each level's grow consumes the previous level's
+    # device-resident routing, so a chunk trains in max_depth+1
+    # dispatches with no host sync between them — the per-(tree, level)
+    # dispatch grid of ~round-trip latency each dominated wall-clock on
+    # remote devices. The grown-to-leaves early exit checks the PREVIOUS
+    # level's splits: one level may dispatch redundantly, but an all-leaf
+    # level writes the same -1/zero values the output arrays start with.
+    for t0 in range(0, num_trees, tc):
+        t1 = min(t0 + tc, num_trees)
+        w_c = chunk_weights(t0, t1)
+        stats_c = stats_base[None, :, :] * w_c[:, :, None]  # [tc, n_rows, S]
+        node_c = np.where(w_c > 0, 0, -1).astype(np.int32)  # [tc, n_rows]
+        if mesh is not None:
+            stats_dev = jax.device_put(stats_c, trows_sh)
+            node_dev = jax.device_put(node_c, trow1_sh)
+        else:
+            stats_dev = jnp.asarray(stats_c)
+            node_dev = jnp.asarray(node_c)
         level_out = []
         prev_sf = None
         for depth in range(max_depth + 1):
             level_start = 2**depth - 1
             num_level = 2**depth
-            feat_mask = np.zeros((num_level, p), dtype=np.float32)
-            for l in range(num_level):
-                feat_mask[l, gen.choice(allowed, size=min(mtry, pa), replace=False)] = 1.0
-            sf, sb, gains, node_tot, node_of_dev = grow(
+            mask_t = np.zeros((t1 - t0, num_level, p), dtype=np.float32)
+            for t in range(t1 - t0):
+                for l in range(num_level):
+                    mask_t[t, l, gen.choice(allowed, size=min(mtry, pa), replace=False)] = 1.0
+            sf, sb, gains, node_tot, node_dev = grow(
                 binned_dev,
-                stats_chan,
-                node_of_dev,
-                jnp.asarray(feat_mask),
+                stats_dev,
+                node_dev,
+                jnp.asarray(mask_t),
                 level_start,
                 num_level,
                 num_bins,
@@ -327,12 +390,14 @@ def train_forest(
             prev_sf = sf
         for level_start, num_level, sf, sb, gains, node_tot in level_out:
             sl = slice(level_start, level_start + num_level)
-            node_tot = np.asarray(node_tot)
-            t_feat[t, sl] = np.asarray(sf)
-            t_bin[t, sl] = np.asarray(sb)
-            t_stats[t, sl] = node_tot
-            t_counts[t, sl] = node_tot[:, 0] if num_classes is None else node_tot.sum(axis=1)
-            t_gains[t, sl] = np.asarray(gains)
+            node_tot = np.asarray(node_tot)  # [tc, L, S]
+            t_feat[t0:t1, sl] = np.asarray(sf)
+            t_bin[t0:t1, sl] = np.asarray(sb)
+            t_stats[t0:t1, sl] = node_tot
+            t_counts[t0:t1, sl] = (
+                node_tot[..., 0] if num_classes is None else node_tot.sum(axis=2)
+            )
+            t_gains[t0:t1, sl] = np.asarray(gains)
     return ForestArrays(t_feat, t_bin, t_stats, t_counts, t_gains, num_classes)
 
 

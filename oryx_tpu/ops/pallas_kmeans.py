@@ -158,27 +158,48 @@ def _sweep_impl(points, centers, *, n_items, k_real, interpret):
     return sums, counts[0], cost[0, 0]
 
 
+def pad_to_block(points: np.ndarray) -> np.ndarray:
+    """Points padded with zero rows to a BLOCK_N multiple (the kernel's
+    grid granule)."""
+    n, d = points.shape
+    n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
+    if n_pad == n:
+        return points
+    return np.concatenate([points, np.zeros((n_pad - n, d), np.float32)])
+
+
 def lloyd_pallas(
-    points: np.ndarray,
+    points,
     centers0: np.ndarray,
     iterations: int,
     interpret: bool | None = None,
+    n_items: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray, float]:
     """Lloyd iterations via the fused sweep; returns (centers, counts, cost)
     with the same semantics as ops.kmeans._lloyd_run (final counts/cost
-    measured against the final centers)."""
+    measured against the final centers). ``points`` may be a device array
+    already padded to a BLOCK_N multiple (pass ``n_items`` = real row
+    count) — that lets callers start the upload before host-side init."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    points = np.asarray(points, dtype=np.float32)
-    n, d = points.shape
     k = centers0.shape[0]
-    n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
     kp = max(8, _ceil_to(k, 8))
-    if n_pad != n:
-        points = np.concatenate([points, np.zeros((n_pad - n, d), np.float32)])
+    if isinstance(points, jax.Array):
+        if n_items is None:
+            raise ValueError("n_items is required for pre-uploaded points")
+        if points.shape[0] % BLOCK_N:
+            raise ValueError("pre-uploaded points must be padded to BLOCK_N")
+        if points.dtype != jnp.float32:
+            raise ValueError("pre-uploaded points must be float32")
+        n, d = n_items, points.shape[1]
+        pts_dev = points
+    else:
+        n = np.asarray(points).shape[0]
+        points = pad_to_block(np.asarray(points, dtype=np.float32))
+        d = points.shape[1]
+        pts_dev = jnp.asarray(points)
     ctr = np.zeros((kp, d), np.float32)
     ctr[:k] = centers0
-    pts_dev = jnp.asarray(points)
     ctr_dev = jnp.asarray(ctr)
     ctr_dev, counts, cost = _lloyd_fused(
         pts_dev, ctr_dev, iterations=iterations, n_items=n, k_real=k, interpret=interpret

@@ -92,15 +92,18 @@ def _cosine_topk(mat, norms, query, k):
     return jax.lax.top_k(scores, k)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _dot_topk_batch(mat, norms, queries, k, cosine):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _dot_topk_batch(mat, norms, queries, k, cosine, download_dtype=None):
     scores = jnp.dot(
         queries, mat.T, preferred_element_type=jnp.float32, precision=_dot_precision(mat.dtype)
     )  # [b, n]
     if cosine:
         qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
         scores = scores / jnp.maximum(norms[None, :] * qn, 1e-12)
-    return jax.lax.top_k(scores, k)
+    vals, idxs = jax.lax.top_k(scores, k)
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
+    return vals, idxs
 
 
 def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
@@ -333,7 +336,8 @@ class TopNHandle:
     _idxs: jax.Array
 
     def result(self) -> tuple[np.ndarray, np.ndarray]:
-        return np.asarray(self._idxs), np.asarray(self._vals)
+        # scores may travel as bf16 (download_dtype); callers always see f32
+        return np.asarray(self._idxs), np.asarray(self._vals).astype(np.float32, copy=False)
 
 
 @dataclass
@@ -348,19 +352,35 @@ class MultiTopNHandle:
     def result(self) -> tuple[np.ndarray, np.ndarray]:
         k = self._vals.shape[-1]
         idxs = np.asarray(self._idxs).reshape(-1, k)[: self._n]
-        vals = np.asarray(self._vals).reshape(-1, k)[: self._n]
+        vals = (
+            np.asarray(self._vals)
+            .astype(np.float32, copy=False)  # bf16-on-the-wire -> f32 for callers
+            .reshape(-1, k)[: self._n]
+        )
         return idxs, vals
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _dot_topk_batch_multi(mat, norms, queries_kb, k, cosine):
+@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+def _dot_topk_batch_multi(mat, norms, queries_kb, k, cosine, download_dtype=None):
     """XLA twin of the fused multi-scan: lax.map over query groups keeps
     peak memory at one [b, n] score block instead of [K*b, n]."""
 
     def one(q):
         return _dot_topk_batch(mat, norms, q, k, cosine)
 
-    return jax.lax.map(one, queries_kb)
+    vals, idxs = jax.lax.map(one, queries_kb)
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
+    return vals, idxs
+
+
+def _auto_download_dtype(uploaded) -> object | None:
+    """Scores of a bf16 item matrix carry ~bf16 information even though
+    selection accumulates in f32 — shipping them back over a result-byte-
+    bound link as bf16 cuts the per-hit payload from 8 B to 6 B without
+    changing the on-device ranking. f32 matrices keep f32 results."""
+    mat = uploaded.mat_t if isinstance(uploaded, StreamingItemMatrix) else uploaded[0]
+    return jnp.bfloat16 if mat.dtype == jnp.bfloat16 else None
 
 
 def submit_top_k_multi(
@@ -383,15 +403,16 @@ def submit_top_k_multi(
     if groups * b != n:
         q = np.concatenate([q, np.zeros((groups * b - n, feat), np.float32)])
     q_kb = q.reshape(groups, b, feat)
+    dl = _auto_download_dtype(uploaded)
     if isinstance(uploaded, StreamingItemMatrix):
         vals, idxs = top_k_streaming_device_multi(
-            uploaded, jnp.asarray(q_kb), k, cosine=cosine
+            uploaded, jnp.asarray(q_kb), k, cosine=cosine, download_dtype=dl
         )
     else:
         mat, norms = uploaded
         kk = max(1, min(int(k), mat.shape[0]))
         vals, idxs = _dot_topk_batch_multi(
-            mat, norms, jnp.asarray(q_kb, dtype=mat.dtype), kk, cosine
+            mat, norms, jnp.asarray(q_kb, dtype=mat.dtype), kk, cosine, dl
         )
     try:
         vals.copy_to_host_async()
@@ -407,13 +428,16 @@ def submit_top_k(
     """Enqueue a batched top-k without waiting: device compute and the
     device→host copy both run asynchronously. Keeping a window of
     handles in flight pipelines transfers behind compute."""
+    dl = _auto_download_dtype(uploaded)
     if isinstance(uploaded, StreamingItemMatrix):
-        vals, idxs = top_k_streaming_device(uploaded, queries, k, cosine=cosine)
+        vals, idxs = top_k_streaming_device(
+            uploaded, queries, k, cosine=cosine, download_dtype=dl
+        )
     else:
         mat, norms = uploaded
         kk = max(1, min(int(k), mat.shape[0]))
         q = jnp.asarray(np.atleast_2d(queries), dtype=mat.dtype)
-        vals, idxs = _dot_topk_batch(mat, norms, q, kk, cosine)
+        vals, idxs = _dot_topk_batch(mat, norms, q, kk, cosine, dl)
     try:
         vals.copy_to_host_async()
         idxs.copy_to_host_async()

@@ -188,3 +188,41 @@ def test_consume_blocks_malformed_vector_raises_like_per_record():
         feed(make_manager(), msgs)
     with pytest.raises(ValueError):
         make_manager().consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))
+
+
+def test_build_updates_coalesces_duplicate_ids():
+    """Duplicate events for one id within a micro-batch publish ONE
+    message per id: the last updated event's (absolute) vector, with the
+    X message's known-items the union over the id's events. Every
+    consumer applies set-vector last-wins, so the end state is identical
+    to publishing per event; the intermediate messages carried no extra
+    information (all events fold from pre-batch state)."""
+    mgr = make_manager(implicit=True)
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    updates = list(mgr.build_updates([
+        KeyMessage(None, "U1,I2,3.0,1"),
+        KeyMessage(None, "U1,I1,-1.0,2"),  # negative pref: target 0.5, updates
+    ]))
+    by_key = {}
+    for u in updates:
+        p = json.loads(u)
+        assert (p[0], p[1]) not in by_key, f"duplicate message for {p[:2]}"
+        by_key[(p[0], p[1])] = p
+    # one X message for U1; I1 and I2 each get one Y message
+    assert set(by_key) == {("X", "U1"), ("Y", "I1"), ("Y", "I2")}
+    assert sorted(by_key[("X", "U1")][3]) == ["I1", "I2"]  # union of knowns
+    # the surviving vector is the last aggregated triple's fold-in — the
+    # micro-batch aggregator orders by (user, item), so (U1, I2) wins;
+    # any serialization of same-user triples (all folded from pre-batch
+    # state) is a valid end state
+    yty = Solver(mgr.model.y.get_vtv())
+    expect_last = compute_updated_xu(
+        yty, 3.0, np.array([1.0, 0.0], dtype=np.float32),
+        np.array([0.0, 1.0], dtype=np.float32), True)
+    np.testing.assert_allclose(by_key[("X", "U1")][2], expect_last, rtol=1e-5)

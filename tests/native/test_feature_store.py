@@ -275,3 +275,42 @@ def test_format_update_messages_many_threads_compaction():
         parsed = json.loads(msgs[j])
         assert parsed[0] == "X" and parsed[1] == f"U{j}" and parsed[3] == [f"I{j}"]
         np.testing.assert_array_equal(np.asarray(parsed[2], np.float32), mat[j])
+
+
+def test_format_update_messages_multi_known_lists():
+    import json
+
+    from oryx_tpu.native.store import format_update_messages_multi
+
+    mat = np.asarray([[0.5, -2.0], [1.0, 3.25], [7.0, 8.0]], np.float32)
+    msgs = format_update_messages_multi(
+        mat,
+        ["U1", 'we"ird\\id', "usér-Ω"],
+        [["I1", "I2", "I3"], [], ['ít"em']],
+        "X",
+    )
+    if msgs is None:  # native lib unavailable: nothing to check
+        return
+    assert json.loads(msgs[0]) == ["X", "U1", [0.5, -2.0], ["I1", "I2", "I3"]]
+    assert json.loads(msgs[1]) == ["X", 'we"ird\\id', [1.0, 3.25], []]
+    assert json.loads(msgs[2]) == ["X", "usér-Ω", [7.0, 8.0], ['ít"em']]
+
+
+def test_format_update_messages_multi_threads_compaction():
+    import json
+
+    from oryx_tpu.native.store import format_update_messages_multi
+
+    gen = np.random.default_rng(11)
+    n, k = 1000, 4
+    mat = gen.standard_normal((n, k)).astype(np.float32)
+    ids = [f"U{j}" for j in range(n)]
+    knowns = [[f"I{j}-{m}" for m in range(j % 4)] for j in range(n)]
+    msgs = format_update_messages_multi(mat, ids, knowns, "X", num_threads=7)
+    if msgs is None:
+        return
+    assert len(msgs) == n
+    for j in (0, 1, 142, 143, 501, 999):
+        parsed = json.loads(msgs[j])
+        assert parsed[0] == "X" and parsed[1] == f"U{j}" and parsed[3] == knowns[j]
+        np.testing.assert_array_equal(np.asarray(parsed[2], np.float32), mat[j])

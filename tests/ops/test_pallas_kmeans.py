@@ -47,3 +47,24 @@ def test_padding_rows_and_clusters_do_not_leak():
     assert counts.sum() == len(pts)
     assert centers.shape == (5, 3)
     assert np.isfinite(centers).all()
+
+
+def test_pre_uploaded_device_points_match_numpy_path():
+    """train_kmeans' TPU path uploads the padded points BEFORE host init
+    so the transfer overlaps; lloyd_pallas must accept that device array
+    + n_items and produce exactly the numpy-path result."""
+    import jax.numpy as jnp
+
+    from oryx_tpu.ops.pallas_kmeans import BLOCK_N, _ceil_to
+
+    pts, init = _blobs(n_per=137, k=4, d=3, seed=21)
+    ref = lloyd_pallas(pts, init[:4], iterations=3, interpret=True)
+    n = len(pts)
+    n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
+    padded = np.concatenate([pts, np.zeros((n_pad - n, 3), np.float32)])
+    dev = lloyd_pallas(
+        jnp.asarray(padded), init[:4], iterations=3, interpret=True, n_items=n
+    )
+    np.testing.assert_allclose(dev[0], ref[0], rtol=1e-6)
+    np.testing.assert_array_equal(dev[1], ref[1])
+    np.testing.assert_allclose(dev[2], ref[2], rtol=1e-6)
