@@ -41,9 +41,13 @@ class AbstractLayer:
         # multi-host: join the JAX multi-controller runtime before any
         # backend is touched, so jax.devices() spans the whole pod slice
         # (no-op unless oryx.batch.compute.distributed.* is configured)
-        from oryx_tpu.parallel.distributed import maybe_initialize
+        from oryx_tpu.parallel.distributed import (
+            maybe_enable_compile_cache,
+            maybe_initialize,
+        )
 
         maybe_initialize(config)
+        maybe_enable_compile_cache(config)
 
     # -- topics -------------------------------------------------------------
 

@@ -257,6 +257,11 @@ def format_vectors_json(mat: np.ndarray) -> list[str]:
     return [s[off[i] : off[i + 1]] for i in range(n)]
 
 
+# cap on one native-formatter call's output buffer (n rows x uniform
+# worst-case stride); larger requests are sliced into bounded calls
+_MULTI_BUFFER_BUDGET = 256 * 1024 * 1024
+
+
 def _format_rows(
     n: int,
     stride: int,
@@ -371,10 +376,35 @@ def format_update_messages_multi(
     if len(flat_known):
         per_known = np.diff(known_offs) * 6 + 3
         cs = np.concatenate([[0], np.cumsum(per_known)])
-        max_known_extra = int((cs[row_offs[1:]] - cs[row_offs[:-1]]).max())
+        row_extra = cs[row_offs[1:]] - cs[row_offs[:-1]]
+        max_known_extra = int(row_extra.max())
     else:
+        row_extra = np.zeros(n, dtype=np.int64)
         max_known_extra = 0
-    stride = int(lib.als_update_row_cap(k, max_id_len)) + max_known_extra
+    base_cap = int(lib.als_update_row_cap(k, max_id_len))
+    stride = base_cap + max_known_extra
+    if n > 1 and n * stride > _MULTI_BUFFER_BUDGET:
+        # the stride is uniform (each thread region is stride-spaced), so
+        # one id with a huge known union would inflate the buffer for
+        # every row; slice rows so each call's n * stride stays bounded
+        # (a pathological row lands in a small slice of its own)
+        out_all: list[str] = []
+        lo = 0
+        while lo < n:
+            hi, worst = lo + 1, int(row_extra[lo])
+            while hi < n:
+                w = max(worst, int(row_extra[hi]))
+                if (hi - lo + 1) * (base_cap + w) > _MULTI_BUFFER_BUDGET:
+                    break
+                worst, hi = w, hi + 1
+            part = format_update_messages_multi(
+                mat[lo:hi], ids[lo:hi], known_lists[lo:hi], tag, num_threads
+            )
+            if part is None:  # pragma: no cover - lib vanished mid-call
+                return None
+            out_all.extend(part)
+            lo = hi
+        return out_all
     return _format_rows(
         n, stride, all_ascii, num_threads,
         lambda out, starts, ends, threads: lib.als_format_updates_multi(

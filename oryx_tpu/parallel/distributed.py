@@ -27,6 +27,32 @@ import os
 log = logging.getLogger(__name__)
 
 _initialized = False
+_cache_enabled = False
+
+
+def maybe_enable_compile_cache(config) -> None:
+    """Point XLA's persistent compilation cache at
+    ``oryx.compute.compile-cache-dir`` (no-op when null). Layers call this
+    before touching a backend, so a restarted process — or generation N+1
+    after a redeploy — reloads the programs generation N compiled instead
+    of paying tens of seconds of recompiles per bucketed shape. (Spark
+    has no analogue; JVM JIT state dies with the process. Here compiled
+    XLA executables are a pure function of HLO + backend, so they cache
+    like any artifact.)"""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    d = config.get("oryx.compute.compile-cache-dir", None)
+    if not d:
+        return
+    import jax
+
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(d))
+    # bucketed training shapes compile in ~1-40s each; cache all of them
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _cache_enabled = True
+    log.info("persistent XLA compilation cache at %s", d)
 
 
 def maybe_initialize(config) -> bool:

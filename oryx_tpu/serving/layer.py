@@ -183,6 +183,9 @@ def _model_ready(ctx: ServingContext) -> bool:
 class ServingLayer:
     def __init__(self, config: Config) -> None:
         self.config = config
+        from oryx_tpu.parallel.distributed import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache(config)  # device scans cache like training
         self.port = config.get_int("oryx.serving.api.port")
         self.context_path = config.get_string("oryx.serving.api.context-path").rstrip("/")
         self.read_only = config.get_bool("oryx.serving.api.read-only")

@@ -314,3 +314,30 @@ def test_format_update_messages_multi_threads_compaction():
         parsed = json.loads(msgs[j])
         assert parsed[0] == "X" and parsed[1] == f"U{j}" and parsed[3] == knowns[j]
         np.testing.assert_array_equal(np.asarray(parsed[2], np.float32), mat[j])
+
+
+def test_format_update_messages_multi_sliced_buffer():
+    """A huge known union on one row must not inflate the output buffer
+    for every row: past the buffer budget the formatter slices rows into
+    bounded calls (identical output)."""
+    import json
+
+    from oryx_tpu.native import store
+
+    gen = np.random.default_rng(3)
+    n, k = 200, 4
+    mat = gen.standard_normal((n, k)).astype(np.float32)
+    ids = [f"U{j}" for j in range(n)]
+    knowns = [[f"I{j}-{m}" for m in range(j % 30)] for j in range(n)]
+    whole = store.format_update_messages_multi(mat, ids, knowns, "X")
+    if whole is None:  # native lib unavailable
+        return
+    prev = store._MULTI_BUFFER_BUDGET
+    store._MULTI_BUFFER_BUDGET = 4096  # force slicing
+    try:
+        sliced = store.format_update_messages_multi(mat, ids, knowns, "X")
+    finally:
+        store._MULTI_BUFFER_BUDGET = prev
+    assert sliced == whole
+    p = json.loads(sliced[199])
+    assert p[1] == "U199" and p[3] == knowns[199]

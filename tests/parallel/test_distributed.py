@@ -81,3 +81,38 @@ def test_two_process_distributed_runtime(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out}"
         assert f"DIST_OK {pid}" in out
+
+
+def test_compile_cache_config_plumbing(tmp_path):
+    """oryx.compute.compile-cache-dir points XLA's persistent compilation
+    cache at the configured directory (and is a no-op when null)."""
+    import jax
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.parallel import distributed
+
+    prev_enabled = distributed._cache_enabled
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        # null default: nothing happens
+        distributed._cache_enabled = False
+        distributed.maybe_enable_compile_cache(C.get_default())
+        assert not distributed._cache_enabled
+
+        d = tmp_path / "xla-cache"
+        cfg = C.get_default().with_overlay(
+            f'oryx.compute.compile-cache-dir = "{d}"'
+        )
+        distributed.maybe_enable_compile_cache(cfg)
+        assert distributed._cache_enabled
+        assert jax.config.jax_compilation_cache_dir == str(d)
+        assert d.is_dir()
+        # idempotent: a second call (other layer in-process) is a no-op
+        distributed.maybe_enable_compile_cache(cfg)
+    finally:
+        # jax config is process-global: restore so later tests don't
+        # silently write a persistent cache under this tmp_path
+        distributed._cache_enabled = prev_enabled
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
