@@ -173,3 +173,148 @@ def compute_updated_xu(
     if xu is None:
         return d_xu
     return np.asarray(xu, dtype=np.float32) + d_xu
+
+
+# ---------------------------------------------------------------------------
+# Columnar UP-message consumption (shared by the speed and serving managers)
+# ---------------------------------------------------------------------------
+
+
+def consume_blocks_columnar(block_iterator, model_ready, apply_up_batch, consume):
+    """Columnar consume loop: contiguous runs of "UP" records hand off to
+    ``apply_up_batch`` as raw byte lines; everything else — MODEL/
+    MODEL-REF, blocks with no key column, records before a model exists —
+    falls back to the per-record ``consume`` in order."""
+    from oryx_tpu.bus.core import KeyMessage
+
+    for block in block_iterator:
+        if not model_ready() or block.keys is None:
+            consume(block.iter_key_messages())
+            continue
+        keys = block.keys.tolist()
+        msgs = block.messages.tolist()
+        n = len(msgs)
+        i = 0
+        while i < n:
+            if keys[i] == b"UP":
+                j = i
+                while j < n and keys[j] == b"UP":
+                    j += 1
+                apply_up_batch(msgs[i:j])
+                i = j
+            else:
+                consume(iter([KeyMessage(
+                    keys[i].decode("utf-8", "replace"),
+                    msgs[i].decode("utf-8", "replace"),
+                )]))
+                i += 1
+
+
+def apply_up_lines(
+    lines: list,
+    k: int,
+    set_x: Callable,
+    set_y: Callable,
+    slow_consume: Callable,
+    on_known: Callable | None = None,
+    strict_tail: bool = False,
+) -> int:
+    """Batched fast path for a run of raw "UP" byte lines.
+
+    Groups ``["X","id",[floats]...`` / ``["Y",...`` lines, parses every
+    float component in one native pass (numpy twin as backstop), and
+    applies each group via one batched setter call. Records the fast
+    parser can't take — escaped ids, malformed lines, (with
+    ``strict_tail``) unrecognized trailing elements — are handed to
+    ``slow_consume`` ONE AT A TIME, and pending groups flush first: a
+    later fast update for the same id must not be overwritten by
+    replaying this older record after it.
+
+    ``on_known(pairs)`` receives the X-side (id, known-ids-list) pairs of
+    each flushed group when given; it implies strict tail validation for
+    X records (the known list is part of the wire contract there).
+    Returns rows applied via the fast path (slow-path records are the
+    caller's consume's to count)."""
+    from oryx_tpu.bus.core import KeyMessage
+    from oryx_tpu.native.store import parse_float_csv
+
+    parse_known = on_known is not None
+    strict = strict_tail or parse_known
+
+    def fresh():
+        return {
+            b'["X","': ([], [], [], [], set_x),
+            b'["Y","': ([], [], [], [], set_y),
+        }
+
+    groups = fresh()
+    applied = 0
+
+    def flush() -> None:
+        nonlocal groups, applied
+        for which, (ids, vecs, origs, knowns, setter) in groups.items():
+            if not ids:
+                continue
+            payload = b",".join(vecs)
+            flat = parse_float_csv(payload, len(ids) * k)  # native strtof
+            if flat is None:  # library absent / mismatch: numpy twin
+                parts = payload.split(b",")
+                if len(parts) == len(ids) * k:
+                    try:
+                        flat = np.array(parts, dtype="S").astype(np.float32)
+                    except ValueError:
+                        flat = None
+            if flat is None:
+                # oddball numerics: whole group per-record, in order
+                for ln in origs:
+                    slow_consume(KeyMessage("UP", ln.decode("utf-8", "replace")))
+                continue
+            setter(ids, flat.reshape(len(ids), k))
+            applied += len(ids)
+            if which == b'["X","' and parse_known:
+                on_known([(u, kn) for u, kn in zip(ids, knowns) if kn])
+        groups = fresh()
+
+    for ln in lines:
+        slow = False
+        group = groups.get(ln[:6])
+        known: list[str] | None = None
+        at = end = -1
+        # escaped ids defeat the byte-slicing parse. With a strict tail the
+        # known list is parsed too, so a backslash ANYWHERE disqualifies;
+        # otherwise the tail is ignored and only the id region matters
+        # (known ids with JSON escapes must not collapse the fast path).
+        if group is None or (strict and b"\\" in ln):
+            slow = True
+        else:
+            at = ln.find(b'",[', 6)
+            end = ln.find(b"]", at + 3) if at != -1 else -1
+            if at == -1 or end == -1 or b"\\" in ln[:at]:
+                slow = True
+            elif strict:
+                tail = ln[end + 1 :]
+                if tail != b"]":
+                    # optional known-ids list: ,["i1","i2"]] (X only)
+                    if not (tail.startswith(b',[') and tail.endswith(b"]]")):
+                        slow = True
+                    else:
+                        inner = tail[2:-2]
+                        if inner == b"":
+                            known = []
+                        elif inner.startswith(b'"') and inner.endswith(b'"'):
+                            known = [
+                                s.decode("utf-8", "replace")
+                                for s in inner[1:-1].split(b'","')
+                            ]
+                        else:
+                            slow = True
+        if slow:
+            flush()
+            slow_consume(KeyMessage("UP", ln.decode("utf-8", "replace")))
+            continue
+        group[0].append(ln[6:at].decode("utf-8", "replace"))
+        group[1].append(ln[at + 3 : end])
+        group[2].append(ln)
+        group[3].append(known)
+    flush()
+    return applied

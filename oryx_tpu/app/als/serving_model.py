@@ -29,6 +29,7 @@ import numpy as np
 
 from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
 from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.als.common import apply_up_lines, consume_blocks_columnar
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import ReadWriteLock
@@ -419,112 +420,28 @@ class ALSServingModelManager(AbstractServingModelManager):
         UP per row — a million-record startup replay). X rows carrying
         known-item lists parse those too; anything escaped or unusual
         falls back to per-record consume in order."""
-        for block in block_iterator:
-            if self.model is None or block.keys is None:
-                self.consume(block.iter_key_messages())
-                continue
-            keys = block.keys.tolist()
-            msgs = block.messages.tolist()
-            n = len(msgs)
-            i = 0
-            while i < n:
-                if keys[i] == b"UP":
-                    j = i
-                    while j < n and keys[j] == b"UP":
-                        j += 1
-                    self._apply_up_batch(msgs[i:j])
-                    i = j
-                else:
-                    self.consume(iter([KeyMessage(
-                        keys[i].decode("utf-8", "replace"),
-                        msgs[i].decode("utf-8", "replace"),
-                    )]))
-                    i += 1
+        consume_blocks_columnar(
+            block_iterator,
+            lambda: self.model is not None,
+            self._apply_up_batch,
+            self.consume,
+        )
 
     def _apply_up_batch(self, lines: list[bytes]) -> None:
-        from oryx_tpu.native.store import parse_float_csv
-
         model = self.model
-        k = model.features
-
-        def fresh():
-            return {
-                b'["X","': ([], [], [], [], model.set_user_vectors),
-                b'["Y","': ([], [], [], [], model.set_item_vectors),
-            }
-
-        groups = fresh()
-        applied = 0
-
-        def flush() -> None:
-            nonlocal groups, applied
-            for which, (ids, vecs, origs, knowns, setter) in groups.items():
-                if not ids:
-                    continue
-                payload = b",".join(vecs)
-                flat = parse_float_csv(payload, len(ids) * k)
-                if flat is None:
-                    parts = payload.split(b",")
-                    if len(parts) == len(ids) * k:
-                        try:
-                            flat = np.array(parts, dtype="S").astype(np.float32)
-                        except ValueError:
-                            flat = None
-                if flat is None:
-                    # oddball numerics: whole group per-record, in order
-                    self.consume(
-                        KeyMessage("UP", ln.decode("utf-8", "replace"))
-                        for ln in origs
-                    )
-                    continue
-                setter(ids, flat.reshape(len(ids), k))
-                applied += len(ids)
-                if which == b'["X","' and not self.no_known_items:
-                    model.add_known_items_many(
-                        (u, kn) for u, kn in zip(ids, knowns) if kn
-                    )
-            groups = fresh()
-
-        for ln in lines:
-            slow = False
-            group = groups.get(ln[:6])
-            known: list[str] | None = None
-            at = end = -1
-            if group is None or b"\\" in ln:
-                slow = True
-            else:
-                at = ln.find(b'",[', 6)
-                end = ln.find(b"]", at + 3) if at != -1 else -1
-                if at == -1 or end == -1:
-                    slow = True
-                else:
-                    tail = ln[end + 1 :]
-                    if tail != b"]":
-                        # optional known-ids list: ,["i1","i2"]] (X only)
-                        if not (tail.startswith(b',[') and tail.endswith(b"]]")):
-                            slow = True
-                        else:
-                            inner = tail[2:-2]
-                            if inner == b"":
-                                known = []
-                            elif inner.startswith(b'"') and inner.endswith(b'"'):
-                                known = [
-                                    s.decode("utf-8", "replace")
-                                    for s in inner[1:-1].split(b'","')
-                                ]
-                            else:
-                                slow = True
-            if slow:
-                # flush first: a later fast update for the same id must
-                # not be overwritten by replaying this older record after it
-                flush()
-                self.consume(iter([KeyMessage("UP", ln.decode("utf-8", "replace"))]))
-                continue
-            group[0].append(ln[6:at].decode("utf-8", "replace"))
-            group[1].append(ln[at + 3 : end])
-            group[2].append(ln)
-            group[3].append(known)
-        flush()
+        applied = apply_up_lines(
+            lines,
+            model.features,
+            model.set_user_vectors,
+            model.set_item_vectors,
+            lambda km: self.consume(iter([km])),
+            on_known=(
+                None
+                if self.no_known_items
+                else lambda pairs: model.add_known_items_many(pairs)
+            ),
+            strict_tail=True,  # the known list is part of the wire contract
+        )
         self._consumed += applied  # slow path self-counts
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:

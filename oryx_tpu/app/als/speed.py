@@ -21,6 +21,7 @@ import numpy as np
 from oryx_tpu.api.speed import SpeedModel, SpeedModelManager
 from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als import data as als_data
+from oryx_tpu.app.als.common import apply_up_lines, consume_blocks_columnar
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.records import Records
@@ -31,7 +32,6 @@ from oryx_tpu.native.store import (
     format_update_messages_multi,
     format_vectors_json,
     make_feature_vectors,
-    parse_float_csv,
 )
 
 log = logging.getLogger(__name__)
@@ -125,82 +125,26 @@ class ALSSpeedModelManager(SpeedModelManager):
 
     def consume_blocks(self, block_iterator) -> None:
         """Columnar consume: contiguous runs of "UP" records parse as one
-        vectorized batch (ids sliced with bytes ops, all float components
-        converted in a single numpy astype) and apply via the batched
-        setters. Everything else — MODEL/MODEL-REF, escaped ids, malformed
-        lines — falls back to the per-record consume in order."""
-        for block in block_iterator:
-            if self.model is None or block.keys is None:
-                self.consume(block.iter_key_messages())
-                continue
-            keys = block.keys.tolist()
-            msgs = block.messages.tolist()
-            n = len(msgs)
-            i = 0
-            while i < n:
-                if keys[i] == b"UP":
-                    j = i
-                    while j < n and keys[j] == b"UP":
-                        j += 1
-                    self._apply_up_batch(msgs[i:j])
-                    i = j
-                else:
-                    self.consume(iter([KeyMessage(
-                        keys[i].decode("utf-8", "replace"),
-                        msgs[i].decode("utf-8", "replace"),
-                    )]))
-                    i += 1
+        vectorized batch (the shared ``apply_up_lines`` fast path) and
+        apply via the batched setters. Everything else — MODEL/MODEL-REF,
+        escaped ids, malformed lines — falls back to the per-record
+        consume in order."""
+        consume_blocks_columnar(
+            block_iterator,
+            lambda: self.model is not None,
+            self._apply_up_batch,
+            self.consume,
+        )
 
     def _apply_up_batch(self, lines: list[bytes]) -> None:
         model = self.model
-        k = model.features
-
-        def fresh():
-            return {
-                b'["X","': ([], [], [], model.set_user_vectors),
-                b'["Y","': ([], [], [], model.set_item_vectors),
-            }
-
-        groups = fresh()
-
-        def flush() -> None:
-            nonlocal groups
-            for ids, vecs, origs, setter in groups.values():
-                if not ids:
-                    continue
-                payload = b",".join(vecs)
-                flat = parse_float_csv(payload, len(ids) * k)  # native strtof
-                if flat is None:  # library absent / mismatch: numpy twin
-                    parts = payload.split(b",")
-                    if len(parts) == len(ids) * k:
-                        try:
-                            flat = np.array(parts, dtype="S").astype(np.float32)
-                        except ValueError:
-                            flat = None
-                if flat is None:
-                    # oddball numerics: whole group per-record, in order
-                    self.consume(
-                        KeyMessage("UP", ln.decode("utf-8", "replace"))
-                        for ln in origs
-                    )
-                else:
-                    setter(ids, flat.reshape(len(ids), k))
-            groups = fresh()
-
-        for ln in lines:
-            group = groups.get(ln[:6])
-            at = ln.find(b'",[', 6) if group is not None else -1
-            end = ln.find(b"]", at + 3) if at != -1 else -1
-            if group is None or at == -1 or end == -1 or b"\\" in ln[:at]:
-                # flush first: a later fast update for the same id must not
-                # be overwritten by replaying this older record after it
-                flush()
-                self.consume(iter([KeyMessage("UP", ln.decode("utf-8", "replace"))]))
-                continue
-            group[0].append(ln[6:at].decode("utf-8", "replace"))
-            group[1].append(ln[at + 3 : end])
-            group[2].append(ln)
-        flush()
+        apply_up_lines(
+            lines,
+            model.features,
+            model.set_user_vectors,
+            model.set_item_vectors,
+            lambda km: self.consume(iter([km])),
+        )
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         for km in update_iterator:

@@ -226,3 +226,29 @@ def test_build_updates_coalesces_duplicate_ids():
         yty, 3.0, np.array([1.0, 0.0], dtype=np.float32),
         np.array([0.0, 1.0], dtype=np.float32), True)
     np.testing.assert_allclose(by_key[("X", "U1")][2], expect_last, rtol=1e-5)
+
+
+def test_apply_up_lines_escape_routing():
+    """Fast-path routing of escaped ids: without strict_tail (speed
+    semantics, tail ignored) only an escape in the ID region disqualifies
+    a line; with strict_tail (serving semantics, known list parsed) any
+    escape does."""
+    from oryx_tpu.app.als.common import apply_up_lines
+
+    applied = {}
+
+    def set_x(ids, m):
+        applied.update(zip(ids, [tuple(r) for r in m]))
+
+    lines = [
+        b'["X","U1",[1.0,2.0],["I\\"1","I2"]]',  # escape in tail only
+        b'["X","we\\"ird",[3.0,4.0],["I3"]]',    # escape in id region
+    ]
+    slow = []
+    n = apply_up_lines(lines, 2, set_x, lambda i, m: None, slow.append)
+    assert n == 1 and "U1" in applied
+    assert len(slow) == 1 and "we" in slow[0].message
+    slow2 = []
+    n2 = apply_up_lines(lines, 2, set_x, lambda i, m: None, slow2.append,
+                        strict_tail=True)
+    assert n2 == 0 and len(slow2) == 2
