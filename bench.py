@@ -114,31 +114,41 @@ def bench_serving(features_override: int | None = None, baseline_qps: float | No
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     uploaded = topn_ops.upload(y, dtype=dtype)
     scans_per_dispatch = (group + scan_batch - 1) // scan_batch
+    # "index": user-factor matrix staged on device once, each dispatch
+    # ships int32 row indices (4 B/query up) — the serving layout where X
+    # lives next to Y. "vector": full query vectors up per dispatch.
+    submit_mode = os.environ.get("ORYX_BENCH_SUBMIT", "index")
+    x_dev = topn_ops.upload_queries(x) if submit_mode == "index" else None
+    idx_all = np.arange(users, dtype=np.int32)
+
+    def submit(lo: int, hi: int):
+        if submit_mode == "index":
+            return topn_ops.submit_top_k_multi_indexed(
+                uploaded, x_dev, idx_all[lo:hi], how_many, scan_batch=scan_batch
+            )
+        return topn_ops.submit_top_k_multi(
+            uploaded, x[lo:hi], how_many, scan_batch=scan_batch
+        )
+
     t0 = time.perf_counter()
-    topn_ops.submit_top_k_multi(uploaded, x[:group], how_many, scan_batch=scan_batch).result()
+    submit(0, group).result()
     print(f"bench[serving]: warmup/compile {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     served = 0
     inflight: deque = deque()
     latencies: list[float] = []
-    num_groups = max(1, users // group)
+    # real row spans: the last (or only) group may be short of `group`
+    bounds = [
+        (lo, min(lo + group, users)) for lo in range(0, max(users, 1), group)
+    ]
     start = time.perf_counter()
     deadline = start + seconds
     i = 0
     while True:
         now = time.perf_counter()
         if now < deadline and len(inflight) < depth:
-            qi = i % num_groups
-            queries = x[qi * group : qi * group + group]
-            inflight.append(
-                (
-                    topn_ops.submit_top_k_multi(
-                        uploaded, queries, how_many, scan_batch=scan_batch
-                    ),
-                    len(queries),
-                    time.perf_counter(),
-                )
-            )
+            lo, hi = bounds[i % len(bounds)]
+            inflight.append((submit(lo, hi), hi - lo, time.perf_counter()))
             i += 1
         elif inflight:
             handle, rows, t_submit = inflight.popleft()
@@ -167,7 +177,7 @@ def bench_serving(features_override: int | None = None, baseline_qps: float | No
     _emit(
         f"ALS recommend top-{how_many} exact scan ({features} feat x {items} "
         f"items, {dtype_name}, {scans_per_dispatch} fused scans x {scan_batch} "
-        f"queries x depth {depth}, ~{gbps:.0f} GB/s effective, "
+        f"queries x depth {depth}, {submit_mode}-submit, ~{gbps:.0f} GB/s effective, "
         f"p50 {lat[0]:.0f}ms/p99 {lat[1]:.0f}ms{tag}) "
         f"vs published {base:.0f} qps (LSH 0.3, 32-core Xeon)",
         qps,

@@ -365,6 +365,57 @@ def top_k_streaming_device_multi(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "n_items", "cosine", "interpret", "download_dtype"),
+)
+def _streaming_topk_multi_indexed(
+    mat_t, norms, x_dev, idx_kb, *, k, n_items, cosine, interpret, download_dtype=None
+):
+    """Index-submitted fused multi-scan: gather the [K, b, feat] query
+    group from the device-resident ``x_dev`` inside the dispatch, then
+    run the same per-group pallas scan."""
+
+    def one(idx_b):
+        q = x_dev[idx_b].astype(jnp.float32)
+        return _streaming_topk_impl(
+            mat_t, norms, q, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+        )
+
+    vals, idxs = jax.lax.map(one, idx_kb)
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
+    return vals, idxs
+
+
+def top_k_streaming_device_multi_indexed(
+    up: StreamingItemMatrix,
+    x_dev: jax.Array,
+    idx_kb: jax.Array,
+    k: int,
+    cosine: bool = False,
+    interpret: bool | None = None,
+    download_dtype=None,
+) -> tuple[jax.Array, jax.Array]:
+    """(scores [K, b, k], indices [K, b, k]) for [K, b] int32 row indices
+    into the device-resident query matrix ``x_dev`` — the uplink carries
+    4 B/query instead of a full vector."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = max(1, min(int(k), up.n_items))
+    return _streaming_topk_multi_indexed(
+        up.mat_t,
+        up.norms,
+        x_dev,
+        idx_kb,
+        k=k,
+        n_items=up.n_items,
+        cosine=cosine,
+        interpret=interpret,
+        download_dtype=download_dtype,
+    )
+
+
 def top_k_streaming(
     up: StreamingItemMatrix,
     queries: np.ndarray,

@@ -136,3 +136,31 @@ def test_sharded_topk_keeps_zero_vector_items():
     np.testing.assert_array_equal(si, ri)
     assert si[0, 0] == 3 and sv[0, 0] == 0.0
     assert np.isfinite(sv).all()
+
+
+def test_indexed_submit_matches_vector_submit():
+    """submit_top_k_multi_indexed (int32 indices up, device-side gather)
+    must return exactly the vector-submitted results for both the XLA and
+    streaming handles, f32 and bf16."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oryx_tpu.ops import topn as topn_ops
+
+    gen = np.random.default_rng(5)
+    mat = gen.standard_normal((3000, 8)).astype(np.float32)
+    x = gen.standard_normal((200, 8)).astype(np.float32)
+    idx = gen.integers(0, 200, 70).astype(np.int32)
+    x_dev = topn_ops.upload_queries(x)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        up = topn_ops.upload(mat, dtype=dtype, streaming=False)
+        i1, v1 = topn_ops.submit_top_k_multi_indexed(up, x_dev, idx, 7, scan_batch=32).result()
+        i2, v2 = topn_ops.submit_top_k_multi(up, x[idx], 7, scan_batch=32).result()
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5)
+    ups = topn_ops.upload_streaming(mat, dtype=jnp.bfloat16)
+    i3, v3 = topn_ops.submit_top_k_multi_indexed(ups, x_dev, idx, 7, scan_batch=32).result()
+    i4, v4 = topn_ops.submit_top_k_multi(ups, x[idx], 7, scan_batch=32).result()
+    np.testing.assert_array_equal(i3, i4)
+    np.testing.assert_allclose(v3, v4, rtol=1e-2)
+    assert v1.dtype == np.float32 and v3.dtype == np.float32

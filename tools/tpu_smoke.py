@@ -83,6 +83,16 @@ def main() -> None:
     np.testing.assert_allclose(mv, pv, rtol=1e-5, atol=1e-4)
     lines.append(f"pallas_topn[multi]: OK ({batch // 32 or 1}+ fused scans == single)")
 
+    # 1b'. index-submitted fused multi-scan (4 B/query uplink) == vector submit
+    x_dev = topn_ops.upload_queries(q)
+    idx = np.arange(batch, dtype=np.int32)
+    ii, iv = topn_ops.submit_top_k_multi_indexed(
+        handle, x_dev, idx, k, scan_batch=32
+    ).result()
+    np.testing.assert_array_equal(ii, mi)
+    np.testing.assert_allclose(iv, mv, rtol=1e-5, atol=1e-4)
+    lines.append("pallas_topn[indexed]: OK (int32 index submit == vector submit)")
+
     # 1c. incremental scatter update: dirty rows re-ship, ranking follows
     y2 = y.copy()
     y2[123] = np.abs(y2[123]) * 50.0  # make row 123 dominate
