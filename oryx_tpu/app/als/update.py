@@ -119,6 +119,7 @@ class ALSUpdate(MLUpdate):
             mesh=mesh,
             shard_factors=mesh is not None
             and bool(self._config.get("oryx.batch.compute.shard-factors", False)),
+            matmul_dtype=self._config.get("oryx.batch.compute.matmul-dtype", None),
         )
         _save_features(candidate_path / "X", rm.user_ids, model.x)
         _save_features(candidate_path / "Y", rm.item_ids, model.y)

@@ -190,22 +190,33 @@ def build_neighbor_buckets(
     return buckets
 
 
-def _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k):
+def _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, matmul_dtype=None):
     """A [C,k,k], b [C,k] of the per-row normal equations given the
-    gathered neighbor workspace v [C,D,k] (zeros at masked slots)."""
+    gathered neighbor workspace v [C,D,k] (zeros at masked slots).
+
+    ``matmul_dtype=bfloat16`` runs the Gramian-building einsums with bf16
+    operands and f32 accumulation (halved HBM traffic, full-rate MXU);
+    the k x k systems and their solves stay f32. Per-row confidence
+    weights fold into one operand in f32 BEFORE the cast so the bf16
+    rounding applies once per factor entry, not per product term."""
+    md = matmul_dtype or jnp.float32
     eye = jnp.eye(k, dtype=jnp.float32)
+    pet = dict(preferred_element_type=jnp.float32)
     if implicit:
         conf_m1 = alpha * jnp.abs(cval) * cmask  # c - 1
-        a = yty[None] + jnp.einsum("cdk,cd,cdl->ckl", v, conf_m1, v) + lam * eye[None]
+        vw = (v * conf_m1[..., None]).astype(md)
+        a = yty[None] + jnp.einsum("cdk,cdl->ckl", vw, v.astype(md), **pet) + lam * eye[None]
         p = (cval > 0).astype(jnp.float32) * cmask
-        b = jnp.einsum("cdk,cd->ck", v, (1.0 + alpha * jnp.abs(cval)) * p)
+        bw = ((1.0 + alpha * jnp.abs(cval)) * p).astype(md)
+        b = jnp.einsum("cdk,cd->ck", v.astype(md), bw, **pet)
     else:
         n_u = cmask.sum(axis=1)  # ratings per row (ALS-WR lambda scaling)
+        vm = v.astype(md)
         a = (
-            jnp.einsum("cdk,cdl->ckl", v, v)
+            jnp.einsum("cdk,cdl->ckl", vm, vm, **pet)
             + (lam * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
         )
-        b = jnp.einsum("cdk,cd->ck", v, cval * cmask)
+        b = jnp.einsum("cdk,cd->ck", vm, (cval * cmask).astype(md), **pet)
     return a, b
 
 
@@ -216,18 +227,24 @@ def _sweep_buckets(
     lam: float,
     alpha: float,
     implicit: bool,
+    matmul_dtype=None,
 ) -> jnp.ndarray:
     """One half-sweep in replicated-factor mode: solve every bucket and
     scatter results into a fresh [out_shape, k] factor matrix. Rows in no
     bucket (degree 0) stay zero; pad slots (row -1) scatter to the last
     (sacrificial) row, which callers slice off."""
     k = other.shape[1]
-    yty = other.T @ other if implicit else None
+    md = matmul_dtype or jnp.float32
+    yty = (
+        jnp.dot(other.astype(md).T, other.astype(md), preferred_element_type=jnp.float32)
+        if implicit
+        else None
+    )
 
     def solve_chunk(args):
         cidx, cval, cmask = args
         v = other[cidx] * cmask[..., None]  # [C, D, k]
-        a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k)
+        a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, md)
         return jnp.linalg.solve(a, b[..., None])[..., 0]
 
     out = jnp.zeros((out_shape, k), dtype=jnp.float32)
@@ -274,6 +291,7 @@ def train_als(
     seed: int | None = None,
     workspace_elems: int = 1 << 27,
     shard_factors: bool = False,
+    matmul_dtype: str | None = None,
 ) -> ALSModel:
     """Full ALS training run.
 
@@ -283,9 +301,19 @@ def train_als(
     factorizations larger than one device's HBM fit the slice (ring-
     exchange half-sweeps; see module docstring). ``workspace_elems``
     bounds the per-chunk gather workspace (elements, not bytes).
+    ``matmul_dtype="bfloat16"`` (oryx.batch.compute.matmul-dtype) runs
+    the Gramian-building matmuls with bf16 operands and f32 accumulation
+    — halved HBM traffic and full-rate MXU on TPU; solves stay f32.
     """
     from oryx_tpu.common import rng as rng_mod
 
+    if matmul_dtype not in (None, "float32", "bfloat16"):
+        # a typo'd dtype silently training full-f32 would corrupt capacity
+        # planning; fail at startup like the serving score-dtype check
+        raise ValueError(
+            f"matmul_dtype must be float32 or bfloat16, got {matmul_dtype!r}"
+        )
+    md = jnp.bfloat16 if matmul_dtype == "bfloat16" else None
     seed_val = rng_mod.next_seed() if seed is None else seed
     if shard_factors:
         if mesh is None:
@@ -293,6 +321,7 @@ def train_als(
         return _train_als_sharded(
             user_idx, item_idx, values, num_users, num_items, features,
             lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
+            md,
         )
 
     num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
@@ -325,8 +354,8 @@ def train_als(
 
         def body(_, carry):
             x_, y_ = carry
-            x_ = _sweep_buckets(y_, num_users + 1, u_args, lam, alpha, implicit)
-            y_ = _sweep_buckets(x_, num_items + 1, i_args, lam, alpha, implicit)
+            x_ = _sweep_buckets(y_, num_users + 1, u_args, lam, alpha, implicit, md)
+            y_ = _sweep_buckets(x_, num_items + 1, i_args, lam, alpha, implicit, md)
             return x_, y_
 
         return jax.lax.fori_loop(0, iterations, body, (x, y))
@@ -408,6 +437,7 @@ def _translate_to_shards(idx: np.ndarray, pos_other: np.ndarray, other_loc: int)
 def _train_als_sharded(
     user_idx, item_idx, values, num_users, num_items, features,
     lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
+    matmul_dtype=None,
 ) -> ALSModel:
     """shard_map ALS with factors sharded over the mesh (see module doc)."""
     try:
@@ -477,7 +507,19 @@ def _train_als_sharded(
         return v
 
     def half_sweep(other_loc, arrs, chunks):
-        yty = jax.lax.psum(other_loc.T @ other_loc, DATA_AXIS) if implicit else None
+        md = matmul_dtype or jnp.float32
+        yty = (
+            jax.lax.psum(
+                jnp.dot(
+                    other_loc.astype(md).T,
+                    other_loc.astype(md),
+                    preferred_element_type=jnp.float32,
+                ),
+                DATA_AXIS,
+            )
+            if implicit
+            else None
+        )
         outs = []
         for (ish, ilo, val, mask), chunk in zip(arrs, chunks):
             n_loc, d = ish.shape
@@ -485,7 +527,7 @@ def _train_als_sharded(
             def solve_chunk(args):
                 ish_c, ilo_c, cval, cmask = args
                 v = ring_fill(other_loc, ish_c, ilo_c) * cmask[..., None]
-                a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k)
+                a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, md)
                 return jnp.linalg.solve(a, b[..., None])[..., 0]
 
             nch = n_loc // chunk

@@ -282,3 +282,30 @@ def test_shard_factors_implicit():
     pred_r = als_ops.predict_pairs(repl.x, repl.y, u, i)
     pred_s = als_ops.predict_pairs(shard.x, shard.y, u, i)
     np.testing.assert_allclose(pred_r, pred_s, atol=5e-2, rtol=5e-2)
+
+
+def test_matmul_dtype_bfloat16_quality_parity():
+    """oryx.batch.compute.matmul-dtype=bfloat16 runs the Gramian einsums
+    with bf16 operands + f32 accumulation; the factorization must stay
+    within noise of the f32 path (solves are f32 either way)."""
+    import numpy as np
+
+    from oryx_tpu.ops import als as als_ops
+
+    gen = np.random.default_rng(13)
+    nu, ni, nnz = 300, 120, 4000
+    u = gen.integers(0, nu, nnz).astype(np.int32)
+    i = gen.integers(0, ni, nnz).astype(np.int32)
+    v = (1.0 + 4.0 * gen.random(nnz)).astype(np.float32)
+    kw = dict(num_users=nu, num_items=ni, features=8, lam=0.1, alpha=1.0,
+              iterations=4, seed=3)
+    for implicit in (False, True):
+        m32 = als_ops.train_als(u, i, v, implicit=implicit, **kw)
+        mbf = als_ops.train_als(u, i, v, implicit=implicit,
+                                matmul_dtype="bfloat16", **kw)
+        for a, b in ((m32.x, mbf.x), (m32.y, mbf.y)):
+            cos = float(np.sum(a * b) / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+            assert cos > 0.99, (implicit, cos)
+        r32 = als_ops.rmse(m32.x, m32.y, u, i, v)
+        rbf = als_ops.rmse(mbf.x, mbf.y, u, i, v)
+        assert abs(r32 - rbf) < 0.05, (implicit, r32, rbf)

@@ -112,6 +112,7 @@ def bench_als_scale() -> dict:
     model = als_ops.train_als(
         u, i, v, num_users, num_items, features=rank, lam=0.01, alpha=1.0,
         implicit=True, iterations=3, mesh=mesh, seed=7, shard_factors=sharded,
+        matmul_dtype=os.environ.get("ORYX_TB_MATMUL_DTYPE"),
     )
     wall = time.perf_counter() - t0
     assert np.isfinite(model.x).all()
