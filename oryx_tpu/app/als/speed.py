@@ -119,6 +119,11 @@ class ALSSpeedModelManager(SpeedModelManager):
         self.implicit = config.get_bool("oryx.als.implicit")
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.fold_backend = config.get_string("oryx.speed.fold-in-backend")
+        self.min_model_load_fraction = config.get_float(
+            "oryx.speed.min-model-load-fraction"
+        )
+        if not 0.0 <= self.min_model_load_fraction <= 1.0:
+            raise ValueError("oryx.speed.min-model-load-fraction must be in [0,1]")
         self.model: ALSSpeedModel | None = None
 
     # -- update-topic consumption (ALSSpeedModelManager.consume:74-126) ------
@@ -184,7 +189,9 @@ class ALSSpeedModelManager(SpeedModelManager):
 
     def build_updates(self, new_data: Iterable[KeyMessage]) -> Iterable[str]:
         model = self.model
-        if model is None:
+        # fold-ins against a half-replayed model would publish junk deltas
+        # (ALSSpeedModelManager.buildUpdates:136-138 gates identically)
+        if model is None or model.get_fraction_loaded() < self.min_model_load_fraction:
             return []
         # columnar parse + aggregate: one numpy pass over the micro-batch
         # (same semantics as parse_interactions + aggregate; the indexed

@@ -78,6 +78,53 @@ class AbstractLayer:
 
     # -- lifecycle ----------------------------------------------------------
 
+    def maybe_start_ui(self) -> None:
+        """Status/metrics HTTP endpoint on ``oryx.<layer>.ui.port`` (the
+        reference exposes the Spark UI on these ports, reference.conf
+        batch/speed ui.port; here it serves the metrics registry and a
+        one-line status as JSON). No-op when the port is null."""
+        port = self.config.get(f"oryx.{self.layer_name}.ui.port", None)
+        if port is None or getattr(self, "_ui_server", None) is not None:
+            return
+        # loopback by default: the endpoint has no auth (the reference's
+        # Spark UI bound 0.0.0.0 unauthenticated; metrics scrapers that
+        # need remote access opt in via ui.bind-address)
+        host = self.config.get(f"oryx.{self.layer_name}.ui.bind-address", None) or "127.0.0.1"
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from oryx_tpu.common import metrics as _metrics
+
+        layer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib contract
+                if self.path not in ("/", "/metrics", "/status"):
+                    self.send_error(404)
+                    return
+                body = dict(_metrics.registry.snapshot())
+                body["layer"] = {
+                    "type": "status",
+                    "name": layer.layer_name,
+                    "id": layer.id,
+                    "stopped": layer.is_stopped(),
+                }
+                data = _json.dumps(body, indent=1).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet: it's a metrics scrape target
+                pass
+
+        srv = ThreadingHTTPServer((host, int(port)), Handler)
+        self._ui_server = srv
+        self.ui_port = srv.server_address[1]  # resolved (port 0 = ephemeral)
+        t = threading.Thread(target=srv.serve_forever, name=f"{self.layer_name}-ui", daemon=True)
+        t.start()
+
     def is_stopped(self) -> bool:
         return self._stop_event.is_set()
 
@@ -86,6 +133,11 @@ class AbstractLayer:
 
     def close(self) -> None:
         self._stop_event.set()
+        srv = getattr(self, "_ui_server", None)
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            self._ui_server = None
 
 
 def blocking_iterator(consumer: TopicConsumer, stop_event: threading.Event) -> Iterator[KeyMessage]:

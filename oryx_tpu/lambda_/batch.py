@@ -60,6 +60,7 @@ class BatchLayer(AbstractLayer):
         background loop; from this point input is observed. Useful when
         driving generations explicitly (tests, one-shot CLI runs)."""
         self.init_topics()
+        self.maybe_start_ui()
         if self._consumer is None:
             self._consumer = self.make_input_consumer()
 

@@ -45,6 +45,7 @@ class SpeedLayer(AbstractLayer):
 
     def start(self) -> None:
         self.init_topics()
+        self.maybe_start_ui()
         ub = self.update_broker()
         if ub is None:
             raise ValueError("speed layer requires an update topic")

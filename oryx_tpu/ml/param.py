@@ -24,6 +24,13 @@ class HyperParamValues(abc.ABC):
     def get_trial_values(self, num: int) -> list:
         """`num` representative values across this range."""
 
+    def sample(self, gen) -> Any:
+        """One random draw from the range (random-search strategy).
+        Ranges override with true uniform draws; discrete/neighborhood
+        types draw among their trial values."""
+        vals = self.get_trial_values(9)
+        return vals[int(gen.integers(len(vals)))]
+
 
 class _ContinuousRange(HyperParamValues):
     def __init__(self, lo: float, hi: float) -> None:
@@ -42,12 +49,18 @@ class _ContinuousRange(HyperParamValues):
         vals[-1] = self.hi
         return vals
 
+    def sample(self, gen) -> float:
+        return float(gen.uniform(self.lo, self.hi))
+
 
 class _DiscreteRange(HyperParamValues):
     def __init__(self, lo: int, hi: int) -> None:
         if lo > hi:
             raise ValueError(f"min {lo} > max {hi}")
         self.lo, self.hi = int(lo), int(hi)
+
+    def sample(self, gen) -> int:
+        return int(gen.integers(self.lo, self.hi + 1))
 
     def get_trial_values(self, num: int) -> list:
         assert num > 0
@@ -107,6 +120,12 @@ class _Unordered(HyperParamValues):
     def get_trial_values(self, num: int) -> list:
         assert num > 0
         return self.values[:num] if num < len(self.values) else list(self.values)
+
+    def sample(self, gen) -> Any:
+        # over ALL values: the base default draws from get_trial_values(9),
+        # which for unordered is a deterministic prefix — values past the
+        # 9th would never be sampled
+        return self.values[int(gen.integers(len(self.values)))]
 
 
 def fixed(value: Any) -> HyperParamValues:
@@ -208,3 +227,31 @@ def choose_hyper_parameter_combos(
         return combos
     picked = gen.permutation(total)[:how_many]
     return [combos[i] for i in picked]
+
+
+def sample_hyper_parameter_combos(
+    ranges: Sequence[HyperParamValues], how_many: int
+) -> list[list]:
+    """Random-search combos (oryx.ml.eval.hyperparam-search = "random"):
+    each candidate draws every hyperparameter independently — continuous
+    ranges uniformly over [lo, hi] rather than from a fixed grid, which
+    dominates grid search when only a few of many dimensions matter
+    (Bergstra & Bengio 2012). Duplicates are retried so small discrete
+    spaces still yield distinct candidates when possible."""
+    if how_many <= 0:
+        raise ValueError("how_many must be positive")
+    if len(ranges) == 0:
+        return [[]]
+    gen = rng.get_random()
+    combos: list[list] = []
+    seen: set = set()
+    attempts = 0
+    while len(combos) < how_many and attempts < how_many * 20:
+        attempts += 1
+        combo = [r.sample(gen) for r in ranges]
+        key = tuple(combo)
+        if key in seen:
+            continue
+        seen.add(key)
+        combos.append(combo)
+    return combos if combos else [[]]

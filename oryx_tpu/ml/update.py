@@ -52,6 +52,12 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         candidates = config.get_int("oryx.ml.eval.candidates")
         self.eval_parallelism = config.get_int("oryx.ml.eval.parallelism")
         self.threshold = config.get_optional_float("oryx.ml.eval.threshold")
+        self.hyperparam_search = config.get_string("oryx.ml.eval.hyperparam-search")
+        if self.hyperparam_search not in ("grid", "random"):
+            raise ValueError(
+                f"oryx.ml.eval.hyperparam-search must be grid or random, "
+                f"got {self.hyperparam_search!r}"
+            )
         self.max_message_size = config.get_int("oryx.update-topic.message.max-size")
         if not 0.0 <= self.test_fraction <= 1.0:
             raise ValueError("test-fraction must be in [0,1]")
@@ -136,13 +142,18 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         # at 100M-rating scale never holds history as one Python list
         all_train = ChainRecords([past_records, ListRecords(train_new)])
 
-        combos = hp.choose_hyper_parameter_combos(
-            self.get_hyper_parameter_values(),
-            self.candidates,
-            hp.choose_values_per_hyper_param(
-                len(self.get_hyper_parameter_values()), self.candidates
-            ),
-        )
+        if self.hyperparam_search == "random":
+            combos = hp.sample_hyper_parameter_combos(
+                self.get_hyper_parameter_values(), self.candidates
+            )
+        else:
+            combos = hp.choose_hyper_parameter_combos(
+                self.get_hyper_parameter_values(),
+                self.candidates,
+                hp.choose_values_per_hyper_param(
+                    len(self.get_hyper_parameter_values()), self.candidates
+                ),
+            )
 
         candidates_root = Path(tempfile.mkdtemp(prefix="oryx-candidates-"))
         try:

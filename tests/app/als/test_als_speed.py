@@ -252,3 +252,23 @@ def test_apply_up_lines_escape_routing():
     n2 = apply_up_lines(lines, 2, set_x, lambda i, m: None, slow2.append,
                         strict_tail=True)
     assert n2 == 0 and len(slow2) == 2
+
+
+def test_build_updates_gated_on_min_model_load_fraction():
+    """A half-replayed model must not fold in events
+    (ALSSpeedModelManager.buildUpdates:136-138): with only 1 of 4
+    expected vectors loaded, build_updates returns nothing; once loading
+    crosses the threshold it resumes."""
+    mgr = make_manager()
+    assert mgr.min_model_load_fraction == 0.8  # packaged default
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [KeyMessage("UP", '["X","U1",[1.0,0.0]]')])
+    assert mgr.model.get_fraction_loaded() < 0.8
+    assert list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")])) == []
+    feed(mgr, [
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    assert mgr.model.get_fraction_loaded() >= 0.8
+    assert list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))

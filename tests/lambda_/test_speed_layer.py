@@ -68,3 +68,38 @@ def test_speed_layer_background_microbatches():
         p.send(None, "x y z")
     assert wait_until(lambda: layer.batch_count >= 1 and layer.manager._counts.get("x") == 2)
     layer.close()
+
+
+def test_layer_ui_port_serves_metrics(tmp_path):
+    """oryx.<layer>.ui.port exposes the metrics registry + layer status as
+    JSON (reference parity: batch/speed ui.port carried the Spark UI)."""
+    import json
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.lambda_.speed import SpeedLayer
+
+    cfg = C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-topic.broker = "inproc://ui-test"
+          update-topic.broker = "inproc://ui-test"
+          speed {{
+            streaming.generation-interval-sec = 3600
+            model-manager-class = "oryx_tpu.app.als.speed:ALSSpeedModelManager"
+            ui.port = 0
+          }}
+        }}
+        """
+    )
+    layer = SpeedLayer(cfg)
+    layer.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{layer.ui_port}/metrics", timeout=5
+        ) as r:
+            body = json.loads(r.read())
+        assert body["layer"]["name"] == "speed"
+        assert body["layer"]["stopped"] is False
+    finally:
+        layer.close()

@@ -84,3 +84,24 @@ def test_combos_empty():
 def test_combos_cap():
     with pytest.raises(ValueError):
         hp.choose_hyper_parameter_combos([hp.fixed(1)] * 10, 1, 10)
+
+
+def test_sample_hyper_parameter_combos_random_search():
+    """random search: continuous ranges draw uniformly (not from a grid),
+    discrete draws stay in range, duplicates are avoided when the space
+    allows, and the empty-ranges edge returns one empty combo."""
+    from oryx_tpu.ml import param as hp
+
+    ranges = [hp.range_param(0.0, 1.0), hp.range_param(1, 4), hp.unordered(["a", "b"])]
+    combos = hp.sample_hyper_parameter_combos(ranges, 16)
+    assert len(combos) == 16
+    cont = [c[0] for c in combos]
+    assert all(0.0 <= x <= 1.0 for x in cont)
+    assert len(set(cont)) > 8  # uniform draws, not a small grid
+    assert all(c[1] in (1, 2, 3, 4) for c in combos)
+    assert all(c[2] in ("a", "b") for c in combos)
+    assert len({tuple(c) for c in combos}) == 16  # deduped
+    # small discrete space: yields the distinct values, doesn't hang
+    small = hp.sample_hyper_parameter_combos([hp.fixed(7)], 5)
+    assert small and all(c == [7] for c in small)
+    assert hp.sample_hyper_parameter_combos([], 3) == [[]]
