@@ -135,14 +135,21 @@ def recommend(ctx: ServingContext, req: Request):
     """als/Recommend.java:68-116."""
     model = _model(ctx)
     user = req.params["userID"]
-    xu = model.get_user_vector(user)
-    if xu is None:
+    # reject unknown users before known-items/rescorer work (providers
+    # must not be invoked with ids that don't exist)
+    if model.get_user_vector(user) is None:
         raise OryxServingException(404, f"unknown user {user}")
     how_many, offset = _paging(req)
     consider_known = req.q_bool("considerKnownItems", False)
     exclude = set() if consider_known else model.get_known_items(user)
     rescorer = _rescorer(ctx, "recommend", req, [user])
-    results = model.top_n(xu, how_many + offset, exclude=exclude, rescorer=rescorer)
+    # top_n_for_user ships an int32 row index when the user is staged on
+    # device (index submit)
+    results = model.top_n_for_user(
+        user, how_many + offset, exclude=exclude, rescorer=rescorer
+    )
+    if results is None:  # removed between the check and the scan
+        raise OryxServingException(404, f"unknown user {user}")
     return [IDValue(i, v) for i, v in _page(results, how_many, offset)]
 
 

@@ -37,7 +37,7 @@ from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
 from oryx_tpu.native.store import make_feature_vectors
 from oryx_tpu.ops import topn as topn_ops
-from oryx_tpu.serving.batcher import score_default
+from oryx_tpu.serving.batcher import score_default, score_indexed_default
 
 log = logging.getLogger(__name__)
 
@@ -51,9 +51,15 @@ class ALSServingModel(ServingModel):
         sample_rate: float = 1.0,
         score_dtype: str = "float32",
         shard_items: bool = False,
+        device_user_matrix: bool = True,
     ) -> None:
         self.features = features
         self.implicit = implicit
+        # stage X on device next to Y so /recommend for a known user ships
+        # an int32 row index instead of a query vector (index submit);
+        # only meaningful for the exact-device-scan path
+        self.device_user_matrix = device_user_matrix
+        self._x_staging = bool(device_user_matrix) and sample_rate >= 1.0 and not shard_items
         # row-shard Y over all local devices (per-device top-k +
         # all_gather merge): the >1-HBM serving mode
         self.shard_items = shard_items
@@ -94,6 +100,15 @@ class ALSServingModel(ServingModel):
         # whether membership may have shrunk (rotation) forcing a rebuild
         self._dirty_ids: set[str] = set()
         self._y_full_rebuild = True
+        # device copy of X (query matrix for index-submitted /recommend)
+        self._x_ids: list[str] = []
+        self._x_index: dict[str, int] = {}
+        self._x_matrix = None  # device [n, k] float32
+        self._x_dirty_ids: set[str] = set()
+        self._x_dirty = True
+        self._x_full_rebuild = True
+        self._x_built_at = 0.0
+        self._x_capacity = 0
 
     # -- vectors -------------------------------------------------------------
 
@@ -107,6 +122,10 @@ class ALSServingModel(ServingModel):
         self.x.set_vector(user, vector)
         with self._expected_lock:
             self._expected_users.discard(user)
+        if self._x_staging:
+            with self._cache_lock:
+                self._x_dirty = True
+                self._x_dirty_ids.add(user)
 
     def set_item_vector(self, item: str, vector: np.ndarray) -> None:
         self.y.set_vector(item, vector)
@@ -124,6 +143,10 @@ class ALSServingModel(ServingModel):
         self.x.set_batch(users, vectors)
         with self._expected_lock:
             self._expected_users.difference_update(users)
+        if self._x_staging:
+            with self._cache_lock:
+                self._x_dirty = True
+                self._x_dirty_ids.update(users)
 
     def set_item_vectors(self, items: list[str], vectors: np.ndarray) -> None:
         self.y.set_batch(items, vectors)
@@ -199,6 +222,13 @@ class ALSServingModel(ServingModel):
 
     def retain_recent_and_user_ids(self, ids: set[str]) -> None:
         self.x.retain_recent_and_ids(ids)
+        if self._x_staging:
+            with self._cache_lock:
+                self._x_dirty = True
+                # membership may have SHRUNK: staged rows for removed users
+                # must stop serving immediately (the vector path would 404),
+                # so index submit disables until the rebuild lands
+                self._x_full_rebuild = True
 
     def retain_recent_and_item_ids(self, ids: set[str]) -> None:
         self.y.retain_recent_and_ids(ids)
@@ -296,6 +326,109 @@ class ALSServingModel(ServingModel):
                 self._y_partitions,
             )
 
+    def _try_incremental_x_refresh(self, dirty: list[str]) -> bool:
+        """Scatter-update the dirty rows of the device-resident X (caller
+        holds the cache lock). First-time users APPEND into the padded
+        device capacity — a steady trickle of new users must not force a
+        full re-upload every refresh tick. False = rebuild required
+        (capacity exhausted or a dirty user vanished)."""
+        new = [u for u in dirty if u not in self._x_index]
+        if len(self._x_ids) + len(new) > self._x_capacity:
+            return False
+        vals, valid = self.x.get_batch(dirty, dim=self.features)
+        if not np.all(valid):
+            return False  # a dirty user vanished: membership changed
+        for u in new:
+            self._x_index[u] = len(self._x_ids)
+            self._x_ids.append(u)
+        rows = np.fromiter(
+            (self._x_index[u] for u in dirty), dtype=np.int32, count=len(dirty)
+        )
+        self._x_matrix = topn_ops.update_query_rows(self._x_matrix, rows, vals)
+        return True
+
+    def _user_scan_row(self, user: str):
+        """(x_matrix, row) for index submit, or (None, None) when the
+        user isn't freshly staged. Resolution happens under the cache
+        lock so the row, the matrix snapshot, and the staleness check
+        are mutually consistent."""
+        with self._cache_lock:
+            now = time.monotonic()
+            if self._x_dirty and (now - self._x_built_at >= self._refresh_sec):
+                dirty = list(self._x_dirty_ids)
+                refreshed = (
+                    self._x_matrix is not None
+                    and not self._x_full_rebuild
+                    and bool(dirty)
+                    and self._try_incremental_x_refresh(dirty)
+                )
+                if not refreshed:
+                    ids, mat = self.x.to_matrix()
+                    self._x_ids = list(ids)
+                    self._x_index = {id_: i for i, id_ in enumerate(ids)}
+                    if len(ids):
+                        # pad capacity so a trickle of new users appends
+                        # via scatter instead of re-uploading everything
+                        cap = max(64, int(len(ids) * 1.25))
+                        pad = np.zeros((cap - len(ids), self.features), np.float32)
+                        self._x_matrix = topn_ops.upload_queries(
+                            np.concatenate([mat, pad]) if cap > len(ids) else mat
+                        )
+                        self._x_capacity = cap
+                    else:
+                        self._x_matrix = None
+                        self._x_capacity = 0
+                    self._x_full_rebuild = False
+                self._x_dirty_ids.clear()
+                self._x_dirty = False
+                self._x_built_at = now
+            if (
+                self._x_matrix is None
+                or self._x_full_rebuild  # rotation pending: rows may be gone
+                or user in self._x_dirty_ids
+            ):
+                return None, None
+            row = self._x_index.get(user)
+            return (self._x_matrix, row) if row is not None else (None, None)
+
+    def top_n_for_user(
+        self,
+        user: str,
+        how_many: int,
+        exclude: set[str] | None = None,
+        rescorer=None,
+        cosine: bool = False,
+    ) -> list[tuple[str, float]] | None:
+        """top_n for a known user id, or None when the user is unknown.
+
+        With the device-resident X enabled (and the exact device scan in
+        play), the request ships an int32 row index instead of a query
+        vector — the serving twin of ``submit_top_k_multi_indexed``. A
+        user whose vector changed since the last X refresh (or isn't
+        staged yet) falls back to the fresh host vector, so results are
+        never staler than the vector path's."""
+        if self._x_staging:
+            x_mat, row = self._user_scan_row(user)
+            if row is not None:
+                ids, _index, y_mat, _h, _p = self._ensure_y_matrix()
+                if y_mat is not None and not isinstance(
+                    y_mat, topn_ops.ShardedItemMatrix
+                ):
+                    return self._select_loop(
+                        ids,
+                        len(ids),
+                        lambda k: score_indexed_default(
+                            y_mat, x_mat, row, k, cosine=cosine
+                        ),
+                        how_many,
+                        exclude,
+                        rescorer,
+                    )
+        vec = self.get_user_vector(user)
+        if vec is None:
+            return None
+        return self.top_n(vec, how_many, exclude=exclude, rescorer=rescorer, cosine=cosine)
+
     def top_n(
         self,
         query: np.ndarray,
@@ -320,25 +453,38 @@ class ALSServingModel(ServingModel):
             if len(lsh_rows) == 0:
                 lsh_rows = None  # degenerate: fall back to the exact scan
         num_candidates = len(lsh_rows) if lsh_rows is not None else len(ids)
+
+        def score_fn(k: int):
+            if lsh_rows is not None:
+                return _host_top_k(y_host, lsh_rows, query, k, cosine=cosine)
+            if isinstance(y_mat, topn_ops.ShardedItemMatrix):
+                # mesh-sharded scan: per-device top-k + all_gather merge
+                bi, bv = topn_ops.top_k_sharded(y_mat, query, k, cosine=cosine)
+                return bi[0], bv[0]
+            # continuous batching: concurrent requests against the same
+            # Y snapshot coalesce into one device call
+            return score_default(y_mat, query, k, cosine=cosine)
+
+        return self._select_loop(
+            ids, num_candidates, score_fn, how_many, exclude, rescorer
+        )
+
+    @staticmethod
+    def _select_loop(
+        ids, num_candidates, score_fn, how_many, exclude, rescorer
+    ) -> list[tuple[str, float]]:
+        """Candidate-window widening shared by the vector and index-submit
+        paths: widen until how_many survive filtering or every item has
+        been considered (the reference streams all items,
+        ALSServingModel.topN:289-335, so filters can never starve
+        results)."""
         exclude = exclude or set()
         margin = how_many + len(exclude)
         if rescorer is not None:
             margin = max(margin * 4, margin + 32)  # rescorer may filter many
-        # widen the candidate window until how_many survive filtering or
-        # every item has been considered (the reference streams all items,
-        # ALSServingModel.topN:289-335, so filters can never starve results)
         while True:
             k = min(margin, num_candidates)
-            if lsh_rows is not None:
-                idx, scores = _host_top_k(y_host, lsh_rows, query, k, cosine=cosine)
-            elif isinstance(y_mat, topn_ops.ShardedItemMatrix):
-                # mesh-sharded scan: per-device top-k + all_gather merge
-                bi, bv = topn_ops.top_k_sharded(y_mat, query, k, cosine=cosine)
-                idx, scores = bi[0], bv[0]
-            else:
-                # continuous batching: concurrent requests against the same
-                # Y snapshot coalesce into one device call
-                idx, scores = score_default(y_mat, query, k, cosine=cosine)
+            idx, scores = score_fn(k)
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
                 id_ = ids[int(i)]
@@ -405,6 +551,9 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.sample_rate = config.get_float("oryx.als.sample-rate")
         self.score_dtype = config.get_string("oryx.als.serving.score-dtype")
         self.shard_items = config.get_bool("oryx.als.serving.shard-items")
+        self.device_user_matrix = config.get_bool(
+            "oryx.als.serving.device-user-matrix"
+        )
         if self.score_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"oryx.als.serving.score-dtype must be float32 or bfloat16, "
@@ -479,6 +628,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                         sample_rate=self.sample_rate,
                         score_dtype=self.score_dtype,
                         shard_items=self.shard_items,
+                        device_user_matrix=self.device_user_matrix,
                     )
                     self.model.set_expected(x_ids, y_ids)
                 else:

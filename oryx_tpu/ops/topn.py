@@ -440,6 +440,21 @@ def upload_queries(queries: np.ndarray) -> jax.Array:
     return jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
 
 
+@jax.jit
+def _scatter_query_rows(x_dev, rows, vals):
+    return x_dev.at[rows].set(vals)
+
+
+def update_query_rows(x_dev: jax.Array, rows: np.ndarray, values: np.ndarray) -> jax.Array:
+    """Scatter-update rows of a staged query matrix (the incremental
+    refresh for device-resident X — same idea as update_rows for Y)."""
+    return _scatter_query_rows(
+        x_dev,
+        jnp.asarray(np.asarray(rows, np.int32)),
+        jnp.asarray(np.ascontiguousarray(values, np.float32)),
+    )
+
+
 @functools.partial(jax.jit, static_argnums=(4, 5, 6))
 def _indexed_multi_xla(mat, norms, x_dev, idx_kb, k, cosine, download_dtype):
     q_kb = x_dev[idx_kb].astype(mat.dtype)  # [K, b, feat] gathered on device

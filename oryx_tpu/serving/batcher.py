@@ -51,9 +51,11 @@ class BatcherClosedError(RuntimeError):
 @dataclass
 class _Entry:
     uploaded: object
-    query: np.ndarray
+    query: np.ndarray | None  # None for index-submitted entries
     k: int
     cosine: bool
+    x_dev: object | None = None  # device-resident query matrix (index entries)
+    row: int | None = None  # row into x_dev
     done: threading.Event = field(default_factory=threading.Event)
     idx: np.ndarray | None = None
     vals: np.ndarray | None = None
@@ -109,6 +111,19 @@ class TopNBatcher:
         ``top_k_scores``. Raises ``RuntimeError`` if the batcher is closed
         (callers going through :func:`score_default` get a retry)."""
         e = _Entry(uploaded, np.asarray(query, dtype=np.float32), int(k), bool(cosine))
+        return self._enqueue(e)
+
+    def score_indexed(
+        self, uploaded, x_dev, row: int, k: int, cosine: bool = False
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """score() with the query vector already device-resident: the
+        entry carries only an int32 row into ``x_dev``; coalesced groups
+        dispatch via submit_top_k_multi_indexed (device-side gather, no
+        vector upload)."""
+        e = _Entry(uploaded, None, int(k), bool(cosine), x_dev=x_dev, row=int(row))
+        return self._enqueue(e)
+
+    def _enqueue(self, e: _Entry) -> tuple[np.ndarray, np.ndarray]:
         with self._state_lock:  # an entry can never land after the sentinel
             if self._closed:
                 raise BatcherClosedError("batcher is closed")
@@ -142,17 +157,22 @@ class TopNBatcher:
             if batch is None:
                 self._pending.put(None)
                 return
-            # group by (matrix snapshot, cosine): indices are only
-            # meaningful against the snapshot the caller captured
-            groups: dict[tuple[int, bool], list[_Entry]] = {}
+            # group by (matrix snapshot, cosine, query-matrix snapshot):
+            # indices are only meaningful against the snapshots the caller
+            # captured, and vector entries never mix with index entries
+            groups: dict[tuple, list[_Entry]] = {}
             for e in batch:
-                groups.setdefault((id(e.uploaded), e.cosine), []).append(e)
-            for (_, cosine), entries in groups.items():
+                xk = id(e.x_dev) if e.row is not None else None
+                groups.setdefault((id(e.uploaded), e.cosine, xk), []).append(e)
+            for (_, cosine, _xk), entries in groups.items():
                 self._submit_group(entries, cosine)
 
     def _submit_group(self, entries: list[_Entry], cosine: bool) -> None:
         self._inflight.acquire()
         try:
+            if entries[0].row is not None:
+                self._submit_indexed(entries, cosine)
+                return
             queries = np.stack([e.query for e in entries])
             kk = _k_bucket(max(e.k for e in entries))
             if len(entries) > self.MULTI_THRESHOLD:
@@ -174,6 +194,31 @@ class TopNBatcher:
                 handle = topn_ops.submit_top_k(
                     entries[0].uploaded, queries, kk, cosine=cosine
                 )
+            self._pending.put((handle, entries))
+        except BaseException as exc:  # deliver the failure to the waiters
+            self._inflight.release()
+            for e in entries:
+                e.error = exc
+                e.done.set()
+
+    def _submit_indexed(self, entries: list[_Entry], cosine: bool) -> None:
+        """Dispatch one coalesced index-entry group (caller holds the
+        inflight slot; errors deliver to waiters exactly like the vector
+        path)."""
+        try:
+            rows = np.asarray([e.row for e in entries], dtype=np.int32)
+            kk = _k_bucket(max(e.k for e in entries))
+            pad = _b_bucket(len(rows)) - len(rows)
+            if pad:  # bucketed shapes: row 0 repeats, results discarded
+                rows = np.concatenate([rows, np.zeros(pad, np.int32)])
+            handle = topn_ops.submit_top_k_multi_indexed(
+                entries[0].uploaded,
+                entries[0].x_dev,
+                rows,
+                kk,
+                cosine=cosine,
+                scan_batch=self.MULTI_THRESHOLD,
+            )
             self._pending.put((handle, entries))
         except BaseException as exc:  # deliver the failure to the waiters
             self._inflight.release()
@@ -237,6 +282,22 @@ def get_default_batcher() -> TopNBatcher:
                 atexit.register(close_default_batcher)
                 _atexit_registered = True
         return _default
+
+
+def score_indexed_default(
+    uploaded, x_dev, row: int, k: int, cosine: bool = False
+) -> tuple[np.ndarray, np.ndarray]:
+    """``score_default`` for index-submitted entries (same close-race
+    retry contract)."""
+    for attempt in range(4):
+        try:
+            return get_default_batcher().score_indexed(
+                uploaded, x_dev, row, k, cosine=cosine
+            )
+        except BatcherClosedError:
+            if attempt == 3:
+                raise
+    raise AssertionError("unreachable")
 
 
 def score_default(

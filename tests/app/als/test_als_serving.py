@@ -422,3 +422,103 @@ def test_consume_blocks_slow_fast_ordering_same_id():
     blk.consume_blocks(iter([RecordBlock.from_key_messages(msgs)]))
     np.testing.assert_array_equal(blk.get_model().get_user_vector("U7"), [3.0, 4.0])
     assert blk.get_model().get_known_items("U7") == {'a"b'}
+
+
+def test_top_n_for_user_index_submit_and_freshness():
+    """Device-staged users serve /recommend via index submit with results
+    identical to the vector path; a user updated since the last X refresh
+    (or unknown) falls back so answers are never staler than the vector
+    path's."""
+    import numpy as np
+
+    import oryx_tpu.app.als.serving_model as sm
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    calls = {"indexed": 0, "vector": 0}
+    orig_i, orig_v = sm.score_indexed_default, sm.score_default
+    sm.score_indexed_default = lambda *a, **k: (
+        calls.__setitem__("indexed", calls["indexed"] + 1),
+        orig_i(*a, **k),
+    )[1]
+    sm.score_default = lambda *a, **k: (
+        calls.__setitem__("vector", calls["vector"] + 1),
+        orig_v(*a, **k),
+    )[1]
+    try:
+        gen = np.random.default_rng(2)
+        m = ALSServingModel(4, True, refresh_sec=0.0)
+        m.set_user_vectors(
+            [f"u{i}" for i in range(20)], gen.standard_normal((20, 4)).astype(np.float32)
+        )
+        m.set_item_vectors(
+            [f"i{i}" for i in range(50)], gen.standard_normal((50, 4)).astype(np.float32)
+        )
+        r_idx = m.top_n_for_user("u3", 5)
+        assert calls == {"indexed": 1, "vector": 0}
+        r_vec = m.top_n(m.get_user_vector("u3"), 5)
+        assert [i for i, _ in r_idx] == [i for i, _ in r_vec]
+        np.testing.assert_allclose(
+            [v for _, v in r_idx], [v for _, v in r_vec], rtol=1e-5
+        )
+        assert m.top_n_for_user("nobody", 3) is None  # unknown -> 404 upstream
+
+        # staleness: long refresh interval, then update a staged user —
+        # the stale device row must NOT serve the request
+        m2 = ALSServingModel(4, True, refresh_sec=999.0)
+        m2.set_user_vectors(
+            [f"u{i}" for i in range(5)], gen.standard_normal((5, 4)).astype(np.float32)
+        )
+        m2.set_item_vectors(
+            [f"i{i}" for i in range(9)], gen.standard_normal((9, 4)).astype(np.float32)
+        )
+        m2.top_n_for_user("u1", 3)  # builds + stages X
+        base = dict(calls)
+        fresh_vec = gen.standard_normal(4).astype(np.float32)
+        m2.set_user_vector("u1", fresh_vec)  # dirty; refresh not due
+        r_after = m2.top_n_for_user("u1", 3)
+        assert calls["vector"] == base["vector"] + 1  # fell back
+        r_direct = m2.top_n(fresh_vec, 3)
+        assert [i for i, _ in r_after] == [i for i, _ in r_direct]
+        # an untouched user still rides the staged matrix
+        m2.top_n_for_user("u2", 3)
+        assert calls["indexed"] == base["indexed"] + 1
+    finally:
+        sm.score_indexed_default = orig_i
+        sm.score_default = orig_v
+
+
+def test_device_x_append_rotation_and_disabled_tracking():
+    """Device-X lifecycle: new users append into padded capacity (no full
+    re-upload per trickle), rotation disables index submit until the
+    rebuild lands (removed users 404 like the vector path), and disabled
+    staging never accumulates dirty-id state."""
+    import numpy as np
+
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    gen = np.random.default_rng(7)
+    m = ALSServingModel(4, True, refresh_sec=0.0)
+    m.set_user_vectors(
+        [f"u{i}" for i in range(8)], gen.standard_normal((8, 4)).astype(np.float32)
+    )
+    m.set_item_vectors(
+        [f"i{i}" for i in range(9)], gen.standard_normal((9, 4)).astype(np.float32)
+    )
+    assert m.top_n_for_user("u1", 3)
+    cap = m._x_capacity
+    assert cap >= 8
+    m.set_user_vector("uNEW", gen.standard_normal(4).astype(np.float32))
+    assert m.top_n_for_user("uNEW", 3)
+    assert m._x_capacity == cap  # appended via scatter, not rebuilt
+    assert m._x_index["uNEW"] == 8
+    # rotation drains the store (two rounds: first keeps recent writes)
+    m.retain_recent_and_user_ids(set())
+    m.retain_recent_and_user_ids(set())
+    assert m.get_user_vector("u1") is None
+    assert m.top_n_for_user("u1", 3) is None  # stale staged row must not serve
+    # staging disabled: no dirty-id accumulation
+    m2 = ALSServingModel(4, True, device_user_matrix=False)
+    m2.set_user_vectors(
+        [f"u{i}" for i in range(5)], gen.standard_normal((5, 4)).astype(np.float32)
+    )
+    assert not m2._x_dirty_ids
