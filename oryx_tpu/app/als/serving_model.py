@@ -109,6 +109,7 @@ class ALSServingModel(ServingModel):
         self._x_full_rebuild = True
         self._x_built_at = 0.0
         self._x_capacity = 0
+        self._x_building = False
 
     # -- vectors -------------------------------------------------------------
 
@@ -347,11 +348,58 @@ class ALSServingModel(ServingModel):
         self._x_matrix = topn_ops.update_query_rows(self._x_matrix, rows, vals)
         return True
 
+    # staged X bigger than this is not worth the HBM next to Y: fall back
+    # to vector submit rather than risk OOMing a previously-fine deploy
+    _X_STAGE_MAX_BYTES = 2 << 30
+
+    def _rebuild_x_staging(self, pre_dirty: set[str]) -> None:
+        """Full X restage, run by the triggering request thread OUTSIDE
+        the cache lock (to_matrix + a
+        potentially multi-GB upload must not stall Y scoring); the swap
+        happens under the lock. Ids written during the build stay dirty
+        and catch up on the next refresh tick."""
+        try:
+            ids, mat = self.x.to_matrix()
+            if len(ids) * self.features * 4 * 1.25 > self._X_STAGE_MAX_BYTES:
+                log.info(
+                    "device X (%d users x %d) exceeds the staging budget; "
+                    "index submit disabled for this model",
+                    len(ids), self.features,
+                )
+                with self._cache_lock:
+                    self._x_matrix = None
+                    self._x_capacity = 0
+                self._x_staging = False
+                return
+            if len(ids):
+                # pad capacity so a trickle of new users appends via
+                # scatter instead of re-uploading everything
+                cap = max(64, int(len(ids) * 1.25))
+                pad = np.zeros((cap - len(ids), self.features), np.float32)
+                staged = topn_ops.upload_queries(
+                    np.concatenate([mat, pad]) if cap > len(ids) else mat
+                )
+            else:
+                staged, cap = None, 0
+            with self._cache_lock:
+                self._x_ids = list(ids)
+                self._x_index = {id_: i for i, id_ in enumerate(ids)}
+                self._x_matrix = staged
+                self._x_capacity = cap
+                self._x_full_rebuild = False
+                self._x_dirty_ids -= pre_dirty
+                self._x_dirty = bool(self._x_dirty_ids)
+                self._x_built_at = time.monotonic()
+        finally:
+            self._x_building = False
+
     def _user_scan_row(self, user: str):
         """(x_matrix, row) for index submit, or (None, None) when the
-        user isn't freshly staged. Resolution happens under the cache
-        lock so the row, the matrix snapshot, and the staleness check
-        are mutually consistent."""
+        user isn't freshly staged. Row resolution happens under the cache
+        lock so the row, the matrix snapshot, and the staleness check are
+        mutually consistent; a pending full restage serves the vector
+        path instead of blocking."""
+        rebuild_dirty: set[str] | None = None
         with self._cache_lock:
             now = time.monotonic()
             if self._x_dirty and (now - self._x_built_at >= self._refresh_sec):
@@ -360,36 +408,27 @@ class ALSServingModel(ServingModel):
                     self._x_matrix is not None
                     and not self._x_full_rebuild
                     and bool(dirty)
-                    and self._try_incremental_x_refresh(dirty)
+                    and self._try_incremental_x_refresh(dirty)  # ms-scale scatter
                 )
-                if not refreshed:
-                    ids, mat = self.x.to_matrix()
-                    self._x_ids = list(ids)
-                    self._x_index = {id_: i for i, id_ in enumerate(ids)}
-                    if len(ids):
-                        # pad capacity so a trickle of new users appends
-                        # via scatter instead of re-uploading everything
-                        cap = max(64, int(len(ids) * 1.25))
-                        pad = np.zeros((cap - len(ids), self.features), np.float32)
-                        self._x_matrix = topn_ops.upload_queries(
-                            np.concatenate([mat, pad]) if cap > len(ids) else mat
-                        )
-                        self._x_capacity = cap
-                    else:
-                        self._x_matrix = None
-                        self._x_capacity = 0
-                    self._x_full_rebuild = False
-                self._x_dirty_ids.clear()
-                self._x_dirty = False
-                self._x_built_at = now
-            if (
+                if refreshed:
+                    self._x_dirty_ids.clear()
+                    self._x_dirty = False
+                    self._x_built_at = now
+                elif not self._x_building:
+                    self._x_building = True
+                    rebuild_dirty = set(self._x_dirty_ids)
+            stale = (
                 self._x_matrix is None
                 or self._x_full_rebuild  # rotation pending: rows may be gone
                 or user in self._x_dirty_ids
-            ):
-                return None, None
-            row = self._x_index.get(user)
-            return (self._x_matrix, row) if row is not None else (None, None)
+            )
+            row = None if stale else self._x_index.get(user)
+            x_mat = self._x_matrix
+        if rebuild_dirty is not None:
+            self._rebuild_x_staging(rebuild_dirty)
+        if row is None:
+            return None, None
+        return x_mat, row
 
     def top_n_for_user(
         self,

@@ -447,12 +447,20 @@ def _scatter_query_rows(x_dev, rows, vals):
 
 def update_query_rows(x_dev: jax.Array, rows: np.ndarray, values: np.ndarray) -> jax.Array:
     """Scatter-update rows of a staged query matrix (the incremental
-    refresh for device-resident X — same idea as update_rows for Y)."""
-    return _scatter_query_rows(
-        x_dev,
-        jnp.asarray(np.asarray(rows, np.int32)),
-        jnp.asarray(np.ascontiguousarray(values, np.float32)),
-    )
+    refresh for device-resident X — same idea as update_rows for Y).
+    Row counts bucket to powers of two (padding repeats the last row) so
+    jit retraces O(log n) scatter shapes, not one per dirty-batch size."""
+    rows = np.asarray(rows, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    m = len(rows)
+    if m == 0:
+        return x_dev
+    bucket = 1 << (m - 1).bit_length()
+    if bucket != m:
+        pad = bucket - m
+        rows = np.concatenate([rows, np.repeat(rows[-1:], pad)])
+        values = np.concatenate([values, np.repeat(values[-1:], pad, axis=0)])
+    return _scatter_query_rows(x_dev, jnp.asarray(rows), jnp.asarray(values))
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5, 6))

@@ -60,6 +60,10 @@ class LoadTestModelManager:
         for _ in it:
             pass
 
+    def consume_blocks(self, it):  # duck-typed manager: mirror the ABC default
+        for _ in it:
+            pass
+
     def get_config(self):
         return self._config
 
