@@ -225,6 +225,8 @@ def bench_als() -> None:
 def bench_als_scale() -> None:
     from tools import train_benchmark as tb
 
+    # the baseline row must be f32 even if the experiment knob is exported
+    prev = os.environ.pop("ORYX_TB_MATMUL_DTYPE", None)
     r = tb.bench_als_scale()
     _emit(
         f"ALS implicit training throughput ({r['config']}, {r['backend']}) "
@@ -232,6 +234,24 @@ def bench_als_scale() -> None:
         r["ratings_per_sec"],
         "ratings/sec",
         r["ratings_per_sec"] / CPU_FLOOR_ALS_SCALE_RPS,
+    )
+    # the bf16-Gramian variant (oryx.batch.compute.matmul-dtype=bfloat16):
+    # half the HBM traffic, full-rate MXU; same CPU-floor denominator
+    os.environ["ORYX_TB_MATMUL_DTYPE"] = "bfloat16"
+    try:
+        rb = tb.bench_als_scale()
+    finally:
+        if prev is None:
+            os.environ.pop("ORYX_TB_MATMUL_DTYPE", None)
+        else:
+            os.environ["ORYX_TB_MATMUL_DTYPE"] = prev
+    _emit(
+        f"ALS implicit training throughput, bf16 Gramians ({rb['config']}, "
+        f"{rb['backend']}) vs this build's CPU floor "
+        f"{CPU_FLOOR_ALS_SCALE_RPS / 1000:.0f}k ratings/s",
+        rb["ratings_per_sec"],
+        "ratings/sec",
+        rb["ratings_per_sec"] / CPU_FLOOR_ALS_SCALE_RPS,
     )
 
 

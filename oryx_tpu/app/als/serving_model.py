@@ -110,6 +110,7 @@ class ALSServingModel(ServingModel):
         self._x_built_at = 0.0
         self._x_capacity = 0
         self._x_building = False
+        self._x_epoch = 0  # bumped by rotation: invalidates in-flight restages
 
     # -- vectors -------------------------------------------------------------
 
@@ -228,8 +229,11 @@ class ALSServingModel(ServingModel):
                 self._x_dirty = True
                 # membership may have SHRUNK: staged rows for removed users
                 # must stop serving immediately (the vector path would 404),
-                # so index submit disables until the rebuild lands
+                # so index submit disables until the rebuild lands — and an
+                # in-flight restage built from the PRE-rotation store must
+                # be discarded at swap time
                 self._x_full_rebuild = True
+                self._x_epoch += 1
 
     def retain_recent_and_item_ids(self, ids: set[str]) -> None:
         self.y.retain_recent_and_ids(ids)
@@ -352,12 +356,15 @@ class ALSServingModel(ServingModel):
     # to vector submit rather than risk OOMing a previously-fine deploy
     _X_STAGE_MAX_BYTES = 2 << 30
 
-    def _rebuild_x_staging(self, pre_dirty: set[str]) -> None:
+    def _rebuild_x_staging(self, pre_dirty: set[str], epoch: int) -> None:
         """Full X restage, run by the triggering request thread OUTSIDE
-        the cache lock (to_matrix + a
-        potentially multi-GB upload must not stall Y scoring); the swap
-        happens under the lock. Ids written during the build stay dirty
-        and catch up on the next refresh tick."""
+        the cache lock (to_matrix + a potentially multi-GB upload must
+        not stall Y scoring); the swap happens under the lock and is
+        DISCARDED if a rotation bumped the epoch mid-build (the snapshot
+        predates it; the next tick rebuilds from the rotated store). Ids
+        written during the build stay dirty and catch up on the next
+        refresh tick; incremental scatters are held off while a build is
+        in flight so the swap can never clobber one."""
         try:
             ids, mat = self.x.to_matrix()
             if len(ids) * self.features * 4 * 1.25 > self._X_STAGE_MAX_BYTES:
@@ -382,6 +389,8 @@ class ALSServingModel(ServingModel):
             else:
                 staged, cap = None, 0
             with self._cache_lock:
+                if self._x_epoch != epoch:
+                    return  # rotation landed mid-build: discard the snapshot
                 self._x_ids = list(ids)
                 self._x_index = {id_: i for i, id_ in enumerate(ids)}
                 self._x_matrix = staged
@@ -405,7 +414,9 @@ class ALSServingModel(ServingModel):
             if self._x_dirty and (now - self._x_built_at >= self._refresh_sec):
                 dirty = list(self._x_dirty_ids)
                 refreshed = (
-                    self._x_matrix is not None
+                    not self._x_building  # a scatter into the old matrix
+                    # would be clobbered by the in-flight restage's swap
+                    and self._x_matrix is not None
                     and not self._x_full_rebuild
                     and bool(dirty)
                     and self._try_incremental_x_refresh(dirty)  # ms-scale scatter
@@ -417,6 +428,7 @@ class ALSServingModel(ServingModel):
                 elif not self._x_building:
                     self._x_building = True
                     rebuild_dirty = set(self._x_dirty_ids)
+                    rebuild_epoch = self._x_epoch
             stale = (
                 self._x_matrix is None
                 or self._x_full_rebuild  # rotation pending: rows may be gone
@@ -425,7 +437,7 @@ class ALSServingModel(ServingModel):
             row = None if stale else self._x_index.get(user)
             x_mat = self._x_matrix
         if rebuild_dirty is not None:
-            self._rebuild_x_staging(rebuild_dirty)
+            self._rebuild_x_staging(rebuild_dirty, rebuild_epoch)
         if row is None:
             return None, None
         return x_mat, row

@@ -371,7 +371,7 @@ def train_forest(
             if m >= pa:
                 mask_t[:, :, allowed] = 1.0
             else:
-                keys = gen.random((t1 - t0, num_level, pa))
+                keys = gen.random((t1 - t0, num_level, pa), dtype=np.float32)
                 pick = np.argpartition(keys, m, axis=2)[:, :, :m]
                 np.put_along_axis(
                     mask_t.reshape((t1 - t0) * num_level, p),

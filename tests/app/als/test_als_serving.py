@@ -526,3 +526,44 @@ def test_device_x_append_rotation_and_disabled_tracking():
         [f"u{i}" for i in range(5)], gen.standard_normal((5, 4)).astype(np.float32)
     )
     assert not m2._x_dirty_ids
+
+
+def test_rotation_during_x_restage_discards_stale_snapshot():
+    """A MODEL rotation landing while the out-of-lock X restage is
+    uploading must invalidate that build: the pre-rotation snapshot is
+    discarded at swap time (epoch check) and removed users keep 404ing
+    exactly like the vector path."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    gen = np.random.default_rng(1)
+    m = ALSServingModel(4, True, refresh_sec=0.0)
+    m.set_user_vectors(
+        [f"u{i}" for i in range(10)], gen.standard_normal((10, 4)).astype(np.float32)
+    )
+    m.set_item_vectors(
+        [f"i{i}" for i in range(8)], gen.standard_normal((8, 4)).astype(np.float32)
+    )
+    orig_to_matrix = m.x.to_matrix
+
+    def slow_to_matrix():
+        out = orig_to_matrix()
+        _time.sleep(0.5)  # rotation lands while "uploading"
+        return out
+
+    m.x.to_matrix = slow_to_matrix
+    t = threading.Thread(target=lambda: m.top_n_for_user("u1", 3))
+    t.start()
+    _time.sleep(0.15)
+    m.retain_recent_and_user_ids(set())  # first keeps recent writes
+    m.retain_recent_and_user_ids(set())  # second drains the store
+    t.join()
+    # the in-flight build (pre-rotation users) must have been discarded
+    assert m._x_full_rebuild and m._x_matrix is None
+    assert m.get_user_vector("u1") is None
+    # and the removed user 404s (None), never served off the stale snapshot
+    assert m.top_n_for_user("u1", 3) is None
