@@ -120,13 +120,15 @@ def main() -> None:
         if time.perf_counter() - t0 > 120:
             sys.exit("model never loaded")
         time.sleep(0.05)
-    # seed factor vectors so fold-ins solve against a real Gramian
+    # seed factor vectors so fold-ins solve against a real Gramian — via
+    # the MODEL-level batched setters (not raw store writes) so expected-id
+    # accounting drains and get_fraction_loaded() reaches 1.0; the layer
+    # refuses to fold into a model below min-model-load-fraction
     x = gen.standard_normal((args.users, args.features)).astype(np.float32)
     y = gen.standard_normal((args.items, args.features)).astype(np.float32)
-    for j in range(args.users):
-        m.x.set_vector(f"u{j}", x[j])
-    for j in range(args.items):
-        m.y.set_vector(f"i{j}", y[j])
+    m.set_user_vectors([f"u{j}" for j in range(args.users)], x)
+    m.set_item_vectors([f"i{j}" for j in range(args.items)], y)
+    assert m.get_fraction_loaded() >= 1.0, m.get_fraction_loaded()
     print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
 
     if args.prefill:
