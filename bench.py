@@ -131,7 +131,17 @@ def bench_serving(features_override: int | None = None, baseline_qps: float | No
         )
 
     t0 = time.perf_counter()
-    submit(0, group).result()
+    try:
+        submit(0, group).result()
+    except Exception as e:  # noqa: BLE001
+        if submit_mode != "index":
+            raise
+        # index submit is the default but must never cost the metric:
+        # fall back to vector upload if the indexed program won't build
+        print(f"bench[serving]: index submit failed ({e!r}); vector fallback", file=sys.stderr)
+        submit_mode = "vector"
+        x_dev = None
+        submit(0, group).result()
     print(f"bench[serving]: warmup/compile {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     served = 0
@@ -321,9 +331,10 @@ def run_bench() -> None:
 
     import jax
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # a site plugin may have pinned jax_platforms at import; re-assert
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import oryx_tpu
+
+    # a site plugin may have pinned jax_platforms at import; re-assert
+    oryx_tpu.honor_platform_env()
     print(
         f"bench: backend={jax.default_backend()} devices={len(jax.devices())}",
         file=sys.stderr,

@@ -20,25 +20,35 @@ __version__ = "0.1.0"
 import os as _os
 import sys as _sys
 
-if "JAX_PLATFORMS" in _os.environ and "jax" in _sys.modules:
-    # honor the env var when a site-installed accelerator plugin already
-    # imported jax at interpreter startup and pinned jax_platforms (the
-    # pin would otherwise silently override JAX_PLATFORMS, making e.g. a
-    # CPU-only run hang trying to reach an unavailable accelerator). If
-    # jax is not yet imported, its own env handling honors the variable.
+
+def honor_platform_env() -> None:
+    """Make $JAX_PLATFORMS authoritative when a site-installed accelerator
+    plugin already imported jax at interpreter startup and pinned
+    jax_platforms (the pin would otherwise silently override the env var,
+    making e.g. a CPU-only run hang trying to reach an unavailable
+    accelerator). Empty string means "unpin" (restore JAX's default
+    platform selection). No-op when jax hasn't been imported yet — its
+    own env handling honors the variable. Runs at package import; call
+    it explicitly from entry points that touch jax before importing this
+    package (bench.py, __graft_entry__)."""
+    if "JAX_PLATFORMS" not in _os.environ or "jax" not in _sys.modules:
+        return
     _jax = _sys.modules["jax"]
     try:
-        _current = _jax.config.jax_platforms
+        current = _jax.config.jax_platforms
     except AttributeError:  # pragma: no cover - config renamed
-        _current = None
-    _desired = _os.environ["JAX_PLATFORMS"] or None
-    if _current != _desired:
+        current = None
+    desired = _os.environ["JAX_PLATFORMS"] or None
+    if current != desired:
         try:
-            _jax.config.update("jax_platforms", _desired)
+            _jax.config.update("jax_platforms", desired)
             import logging as _logging
 
             _logging.getLogger(__name__).info(
-                "overriding jax_platforms=%r with $JAX_PLATFORMS=%r", _current, _desired
+                "overriding jax_platforms=%r with $JAX_PLATFORMS=%r", current, desired
             )
         except AttributeError:  # pragma: no cover - config renamed
             pass
+
+
+honor_platform_env()
