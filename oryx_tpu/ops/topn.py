@@ -440,6 +440,72 @@ def upload_queries(queries: np.ndarray) -> jax.Array:
     return jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
 
 
+def upload_random(
+    n_items: int,
+    num_features: int,
+    dtype=None,
+    seed: int = 0,
+    streaming: bool | None = None,
+):
+    """Benchmark helper: a random item matrix generated ON DEVICE, in the
+    same handle form as :func:`upload`. A 20M x 250 bf16 matrix is 10 GB;
+    generating it device-side means those bytes never cross the
+    host<->device link (minutes of tunnel upload in the load-test setups
+    of docs/performance.md's 5M/20M-item rows) and never cost host RAM."""
+    if streaming is None:
+        streaming = _default_streaming()
+    dtype = dtype or jnp.float32
+    key = jax.random.PRNGKey(seed)
+    if streaming:
+        from oryx_tpu.ops.pallas_topn import BLOCK_N
+
+        n_pad = max(BLOCK_N, ((n_items + BLOCK_N - 1) // BLOCK_N) * BLOCK_N)
+        mat_t, norms = _gen_streaming_random(key, num_features, n_pad, n_items, dtype)
+        return StreamingItemMatrix(mat_t=mat_t, norms=norms, n_items=n_items)
+    mat, norms = _gen_plain_random(key, n_items, num_features, dtype)
+    return mat, norms
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnums=3)
+def _fill_normal_block(buf, key, start, width):
+    blk = jax.random.normal(key, (buf.shape[0], width), dtype=buf.dtype)
+    return jax.lax.dynamic_update_slice(buf, blk, (0, start))
+
+
+@functools.partial(jax.jit, donate_argnums=0, static_argnums=2)
+def _mask_and_norms(mat_t, n_items_arr, n_pad):
+    mask = (jnp.arange(n_pad) < n_items_arr)[None, :]
+    mat_t = jnp.where(mask, mat_t, jnp.zeros((), dtype=mat_t.dtype))
+    norms = jnp.sqrt(
+        jnp.sum(jnp.square(mat_t.astype(jnp.float32)), axis=0, keepdims=True)
+    )
+    return mat_t, norms
+
+
+def _gen_streaming_random(key, num_features, n_pad, n_items, dtype):
+    # Chunked fill with buffer donation: generating a [250, 20M] matrix in
+    # one call would materialize the RNG bit tensor next to the output
+    # (2x peak); 2M-column blocks bound the transient to ~1 GB while the
+    # donated buffer stays in place.
+    chunk = min(n_pad, 2_000_000)
+    buf = jnp.zeros((num_features, n_pad), dtype=dtype)
+    starts = list(range(0, n_pad, chunk))
+    keys = jax.random.split(key, len(starts))
+    for i, start in enumerate(starts):
+        # keep the block width static for one compiled fill: clamp the
+        # last start back so the block fits (the overlap is re-randomized,
+        # which is harmless for benchmark data)
+        buf = _fill_normal_block(buf, keys[i], min(start, n_pad - chunk), chunk)
+    return _mask_and_norms(buf, jnp.int32(n_items), n_pad)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _gen_plain_random(key, n_items, num_features, dtype):
+    mat = jax.random.normal(key, (n_items, num_features), dtype=dtype)
+    norms = jnp.linalg.norm(mat.astype(jnp.float32), axis=1)
+    return mat, norms
+
+
 @jax.jit
 def _scatter_query_rows(x_dev, rows, vals):
     return x_dev.at[rows].set(vals)

@@ -164,3 +164,46 @@ def test_indexed_submit_matches_vector_submit():
     np.testing.assert_array_equal(i3, i4)
     np.testing.assert_allclose(v3, v4, rtol=1e-2)
     assert v1.dtype == np.float32 and v3.dtype == np.float32
+
+
+def test_upload_random_device_generated_matches_host_topk():
+    """upload_random builds the same handle forms as upload() without a
+    host matrix; top-k through it must equal host top-k on the downloaded
+    matrix, and padded columns must be zero (never winning top-k)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from oryx_tpu.ops import topn as topn_ops
+
+    gen = np.random.default_rng(11)
+    q = gen.standard_normal((4, 8)).astype(np.float32)
+
+    # streaming (feature-major) handle, chunked device fill
+    ups = topn_ops.upload_random(700, 8, dtype=jnp.float32, seed=3, streaming=True)
+    assert ups.n_items == 700
+    mat = np.asarray(ups.mat_t, dtype=np.float32)
+    assert (mat[:, 700:] == 0).all()
+    np.testing.assert_allclose(
+        np.asarray(ups.norms)[0, :700], np.linalg.norm(mat[:, :700], axis=0), rtol=1e-5
+    )
+    idx, vals = topn_ops.top_k_scores_batch(ups, q, 5)
+    scores = q @ mat[:, :700]
+    expect = np.argsort(-scores, axis=1)[:, :5]
+    np.testing.assert_array_equal(np.sort(idx, axis=1), np.sort(expect, axis=1))
+    np.testing.assert_allclose(
+        np.sort(vals, axis=1), np.sort(np.take_along_axis(scores, expect, 1), axis=1), rtol=1e-5
+    )
+
+    # plain XLA handle
+    upx = topn_ops.upload_random(700, 8, dtype=jnp.float32, seed=3, streaming=False)
+    matx, norms = np.asarray(upx[0]), np.asarray(upx[1])
+    np.testing.assert_allclose(norms, np.linalg.norm(matx, axis=1), rtol=1e-5)
+    idx2, vals2 = topn_ops.top_k_scores_batch(upx, q, 5)
+    scores2 = q @ matx.T
+    expect2 = np.argsort(-scores2, axis=1)[:, :5]
+    np.testing.assert_array_equal(np.sort(idx2, axis=1), np.sort(expect2, axis=1))
+    np.testing.assert_allclose(
+        np.sort(vals2, axis=1),
+        np.sort(np.take_along_axis(scores2, expect2, 1), axis=1),
+        rtol=1e-5,
+    )
