@@ -65,11 +65,13 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 # r05 CPU-container floors (docs/performance.md, identical configs,
-# two-run steady-state protocol — same protocol as the TPU side)
-CPU_FLOOR_ALS_WALL = 4.3
-CPU_FLOOR_ALS_SCALE_RPS = 227_000.0
-CPU_FLOOR_KMEANS_WALL = 0.6
-CPU_FLOOR_RDF_WALL = 34.3
+# re-measured 2026-07-30 under the SAME two-run steady-state protocol as
+# the TPU side — the r02 floors mixed compile-inclusive single runs into
+# the denominators)
+CPU_FLOOR_ALS_WALL = 4.5
+CPU_FLOOR_ALS_SCALE_RPS = 240_000.0
+CPU_FLOOR_KMEANS_WALL = 0.3
+CPU_FLOOR_RDF_WALL = 18.7
 SPEED_TARGET_EPS = 100_000.0
 
 # Published /recommend qps at LSH sample-rate 0.3 on a 32-core Xeon

@@ -110,6 +110,7 @@ class ALSServingModel(ServingModel):
         self._x_built_at = 0.0
         self._x_capacity = 0
         self._x_building = False
+        self._x_restage_thread: threading.Thread | None = None
         self._x_epoch = 0  # bumped by rotation: invalidates in-flight restages
 
     # -- vectors -------------------------------------------------------------
@@ -374,9 +375,14 @@ class ALSServingModel(ServingModel):
                     len(ids), self.features,
                 )
                 with self._cache_lock:
+                    # flip + drain under the same lock that set_user_vector
+                    # appends dirty ids under, so no stale dirty set is
+                    # retained for the model's lifetime after the disable
                     self._x_matrix = None
                     self._x_capacity = 0
-                self._x_staging = False
+                    self._x_staging = False
+                    self._x_dirty_ids.clear()
+                    self._x_dirty = False
                 return
             if len(ids):
                 # pad capacity so a trickle of new users appends via
@@ -437,7 +443,19 @@ class ALSServingModel(ServingModel):
             row = None if stale else self._x_index.get(user)
             x_mat = self._x_matrix
         if rebuild_dirty is not None:
-            self._rebuild_x_staging(rebuild_dirty, rebuild_epoch)
+            # run the restage (to_matrix + up to multi-GB upload) on a
+            # daemon thread: the request that trips the refresh tick falls
+            # through to the vector path instead of paying seconds of
+            # latency; _x_building (set under the lock above) already
+            # serializes builds, so at most one thread runs this
+            t = threading.Thread(
+                target=self._rebuild_x_staging,
+                args=(rebuild_dirty, rebuild_epoch),
+                name="als-x-restage",
+                daemon=True,
+            )
+            self._x_restage_thread = t  # joinable: tests + orderly close
+            t.start()
         if row is None:
             return None, None
         return x_mat, row

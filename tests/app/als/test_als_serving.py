@@ -453,10 +453,11 @@ def test_top_n_for_user_index_submit_and_freshness():
         m.set_item_vectors(
             [f"i{i}" for i in range(50)], gen.standard_normal((50, 4)).astype(np.float32)
         )
-        # the first request triggers the out-of-lock restage and serves
+        # the first request triggers the background restage and serves
         # via the vector path; once staged, requests go indexed
         m.top_n_for_user("u3", 5)
         assert calls == {"indexed": 0, "vector": 1}
+        m._x_restage_thread.join(30)
         r_idx = m.top_n_for_user("u3", 5)
         assert calls == {"indexed": 1, "vector": 1}
         r_vec = m.top_n(m.get_user_vector("u3"), 5)
@@ -475,7 +476,8 @@ def test_top_n_for_user_index_submit_and_freshness():
         m2.set_item_vectors(
             [f"i{i}" for i in range(9)], gen.standard_normal((9, 4)).astype(np.float32)
         )
-        m2.top_n_for_user("u1", 3)  # builds + stages X
+        m2.top_n_for_user("u1", 3)  # triggers the background X restage
+        m2._x_restage_thread.join(30)
         base = dict(calls)
         fresh_vec = gen.standard_normal(4).astype(np.float32)
         m2.set_user_vector("u1", fresh_vec)  # dirty; refresh not due
@@ -509,6 +511,8 @@ def test_device_x_append_rotation_and_disabled_tracking():
         [f"i{i}" for i in range(9)], gen.standard_normal((9, 4)).astype(np.float32)
     )
     assert m.top_n_for_user("u1", 3)
+    m._x_restage_thread.join(30)
+    assert m.top_n_for_user("u1", 3)  # staged now: rides the device matrix
     cap = m._x_capacity
     assert cap >= 8
     m.set_user_vector("uNEW", gen.standard_normal(4).astype(np.float32))
@@ -562,6 +566,9 @@ def test_rotation_during_x_restage_discards_stale_snapshot():
     m.retain_recent_and_user_ids(set())  # first keeps recent writes
     m.retain_recent_and_user_ids(set())  # second drains the store
     t.join()
+    restage = m._x_restage_thread
+    if restage is not None:
+        restage.join(30)  # the build itself now runs on a daemon thread
     # whichever way the interleaving lands (swap discarded by the epoch
     # check, or the build won the race and rotation invalidated after),
     # the rebuild must be pending and the removed user must 404 (None) —
