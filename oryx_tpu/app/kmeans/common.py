@@ -80,11 +80,12 @@ def clusters_to_pmml(clusters: Sequence[ClusterInfo], schema: InputSchema) -> El
     184-221)."""
     root = pmml_io.build_skeleton_pmml()
     app_pmml.build_data_dictionary(root, schema)
+    # no modelName: the reference constructs ClusteringModel(<function>,
+    # <modelClass>, <n>, ...) without one (KMeansUpdate.java:214-221)
     model = pmml_io.sub(
         root,
         "ClusteringModel",
         {
-            "modelName": "k-means clustering",
             "functionName": "clustering",
             "modelClass": "centerBased",
             "numberOfClusters": str(len(clusters)),
@@ -95,7 +96,9 @@ def clusters_to_pmml(clusters: Sequence[ClusterInfo], schema: InputSchema) -> El
     pmml_io.sub(cm, "squaredEuclidean")
     for i, name in enumerate(schema.feature_names):
         if schema.is_active(i):
-            pmml_io.sub(model, "ClusteringField", {"field": name})
+            pmml_io.sub(
+                model, "ClusteringField", {"field": name, "centerField": "true"}
+            )
     for c in clusters:
         cl = pmml_io.sub(model, "Cluster", {"id": str(c.id), "size": str(int(c.count))})
         arr = pmml_io.sub(

@@ -67,44 +67,53 @@ def get_required_extension_value(root: Element, name: str) -> str:
 def build_data_dictionary(
     root: Element, schema: InputSchema, encodings: CategoricalValueEncodings | None = None
 ) -> Element:
-    """DataDictionary from schema (AppPMMLUtils.buildDataDictionary:128-166)."""
+    """DataDictionary from schema (AppPMMLUtils.buildDataDictionary:195-227).
+
+    Mirrors the reference's field set exactly: EVERY feature gets a
+    DataField — id/ignored features as bare fields with no optype or
+    dataType — and numberOfFields counts them all, so a document written
+    here is column-for-column what the reference's JAXB writer emits."""
     dd = pmml_io.sub(root, "DataDictionary")
-    n = 0
     for i, name in enumerate(schema.feature_names):
-        if not schema.is_active(i):
-            continue
-        n += 1
         if schema.is_numeric(i):
             pmml_io.sub(dd, "DataField", {"name": name, "optype": "continuous", "dataType": "double"})
-        else:
+        elif schema.is_categorical(i):
             df = pmml_io.sub(dd, "DataField", {"name": name, "optype": "categorical", "dataType": "string"})
             if encodings is not None:
                 for v, _ in sorted(
                     encodings.value_to_index_map(i).items(), key=lambda kv: kv[1]
                 ):
                     pmml_io.sub(df, "Value", {"value": v})
-    dd.set("numberOfFields", str(n))
+        else:
+            pmml_io.sub(dd, "DataField", {"name": name})
+    dd.set("numberOfFields", str(len(schema.feature_names)))
     return dd
 
 
 def build_mining_schema(
     parent: Element, schema: InputSchema, importances: list[float] | None = None
 ) -> Element:
-    """MiningSchema with target marked predicted, others active, with
-    optional per-predictor importances (AppPMMLUtils.buildMiningSchema:
-    168-206)."""
+    """MiningSchema mirroring AppPMMLUtils.buildMiningSchema:140-171:
+    every feature appears; numeric/categorical actives carry optype +
+    usageType=active, id/ignored features usageType=supplementary (no
+    optype), the target's usageType is overridden to predicted, and
+    importances land on active predictor fields."""
     ms = pmml_io.sub(parent, "MiningSchema")
     for i, name in enumerate(schema.feature_names):
-        if not schema.is_active(i):
-            continue
         attrs = {"name": name}
+        if schema.is_numeric(i):
+            attrs["optype"] = "continuous"
+            attrs["usageType"] = "active"
+        elif schema.is_categorical(i):
+            attrs["optype"] = "categorical"
+            attrs["usageType"] = "active"
+        else:
+            attrs["usageType"] = "supplementary"
         if schema.is_target(i):
             attrs["usageType"] = "predicted"
-        else:
-            attrs["usageType"] = "active"
-            if importances is not None:
-                p = schema.feature_to_predictor_index(i)
-                attrs["importance"] = repr(float(importances[p]))
+        if attrs["usageType"] == "active" and importances is not None:
+            p = schema.feature_to_predictor_index(i)
+            attrs["importance"] = repr(float(importances[p]))
         pmml_io.sub(ms, "MiningField", attrs)
     return ms
 

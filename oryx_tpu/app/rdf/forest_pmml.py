@@ -24,53 +24,71 @@ def forest_to_pmml(
     schema: InputSchema,
     encodings: CategoricalValueEncodings,
 ) -> Element:
+    """Document layout matches RDFUpdate.rdfModelToPMML:369-423 +
+    toTreeModel:424-516 element-for-element: DataDictionary then a
+    MiningModel (multi-tree) or bare TreeModel (single tree), Segments
+    carrying an un-schema'd TreeModel with splitCharacteristic=
+    binarySplit and missingValueStrategy=defaultChild, root Extensions
+    last. Feature importances ride MiningField importance attributes (the
+    reference's channel) plus the round-trip `importances` extension."""
     root = pmml_io.build_skeleton_pmml()
-    if forest.feature_importances is not None:
-        app_pmml.add_extension_content(
-            root, "importances", [repr(float(v)) for v in forest.feature_importances]
-        )
     app_pmml.build_data_dictionary(root, schema, encodings)
     classification = schema.target_feature is not None and schema.is_categorical(
         schema.target_feature
     )
     function = "classification" if classification else "regression"
-    mm = pmml_io.sub(
-        root, "MiningModel", {"modelName": "randomDecisionForest", "functionName": function}
+    importances = (
+        list(forest.feature_importances) if forest.feature_importances is not None else None
     )
-    app_pmml.build_mining_schema(
-        mm,
-        schema,
-        list(forest.feature_importances) if forest.feature_importances is not None else None,
-    )
-    seg = pmml_io.sub(
-        mm,
-        "Segmentation",
-        {"multipleModelMethod": "weightedAverage" if not classification else "weightedMajorityVote"},
-    )
-    for i, (tree, weight) in enumerate(zip(forest.trees, forest.weights)):
-        s = pmml_io.sub(seg, "Segment", {"id": str(i), "weight": repr(float(weight))})
-        pmml_io.sub(s, "True")
-        tm = pmml_io.sub(
-            s, "TreeModel", {"functionName": function, "splitCharacteristic": "binarySplit"}
+    tree_attrs = {
+        "splitCharacteristic": "binarySplit",
+        "missingValueStrategy": "defaultChild",
+    }
+    if len(forest.trees) == 1:
+        tm = pmml_io.sub(root, "TreeModel", {"functionName": function, **tree_attrs})
+        app_pmml.build_mining_schema(tm, schema, importances)
+        _write_node(tm, forest.trees[0].root, None, schema, encodings, classification)
+    else:
+        mm = pmml_io.sub(root, "MiningModel", {"functionName": function})
+        app_pmml.build_mining_schema(mm, schema, importances)
+        seg = pmml_io.sub(
+            mm,
+            "Segmentation",
+            {
+                "multipleModelMethod": "weightedMajorityVote"
+                if classification
+                else "weightedAverage"
+            },
         )
-        app_pmml.build_mining_schema(tm, schema)
-        _write_node(tm, tree.root, None, schema, encodings, classification)
+        for i, (tree, weight) in enumerate(zip(forest.trees, forest.weights)):
+            s = pmml_io.sub(seg, "Segment", {"id": str(i), "weight": repr(float(weight))})
+            pmml_io.sub(s, "True")
+            # segment TreeModels carry no MiningSchema or functionName of
+            # their own, exactly like the reference's inner toTreeModel
+            tm = pmml_io.sub(s, "TreeModel", dict(tree_attrs))
+            _write_node(tm, tree.root, None, schema, encodings, classification)
+    if forest.feature_importances is not None:
+        app_pmml.add_extension_content(
+            root, "importances", [repr(float(v)) for v in forest.feature_importances]
+        )
     return root
 
 
+def _node_count(node) -> float:
+    return float(node.prediction.count if node.is_terminal() else node.record_count)
+
+
 def _write_node(parent, node, predicate_writer, schema, encodings, classification) -> None:
-    attrs = {"id": node.id}
-    if node.is_terminal():
-        pred = node.prediction
-        if classification:
-            tfi = schema.target_feature_index
-            attrs["score"] = encodings.value_for(tfi, pred.most_probable_index)
-            attrs["recordCount"] = repr(float(pred.count))
-        else:
-            attrs["score"] = repr(float(pred.prediction))
-            attrs["recordCount"] = repr(float(pred.count))
-    else:
-        attrs["recordCount"] = repr(float(node.record_count))
+    attrs = {"id": node.id, "recordCount": repr(_node_count(node))}
+    if node.is_terminal() and not classification:
+        # classification leaves carry only ScoreDistributions, exactly
+        # like toTreeModel:458-487 (no score attribute)
+        attrs["score"] = repr(float(node.prediction.prediction))
+    if not node.is_terminal():
+        # defaultChild = the heavier branch, the reference's missing-value
+        # routing (toTreeModel:494-499)
+        heavier_positive = _node_count(node.positive) > _node_count(node.negative)
+        attrs["defaultChild"] = node.positive.id if heavier_positive else node.negative.id
     el = pmml_io.sub(parent, "Node", attrs)
     if predicate_writer is None:
         pmml_io.sub(el, "True")
@@ -79,12 +97,16 @@ def _write_node(parent, node, predicate_writer, schema, encodings, classificatio
     if node.is_terminal():
         if classification:
             tfi = schema.target_feature_index
+            total = max(1.0, float(node.prediction.counts.sum()))
             for ci, cnt in enumerate(node.prediction.counts):
-                pmml_io.sub(
+                if cnt <= 0:
+                    continue  # zero-probability rows omitted (toTreeModel:478)
+                sd = pmml_io.sub(
                     el,
                     "ScoreDistribution",
                     {"value": encodings.value_for(tfi, ci), "recordCount": repr(float(cnt))},
                 )
+                sd.set("confidence", repr(float(cnt) / total))
         return
     d = node.decision
     feature_index = schema.predictor_to_feature_index(d.feature)
@@ -108,8 +130,12 @@ def _write_node(parent, node, predicate_writer, schema, encodings, classificatio
             arr = pmml_io.sub(sp, "Array", {"n": str(len(vals)), "type": "string"})
             arr.text = " ".join(_quote(v) for v in vals)
 
-    _write_node(el, node.negative, neg, schema, encodings, classification)
+    # the positive (predicate-carrying) child comes FIRST: PMML evaluates
+    # predicates in document order, and the negative child's True would
+    # otherwise always match (RDFUpdate.toTreeModel:500-505 — "Right node
+    # is 'positive', so carries the predicate. It must evaluate first")
     _write_node(el, node.positive, pos, schema, encodings, classification)
+    _write_node(el, node.negative, neg, schema, encodings, classification)
 
 
 def _quote(v: str) -> str:
@@ -133,19 +159,30 @@ def pmml_to_forest(
     """Inverse of forest_to_pmml (RDFPMMLUtils.read)."""
     encodings = app_pmml.build_categorical_encodings(root, schema)
     mm = pmml_io.find(root, "MiningModel")
-    if mm is None:
-        raise ValueError("no MiningModel in PMML")
-    classification = mm.get("functionName") == "classification"
+    classification = schema.target_feature is not None and schema.is_categorical(
+        schema.target_feature
+    )
     tfi = schema.target_feature_index
     num_classes = encodings.category_count(tfi) if classification else 0
     trees, weights = [], []
-    seg = pmml_io.find(mm, "Segmentation")
     importances = app_pmml.get_extension_content(root, "importances")
-    for s in pmml_io.findall(seg, "Segment"):
-        weights.append(float(s.get("weight", "1")))
-        tm = pmml_io.find(s, "TreeModel")
+    if mm is None:
+        # single-tree documents carry a bare TreeModel (RDFUpdate:383-384)
+        tm = pmml_io.find(root, "TreeModel")
+        if tm is None:
+            raise ValueError("no MiningModel or TreeModel in PMML")
         node_el = pmml_io.find(tm, "Node")
-        trees.append(T.DecisionTree(_read_node(node_el, schema, encodings, classification, num_classes)))
+        trees.append(
+            T.DecisionTree(_read_node(node_el, schema, encodings, classification, num_classes))
+        )
+        weights.append(1.0)
+    else:
+        seg = pmml_io.find(mm, "Segmentation")
+        for s in pmml_io.findall(seg, "Segment"):
+            weights.append(float(s.get("weight", "1")))
+            tm = pmml_io.find(s, "TreeModel")
+            node_el = pmml_io.find(tm, "Node")
+            trees.append(T.DecisionTree(_read_node(node_el, schema, encodings, classification, num_classes)))
     fi = np.asarray([float(v) for v in importances]) if importances else None
     return T.DecisionForest(trees, weights, fi), encodings
 
@@ -165,8 +202,12 @@ def _read_node(el, schema, encodings, classification, num_classes):
             node_id, T.NumericPrediction(float(el.get("score", "0")), int(rc)), int(rc)
         )
     assert len(children) == 2, "binary trees expected"
-    neg_el, pos_el = children
-    # the positive child carries the defining predicate
+    # the positive child is the one carrying a real predicate; the
+    # negative child carries True. The reference writes positive first
+    # (document order = evaluation order) but identify by predicate, like
+    # RDFPMMLUtils.translateFromPMML:206-224, to accept either layout.
+    first_true = pmml_io.find(children[0], "True") is not None
+    pos_el, neg_el = (children[1], children[0]) if first_true else (children[0], children[1])
     decision = _read_predicate(pos_el, schema, encodings)
     negative = _read_node(neg_el, schema, encodings, classification, num_classes)
     positive = _read_node(pos_el, schema, encodings, classification, num_classes)

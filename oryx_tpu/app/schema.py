@@ -37,11 +37,15 @@ class InputSchema:
         if (numeric is None) == (categorical is None):
             raise ConfigError("set exactly one of numeric-features / categorical-features")
         active = [n for n in names if n not in id_f and n not in ignored]
+        # type declarations apply to ACTIVE features only (the reference
+        # REJECTS declared sets that aren't subsets of the actives,
+        # InputSchema.java:89-101; we normalize instead of erroring so an
+        # id/ignored feature is never numeric nor categorical either way)
         if numeric is not None:
-            self._numeric = set(numeric)
+            self._numeric = set(numeric) & set(active)
             self._categorical = {n for n in active if n not in self._numeric}
         else:
-            self._categorical = set(categorical)
+            self._categorical = set(categorical) & set(active)
             self._numeric = {n for n in active if n not in self._categorical}
 
         self.target_feature = config.get_optional_string("oryx.input-schema.target-feature")

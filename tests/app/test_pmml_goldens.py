@@ -1,0 +1,364 @@
+"""Golden-file PMML interop tests.
+
+Each golden below is the document the REFERENCE's model writer would emit
+for the same model (shapes hand-derived from ALSUpdate.mfModelToPMML:359-395,
+KMeansUpdate.kMeansModelToPMML:184-221 and RDFUpdate.rdfModelToPMML:369-423 /
+toTreeModel:424-516, with AppPMMLUtils.buildDataDictionary:195-227 and
+buildMiningSchema:140-171). The rebuild's writers must match
+attribute-for-attribute — element names, attribute names and values, child
+order (PMML evaluates Node predicates in document order, so order is
+semantics) — modulo the Header (timestamp/app version vary by run) and XML
+attribute ordering (canonicalized away).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.app.schema import CategoricalValueEncodings, InputSchema
+from oryx_tpu.common import config as C
+from oryx_tpu.common import pmml as pmml_io
+
+
+def _schema(overlay: str) -> InputSchema:
+    return InputSchema(C.get_default().with_overlay(overlay))
+
+
+def _canonical_sans_header(root_or_text) -> str:
+    """Canonical XML with the Header subtree dropped (its Timestamp and
+    Application version legitimately differ run to run)."""
+    if isinstance(root_or_text, str):
+        root = ET.fromstring(root_or_text)
+    else:
+        root = ET.fromstring(pmml_io.to_string(root_or_text))
+    for header in root.findall(pmml_io.q("Header")):
+        root.remove(header)
+    for el in root.iter():  # drop pretty-printing whitespace, keep real text
+        if el.text is not None and not el.text.strip():
+            el.text = None
+        if el.tail is not None and not el.tail.strip():
+            el.tail = None
+    return ET.canonicalize(ET.tostring(root, encoding="unicode"))
+
+
+def assert_matches_golden(document, golden: str) -> None:
+    got = _canonical_sans_header(document)
+    want = _canonical_sans_header(golden)
+    assert got == want, f"\n--- got ---\n{got}\n--- want ---\n{want}"
+
+
+# ---------------------------------------------------------------------------
+# k-means: ClusteringModel (KMeansUpdate.kMeansModelToPMML:184-221)
+# ---------------------------------------------------------------------------
+
+
+KMEANS_GOLDEN = """
+<PMML xmlns="http://www.dmg.org/PMML-4_2" version="4.2.1">
+ <DataDictionary numberOfFields="3">
+  <DataField name="uid"/>
+  <DataField name="x" optype="continuous" dataType="double"/>
+  <DataField name="y" optype="continuous" dataType="double"/>
+ </DataDictionary>
+ <ClusteringModel functionName="clustering" modelClass="centerBased" numberOfClusters="2">
+  <MiningSchema>
+   <MiningField name="uid" usageType="supplementary"/>
+   <MiningField name="x" optype="continuous" usageType="active"/>
+   <MiningField name="y" optype="continuous" usageType="active"/>
+  </MiningSchema>
+  <ComparisonMeasure kind="distance"><squaredEuclidean/></ComparisonMeasure>
+  <ClusteringField field="x" centerField="true"/>
+  <ClusteringField field="y" centerField="true"/>
+  <Cluster id="0" size="3"><Array n="2" type="real">1.5 2.0</Array></Cluster>
+  <Cluster id="1" size="7"><Array n="2" type="real">-0.5 4.25</Array></Cluster>
+ </ClusteringModel>
+</PMML>
+"""
+
+
+def test_kmeans_clustering_model_golden():
+    from oryx_tpu.app.kmeans import common as km
+
+    schema = _schema(
+        """
+        oryx.input-schema {
+          feature-names = ["uid", "x", "y"]
+          id-features = ["uid"]
+          numeric-features = ["x", "y"]
+        }
+        """
+    )
+    clusters = [
+        km.ClusterInfo(0, np.array([1.5, 2.0]), 3),
+        km.ClusterInfo(1, np.array([-0.5, 4.25]), 7),
+    ]
+    assert_matches_golden(km.clusters_to_pmml(clusters, schema), KMEANS_GOLDEN)
+
+
+# ---------------------------------------------------------------------------
+# RDF classification: MiningModel + Segmentation (RDFUpdate.rdfModelToPMML)
+# ---------------------------------------------------------------------------
+
+
+RDF_CLASSIFICATION_GOLDEN = """
+<PMML xmlns="http://www.dmg.org/PMML-4_2" version="4.2.1">
+ <DataDictionary numberOfFields="4">
+  <DataField name="uid"/>
+  <DataField name="color" optype="categorical" dataType="string">
+   <Value value="red"/><Value value="green"/><Value value="blue"/>
+  </DataField>
+  <DataField name="size" optype="continuous" dataType="double"/>
+  <DataField name="result" optype="categorical" dataType="string">
+   <Value value="yes"/><Value value="no"/>
+  </DataField>
+ </DataDictionary>
+ <MiningModel functionName="classification">
+  <MiningSchema>
+   <MiningField name="uid" usageType="supplementary"/>
+   <MiningField name="color" optype="categorical" usageType="active" importance="0.6"/>
+   <MiningField name="size" optype="continuous" usageType="active" importance="0.4"/>
+   <MiningField name="result" optype="categorical" usageType="predicted"/>
+  </MiningSchema>
+  <Segmentation multipleModelMethod="weightedMajorityVote">
+   <Segment id="0" weight="1.0">
+    <True/>
+    <TreeModel splitCharacteristic="binarySplit" missingValueStrategy="defaultChild">
+     <Node id="r" recordCount="10.0" defaultChild="r-">
+      <True/>
+      <Node id="r+" recordCount="4.0">
+       <SimplePredicate field="size" operator="greaterOrEqual" value="2.5"/>
+       <ScoreDistribution value="yes" recordCount="3.0" confidence="0.75"/>
+       <ScoreDistribution value="no" recordCount="1.0" confidence="0.25"/>
+      </Node>
+      <Node id="r-" recordCount="6.0" defaultChild="r--">
+       <SimplePredicate field="size" operator="lessThan" value="2.5"/>
+       <Node id="r-+" recordCount="2.0">
+        <SimpleSetPredicate field="color" booleanOperator="isIn">
+         <Array n="2" type="string">red blue</Array>
+        </SimpleSetPredicate>
+        <ScoreDistribution value="no" recordCount="2.0" confidence="1.0"/>
+       </Node>
+       <Node id="r--" recordCount="4.0">
+        <SimpleSetPredicate field="color" booleanOperator="isNotIn">
+         <Array n="2" type="string">red blue</Array>
+        </SimpleSetPredicate>
+        <ScoreDistribution value="yes" recordCount="4.0" confidence="1.0"/>
+       </Node>
+      </Node>
+     </Node>
+    </TreeModel>
+   </Segment>
+   <Segment id="1" weight="1.0">
+    <True/>
+    <TreeModel splitCharacteristic="binarySplit" missingValueStrategy="defaultChild">
+     <Node id="r" recordCount="10.0">
+      <True/>
+      <ScoreDistribution value="yes" recordCount="5.0" confidence="0.5"/>
+      <ScoreDistribution value="no" recordCount="5.0" confidence="0.5"/>
+     </Node>
+    </TreeModel>
+   </Segment>
+  </Segmentation>
+ </MiningModel>
+ <Extension name="importances">0.6 0.4 0.0</Extension>
+</PMML>
+"""
+
+
+def _rdf_classification_fixture():
+    from oryx_tpu.app.rdf import tree as T
+
+    schema = _schema(
+        """
+        oryx.input-schema {
+          feature-names = ["uid", "color", "size", "result"]
+          id-features = ["uid"]
+          categorical-features = ["color", "result"]
+          target-feature = "result"
+        }
+        """
+    )
+    encodings = CategoricalValueEncodings({1: ["red", "green", "blue"], 3: ["yes", "no"]})
+    tree0 = T.DecisionTree(
+        T.DecisionNode(
+            "r",
+            T.NumericDecision(1, 2.5),  # predictor 1 = "size"
+            negative=T.DecisionNode(
+                "r-",
+                T.CategoricalDecision(0, frozenset({0, 2})),  # predictor 0 = "color"
+                negative=T.TerminalNode("r--", T.CategoricalPrediction([4.0, 0.0])),
+                positive=T.TerminalNode("r-+", T.CategoricalPrediction([0.0, 2.0])),
+                record_count=6,
+            ),
+            positive=T.TerminalNode("r+", T.CategoricalPrediction([3.0, 1.0])),
+            record_count=10,
+        )
+    )
+    tree1 = T.DecisionTree(T.TerminalNode("r", T.CategoricalPrediction([5.0, 5.0])))
+    forest = T.DecisionForest([tree0, tree1], [1.0, 1.0], np.array([0.6, 0.4, 0.0]))
+    return forest, schema, encodings
+
+
+def test_rdf_classification_mining_model_golden():
+    from oryx_tpu.app.rdf import forest_pmml
+
+    forest, schema, encodings = _rdf_classification_fixture()
+    doc = forest_pmml.forest_to_pmml(forest, schema, encodings)
+    assert_matches_golden(doc, RDF_CLASSIFICATION_GOLDEN)
+
+
+def test_rdf_classification_golden_round_trips():
+    """The reference-shaped document (positive child FIRST) must read back
+    to an equivalent forest — this is the layout reference-written models
+    arrive in over the update topic."""
+    from oryx_tpu.app.rdf import forest_pmml
+
+    forest, schema, encodings = _rdf_classification_fixture()
+    back, enc2 = forest_pmml.pmml_to_forest(
+        pmml_io.from_string(RDF_CLASSIFICATION_GOLDEN), schema
+    )
+    assert len(back.trees) == 2
+    assert enc2.index_to_value_map(3) == {0: "yes", 1: "no"}
+    # routing semantics survive: size >= 2.5 goes positive
+    # size >= 2.5 -> r+ (argmax yes); size < 2.5, color in {red, blue} ->
+    # r-+ (no); size < 2.5, color green -> r-- (yes)
+    for size, color, want in ((3.0, 0, "yes"), (1.0, 0, "no"), (1.0, 1, "yes")):
+        # predictor vector order: color(p0), size(p1), result(p2 target)
+        leaf = back.trees[0].find_terminal([color, size, None])
+        got = enc2.value_for(3, leaf.prediction.most_probable_index)
+        assert got == want, (size, color)
+    np.testing.assert_allclose(back.feature_importances, [0.6, 0.4, 0.0])
+
+
+# ---------------------------------------------------------------------------
+# RDF regression, single tree: bare TreeModel (RDFUpdate:383-384)
+# ---------------------------------------------------------------------------
+
+
+RDF_REGRESSION_GOLDEN = """
+<PMML xmlns="http://www.dmg.org/PMML-4_2" version="4.2.1">
+ <DataDictionary numberOfFields="3">
+  <DataField name="size" optype="continuous" dataType="double"/>
+  <DataField name="weight" optype="continuous" dataType="double"/>
+  <DataField name="value" optype="continuous" dataType="double"/>
+ </DataDictionary>
+ <TreeModel functionName="regression" splitCharacteristic="binarySplit" missingValueStrategy="defaultChild">
+  <MiningSchema>
+   <MiningField name="size" optype="continuous" usageType="active"/>
+   <MiningField name="weight" optype="continuous" usageType="active"/>
+   <MiningField name="value" optype="continuous" usageType="predicted"/>
+  </MiningSchema>
+  <Node id="r" recordCount="5.0" defaultChild="r-">
+   <True/>
+   <Node id="r+" recordCount="2.0" score="3.25">
+    <SimplePredicate field="size" operator="greaterOrEqual" value="1.5"/>
+   </Node>
+   <Node id="r-" recordCount="3.0" score="1.5">
+    <SimplePredicate field="size" operator="lessThan" value="1.5"/>
+   </Node>
+  </Node>
+ </TreeModel>
+</PMML>
+"""
+
+
+def test_rdf_regression_single_tree_golden():
+    from oryx_tpu.app.rdf import forest_pmml, tree as T
+
+    schema = _schema(
+        """
+        oryx.input-schema {
+          feature-names = ["size", "weight", "value"]
+          numeric-features = ["size", "weight", "value"]
+          target-feature = "value"
+        }
+        """
+    )
+    tree = T.DecisionTree(
+        T.DecisionNode(
+            "r",
+            T.NumericDecision(0, 1.5),
+            negative=T.TerminalNode("r-", T.NumericPrediction(1.5, 3)),
+            positive=T.TerminalNode("r+", T.NumericPrediction(3.25, 2)),
+            record_count=5,
+        )
+    )
+    forest = T.DecisionForest([tree], [1.0], None)
+    doc = forest_pmml.forest_to_pmml(forest, schema, CategoricalValueEncodings({}))
+    assert_matches_golden(doc, RDF_REGRESSION_GOLDEN)
+    # and the bare-TreeModel layout reads back
+    back, _ = forest_pmml.pmml_to_forest(pmml_io.from_string(RDF_REGRESSION_GOLDEN), schema)
+    assert len(back.trees) == 1
+    leaf = back.trees[0].find_terminal([2.0, 0.0, None])
+    assert leaf.prediction.prediction == pytest.approx(3.25)
+
+
+# ---------------------------------------------------------------------------
+# ALS: extension-pointer document (ALSUpdate.mfModelToPMML:359-395)
+# ---------------------------------------------------------------------------
+
+
+def test_als_model_extension_layout_golden(tmp_path):
+    from oryx_tpu.app.als.update import ALSUpdate
+    from oryx_tpu.bus.core import KeyMessage
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx.als { implicit = true, no-known-items = false, iterations = 2 }
+        oryx.ml.eval { candidates = 1, test-fraction = 0 }
+        """
+    )
+    update = ALSUpdate(cfg)
+    gen = np.random.default_rng(4)
+    data = [
+        KeyMessage(None, f"u{gen.integers(0, 6)},i{gen.integers(0, 5)},1.0,{t}")
+        for t in range(60)
+    ]
+    doc = update.build_model(data, [2, 0.01, 1.0], tmp_path)
+
+    # extension sequence exactly as mfModelToPMML writes it:
+    # X, Y, features, lambda, implicit, alpha (implicit only), XIDs, YIDs
+    exts = [e for e in doc if e.tag == pmml_io.q("Extension")]
+    assert [e.get("name") for e in exts] == [
+        "X", "Y", "features", "lambda", "implicit", "alpha", "XIDs", "YIDs",
+    ]
+    by_name = {e.get("name"): e for e in exts}
+    assert by_name["X"].get("value") == "X/"
+    assert by_name["Y"].get("value") == "Y/"
+    assert by_name["features"].get("value") == "2"
+    assert by_name["lambda"].get("value") == "0.01"
+    assert by_name["implicit"].get("value") == "true"
+    assert by_name["alpha"].get("value") == "1.0"
+    # ID extensions carry space-delimited content, not a value attribute
+    for key in ("XIDs", "YIDs"):
+        assert by_name[key].get("value") is None
+        assert (by_name[key].text or "").strip()
+    xids = app_pmml.get_extension_content(doc, "XIDs")
+    yids = app_pmml.get_extension_content(doc, "YIDs")
+    assert set(xids) <= {f"u{j}" for j in range(6)}
+    assert set(yids) <= {f"i{j}" for j in range(5)}
+    # the pointed-to factor shards exist under the candidate path
+    assert any((tmp_path / "X").iterdir())
+    assert any((tmp_path / "Y").iterdir())
+    # no model element: the factored model is carried entirely by
+    # extensions + X/-Y/ pointers, like the reference
+    assert pmml_io.find(doc, "MiningModel") is None
+    assert doc.get("version") == "4.2.1"
+
+
+def test_explicit_als_omits_alpha(tmp_path):
+    from oryx_tpu.app.als.update import ALSUpdate
+    from oryx_tpu.bus.core import KeyMessage
+
+    cfg = C.get_default().with_overlay(
+        "oryx.als { implicit = false }, oryx.ml.eval { candidates = 1, test-fraction = 0 }"
+    )
+    update = ALSUpdate(cfg)
+    data = [KeyMessage(None, f"u{j % 4},i{j % 3},{1 + j % 5},{j}") for j in range(40)]
+    doc = update.build_model(data, [2, 0.1, 1.0], tmp_path)
+    exts = [e.get("name") for e in doc if e.tag == pmml_io.q("Extension")]
+    assert exts == ["X", "Y", "features", "lambda", "implicit", "XIDs", "YIDs"]
+    assert app_pmml.get_extension_value(doc, "implicit") == "false"
