@@ -423,9 +423,11 @@ def bench_speed() -> None:
             sys.executable,
             os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
             "--seconds",
-            "25",
+            "30",
             "--prefill",
-            "800000",
+            "1600000",
+            "--batch-events",
+            "400000",
         ],
         capture_output=True,
         text=True,

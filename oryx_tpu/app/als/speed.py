@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from collections import deque
 from typing import Iterable, Iterator
 
 import numpy as np
@@ -119,6 +120,13 @@ class ALSSpeedModelManager(SpeedModelManager):
         self.implicit = config.get_bool("oryx.als.implicit")
         self.no_known_items = config.get_bool("oryx.als.no-known-items")
         self.fold_backend = config.get_string("oryx.speed.fold-in-backend")
+        self.self_apply = config.get_bool("oryx.speed.self-apply")
+        # byte-encoded copies of this instance's own published deltas,
+        # publish order; the consume thread skips exact matches instead
+        # of re-parsing them (the vectors were applied at build time).
+        # Bounded: overflow just means those messages get re-applied.
+        self._self_pending: deque[bytes] = deque()
+        self._self_pending_cap = 600_000
         self.min_model_load_fraction = config.get_float(
             "oryx.speed.min-model-load-fraction"
         )
@@ -142,6 +150,23 @@ class ALSSpeedModelManager(SpeedModelManager):
         )
 
     def _apply_up_batch(self, lines: list[bytes]) -> None:
+        pending = self._self_pending
+        if pending:
+            # skip this instance's own deltas coming back around the
+            # topic: exact byte match against the publish-ordered queue
+            # (single UP partition preserves order). Anything unmatched —
+            # another producer's message, a rotation in between — applies
+            # normally; a missed match merely re-applies an absolute
+            # vector, which is idempotent.
+            rest: list[bytes] = []
+            for ln in lines:
+                if pending and ln == pending[0]:
+                    pending.popleft()
+                else:
+                    rest.append(ln)
+            lines = rest
+            if not lines:
+                return
         model = self.model
         apply_up_lines(
             lines,
@@ -296,13 +321,23 @@ class ALSSpeedModelManager(SpeedModelManager):
             x_msgs = format_update_messages(new_xu[rows_x], x_ids, [], "X", False)
             y_msgs = format_update_messages(new_yi[rows_y], y_ids, [], "Y", False)
         if x_msgs is not None and y_msgs is not None:
-            return x_msgs + y_msgs
-        # pure-Python fallback when the native library is unavailable
-        out: list[str] = []
-        for i, vec in enumerate(format_vectors_json(new_xu[rows_x])):
-            out.append(self._assemble("X", x_ids[i], vec, known_lists[i] if known else None))
-        for i, vec in enumerate(format_vectors_json(new_yi[rows_y])):
-            out.append(self._assemble("Y", y_ids[i], vec, y_known[i] if known else None))
+            out = x_msgs + y_msgs
+        else:
+            # pure-Python fallback when the native library is unavailable
+            out = []
+            for i, vec in enumerate(format_vectors_json(new_xu[rows_x])):
+                out.append(self._assemble("X", x_ids[i], vec, known_lists[i] if known else None))
+            for i, vec in enumerate(format_vectors_json(new_yi[rows_y])):
+                out.append(self._assemble("Y", y_ids[i], vec, y_known[i] if known else None))
+        if self.self_apply and model is self.model:
+            # apply the deltas to this model NOW (they are absolute
+            # vectors computed this batch) and queue their encoded forms
+            # so the consume thread can skip the round-trip re-parse
+            model.set_user_vectors(x_ids, new_xu[rows_x])
+            model.set_item_vectors(y_ids, new_yi[rows_y])
+            room = self._self_pending_cap - len(self._self_pending)
+            if room > 0:
+                self._self_pending.extend(m.encode("utf-8") for m in out[:room])
         return out
 
     def _assemble(

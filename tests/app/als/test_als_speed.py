@@ -83,11 +83,14 @@ def test_build_updates_exact_fold_in():
         KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
         KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
     ])
+    # snapshot the Gramian BEFORE build: with self-apply on, build_updates
+    # folds the deltas into its own model, but published vectors are
+    # always computed from pre-batch state
+    yty = Solver(mgr.model.y.get_vtv())
     updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
     assert len(updates) == 2
     parsed = {json.loads(u)[0]: json.loads(u) for u in updates}
     # verify against direct ALSUtils computation
-    yty = Solver(mgr.model.y.get_vtv())
     expect_xu = compute_updated_xu(
         yty, 3.0, np.array([1.0, 0.0], dtype=np.float32),
         np.array([0.0, 1.0], dtype=np.float32), True)
@@ -205,6 +208,9 @@ def test_build_updates_coalesces_duplicate_ids():
         KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
         KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
     ])
+    # snapshot before build: self-apply folds deltas into the model, but
+    # published vectors are computed from pre-batch state
+    yty = Solver(mgr.model.y.get_vtv())
     updates = list(mgr.build_updates([
         KeyMessage(None, "U1,I2,3.0,1"),
         KeyMessage(None, "U1,I1,-1.0,2"),  # negative pref: target 0.5, updates
@@ -221,7 +227,6 @@ def test_build_updates_coalesces_duplicate_ids():
     # micro-batch aggregator orders by (user, item), so (U1, I2) wins;
     # any serialization of same-user triples (all folded from pre-batch
     # state) is a valid end state
-    yty = Solver(mgr.model.y.get_vtv())
     expect_last = compute_updated_xu(
         yty, 3.0, np.array([1.0, 0.0], dtype=np.float32),
         np.array([0.0, 1.0], dtype=np.float32), True)
@@ -272,3 +277,43 @@ def test_build_updates_gated_on_min_model_load_fraction():
     ])
     assert mgr.model.get_fraction_loaded() >= 0.8
     assert list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
+
+
+def test_self_apply_applies_at_build_and_skips_roundtrip():
+    """With self-apply (default on): build_updates folds its own deltas
+    into the model immediately; when the same messages come back around
+    the update topic the consume path skips them by exact byte match;
+    any non-matching (foreign) UP message still applies normally."""
+    mgr = make_manager(implicit=True)
+    feed(mgr, [KeyMessage("MODEL", model_message())])
+    feed(mgr, [
+        KeyMessage("UP", '["X","U1",[1.0,0.0]]'),
+        KeyMessage("UP", '["X","U2",[0.0,1.0]]'),
+        KeyMessage("UP", '["Y","I1",[1.0,0.0]]'),
+        KeyMessage("UP", '["Y","I2",[0.0,1.0]]'),
+    ])
+    updates = list(mgr.build_updates([KeyMessage(None, "U1,I2,3.0,1")]))
+    assert updates and len(mgr._self_pending) == len(updates)
+    # the delta is already in the model (applied at build time)
+    vec, ok = mgr.model.x.get_batch(["U1"], dim=2)
+    assert ok.all()
+    published = json.loads([u for u in updates if '"X"' in u[:6]][0])
+    np.testing.assert_allclose(vec[0], published[2], rtol=1e-6)
+    # round-trip: exact-match messages are skipped, queue drains,
+    # vector unchanged
+    mgr._apply_up_batch([u.encode("utf-8") for u in updates])
+    assert not mgr._self_pending
+    vec2, _ = mgr.model.x.get_batch(["U1"], dim=2)
+    np.testing.assert_array_equal(vec, vec2)
+    # a foreign UP (not in the pending queue) still applies
+    mgr._apply_up_batch([b'["X","U1",[9.0,9.0]]'])
+    vec3, _ = mgr.model.x.get_batch(["U1"], dim=2)
+    np.testing.assert_array_equal(vec3[0], [9.0, 9.0])
+    # mismatch safety: with something pending, a foreign message in the
+    # stream is applied, not swallowed
+    updates2 = list(mgr.build_updates([KeyMessage(None, "U2,I1,2.0,5")]))
+    assert mgr._self_pending
+    mgr._apply_up_batch([b'["X","U1",[3.0,3.0]]'])
+    vec4, _ = mgr.model.x.get_batch(["U1"], dim=2)
+    np.testing.assert_array_equal(vec4[0], [3.0, 3.0])
+    assert mgr._self_pending  # own deltas still queued, not mismatched away

@@ -69,6 +69,13 @@ def main() -> None:
         "on a 1-core host where producers and the layer share the core)",
     )
     ap.add_argument("--backend", default="auto", choices=["auto", "host", "device"])
+    ap.add_argument(
+        "--batch-events",
+        type=int,
+        default=400_000,
+        help="micro-batch cap; larger batches amortize per-batch fixed "
+        "costs (poll timeouts, producer open, GIL handoffs)",
+    )
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
 
@@ -106,7 +113,7 @@ def main() -> None:
         oryx.input-topic.broker = "{locator}"
         oryx.update-topic.broker = "{locator}"
         oryx.speed.streaming.generation-interval-sec = 3600
-        oryx.speed.streaming.max-batch-events = 200000
+        oryx.speed.streaming.max-batch-events = {args.batch_events}
         """
     )
     layer = SpeedLayer(cfg)
