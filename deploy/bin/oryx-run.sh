@@ -1,0 +1,75 @@
+#!/usr/bin/env bash
+# Launcher for the TPU-native rebuild's layers — the role of the
+# reference's deploy/bin/oryx-run.sh:194-286 (spark-submit / YARN
+# distributed shell), re-targeted at TPU-VM / container hosts: layers are
+# plain processes (python -m oryx_tpu <layer>) and cluster placement is
+# handled by the GKE manifests in deploy/gke/ or by running this script
+# on each host.
+#
+#   oryx-run.sh command [--option value] ...
+#     command: batch | speed | serving | bus-serve | bus-setup |
+#              bus-tail | bus-input | all
+#     --conf        Oryx config file (default: ./oryx.conf)
+#     --app-dir     extra dir on sys.path for config-named app classes
+#                   (the --app-jar analogue)
+#     --set         KEY=VALUE config override; repeatable
+#     --input-file  for bus-input
+#     --bind        for bus-serve (default 0.0.0.0:6378)
+#     --data-dir    for bus-serve (topic log directory on this host)
+#     --foreground  run in the foreground (default: nohup to logs/)
+#
+# `all` stands up a single-host pipeline: bus-serve + batch + speed +
+# serving, each as its own process with logs under ./logs/ — the
+# quick-start topology for one TPU VM (docs/admin.md).
+
+set -euo pipefail
+
+COMMAND="${1:-}"
+[ -n "${COMMAND}" ] || { grep '^#   ' "$0" | sed 's/^#   //'; exit 1; }
+shift
+
+CONF="oryx.conf"
+FOREGROUND=0
+PASS_ARGS=()
+while (($#)); do
+  case "$1" in
+    --conf)       CONF="$2"; PASS_ARGS+=(--conf "$2"); shift 2 ;;
+    --foreground) FOREGROUND=1; shift ;;
+    --app-dir|--set|--input-file|--bind|--data-dir)
+                  PASS_ARGS+=("$1" "$2"); shift 2 ;;
+    *) echo "unknown option $1"; exit 1 ;;
+  esac
+done
+
+PY="${ORYX_PYTHON:-python3}"
+LOG_DIR="${ORYX_LOG_DIR:-logs}"
+mkdir -p "${LOG_DIR}"
+
+launch() {  # launch <name> <subcommand...>
+  local name="$1"; shift
+  if [ "${FOREGROUND}" = "1" ]; then
+    exec "${PY}" -m oryx_tpu "$@"
+  fi
+  nohup "${PY}" -m oryx_tpu "$@" >"${LOG_DIR}/${name}.log" 2>&1 &
+  echo $! > "${LOG_DIR}/${name}.pid"
+  echo "${name}: pid $(cat "${LOG_DIR}/${name}.pid") log ${LOG_DIR}/${name}.log"
+}
+
+case "${COMMAND}" in
+  batch|speed|serving|bus-serve)
+    launch "${COMMAND}" "${COMMAND}" "${PASS_ARGS[@]}"
+    ;;
+  bus-setup|bus-tail|bus-input)
+    exec "${PY}" -m oryx_tpu "${COMMAND}" "${PASS_ARGS[@]}"
+    ;;
+  all)
+    # single-host pipeline; bus topics must exist before layers attach
+    "${PY}" -m oryx_tpu bus-setup "${PASS_ARGS[@]}"
+    launch serving serving "${PASS_ARGS[@]}"
+    launch speed   speed   "${PASS_ARGS[@]}"
+    launch batch   batch   "${PASS_ARGS[@]}"
+    echo "pipeline up; stop with: kill \$(cat ${LOG_DIR}/*.pid)"
+    ;;
+  *)
+    echo "unknown command ${COMMAND}"; exit 1 ;;
+esac

@@ -167,11 +167,24 @@ def get_broker(locator: str) -> Broker:
 
     inproc://<name> — process-local named broker (tests, single-process runs)
     file:/<dir> or file://<dir> or a bare path — file-backed broker
+    tcp://host:port — networked bus server (oryx_tpu.bus.netbus; start one
+        with `python -m oryx_tpu bus-serve`)
+    kafka://host:port[,host:port...] — Apache Kafka via kafka-python
+        (optional dependency; oryx_tpu.bus.kafkabus)
     """
     if locator.startswith("inproc://"):
         from oryx_tpu.bus.inproc import InProcessBroker
 
         return InProcessBroker.named(locator[len("inproc://") :])
+    if locator.startswith("tcp://"):
+        from oryx_tpu.bus.netbus import NetBroker
+
+        host, _, port = locator[len("tcp://") :].partition(":")
+        return NetBroker(host, int(port))
+    if locator.startswith("kafka://"):
+        from oryx_tpu.bus.kafkabus import KafkaBroker
+
+        return KafkaBroker(locator[len("kafka://") :])
     if locator.startswith("file:"):
         path = locator[len("file:") :]
         while path.startswith("//"):

@@ -591,69 +591,7 @@ class _FileConsumer(TopicConsumer):
             time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
 
     def _lines_to_block(self, raw: list[bytes], RecordBlock):
-        # vectorized fast path: a batch is nearly always escape-free,
-        # non-legacy (one memchr over the joined blob) and single-key
-        # ("UP" runs, None-keyed input) — verify every line shares line
-        # 0's key prefix, then strip it with one C-level memcpy view. No
-        # per-line Python: this path carries the 100K+ events/s drain.
-        blob = b"\n".join(raw)
-        if b"\\" not in blob and b'{"k":' not in blob:
-            tab = raw[0].find(b"\t")
-            if tab != -1:
-                pref = raw[0][: tab + 1]
-                arr = np.array(raw, dtype="S")
-                w = arr.dtype.itemsize
-                m = w - len(pref)
-                if m > 0 and bool(np.char.startswith(arr, pref).all()):
-                    body = arr.view("S1").reshape(len(raw), w)[:, len(pref):]
-                    msgs_a = np.ascontiguousarray(body).view(f"S{m}").ravel()
-                    key = pref[:-1]
-                    if key == b"\x00":
-                        return RecordBlock(None, msgs_a)  # no key column
-                    return RecordBlock(
-                        np.full(len(raw), key, dtype=f"S{max(1, len(key))}"),
-                        msgs_a,
-                        None,
-                    )
-        msgs: list[bytes] = []
-        keys: list[bytes] = []
-        nones: list[bool] = []
-        any_key = False
-        for line in raw:
-            if b"\\" not in line and not line.startswith(b'{"k":'):
-                tab = line.find(b"\t")
-                if tab != -1:
-                    kf = line[:tab]
-                    if kf == b"\x00":
-                        keys.append(b"")
-                        nones.append(True)
-                    else:
-                        keys.append(kf)
-                        nones.append(False)
-                        any_key = True
-                    msgs.append(line[tab + 1 :])
-                    continue
-            rec = self._decode_line(line)  # legacy/escaped/corrupt: slow path
-            if rec is None:
-                continue
-            if rec.key is None:
-                keys.append(b"")
-                nones.append(True)
-            else:
-                keys.append(rec.key.encode("utf-8"))
-                nones.append(False)
-                any_key = True
-            msgs.append(rec.message.encode("utf-8"))
-        if not msgs:
-            return None
-        np_msgs = np.array(msgs, dtype="S")
-        if not any_key:
-            return RecordBlock(None, np_msgs)
-        return RecordBlock(
-            np.array(keys, dtype="S"),
-            np_msgs,
-            np.array(nones, dtype=bool) if any(nones) else None,
-        )
+        return _lines_to_block_standalone(raw, RecordBlock)
 
     def positions(self) -> dict[int, int]:
         return dict(self._pos)
@@ -667,3 +605,139 @@ class _FileConsumer(TopicConsumer):
 
     def closed(self) -> bool:
         return self._closed
+
+
+def _lines_to_block_standalone(raw: list[bytes], RecordBlock):
+    # vectorized fast path: a batch is nearly always escape-free,
+    # non-legacy (one memchr over the joined blob) and single-key
+    # ("UP" runs, None-keyed input) — verify every line shares line
+    # 0's key prefix, then strip it with one C-level memcpy view. No
+    # per-line Python: this path carries the 100K+ events/s drain.
+    blob = b"\n".join(raw)
+    if b"\\" not in blob and b'{"k":' not in blob:
+        tab = raw[0].find(b"\t")
+        if tab != -1:
+            pref = raw[0][: tab + 1]
+            arr = np.array(raw, dtype="S")
+            w = arr.dtype.itemsize
+            m = w - len(pref)
+            if m > 0 and bool(np.char.startswith(arr, pref).all()):
+                body = arr.view("S1").reshape(len(raw), w)[:, len(pref):]
+                msgs_a = np.ascontiguousarray(body).view(f"S{m}").ravel()
+                key = pref[:-1]
+                if key == b"\x00":
+                    return RecordBlock(None, msgs_a)  # no key column
+                return RecordBlock(
+                    np.full(len(raw), key, dtype=f"S{max(1, len(key))}"),
+                    msgs_a,
+                    None,
+                )
+    msgs: list[bytes] = []
+    keys: list[bytes] = []
+    nones: list[bool] = []
+    any_key = False
+    for line in raw:
+        if b"\\" not in line and not line.startswith(b'{"k":'):
+            tab = line.find(b"\t")
+            if tab != -1:
+                kf = line[:tab]
+                if kf == b"\x00":
+                    keys.append(b"")
+                    nones.append(True)
+                else:
+                    keys.append(kf)
+                    nones.append(False)
+                    any_key = True
+                msgs.append(line[tab + 1 :])
+                continue
+        rec = _FileConsumer._decode_line(line)  # legacy/escaped/corrupt: slow path
+        if rec is None:
+            continue
+        if rec.key is None:
+            keys.append(b"")
+            nones.append(True)
+        else:
+            keys.append(rec.key.encode("utf-8"))
+            nones.append(False)
+            any_key = True
+        msgs.append(rec.message.encode("utf-8"))
+    if not msgs:
+        return None
+    np_msgs = np.array(msgs, dtype="S")
+    if not any_key:
+        return RecordBlock(None, np_msgs)
+    return RecordBlock(
+        np.array(keys, dtype="S"),
+        np_msgs,
+        np.array(nones, dtype=bool) if any(nones) else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Wire codec for transported record batches (the TCP bus in bus/netbus.py
+# ships batches in the same tab-framed line format as the on-disk
+# segments, so both ends reuse the splitter/decoder above)
+# ---------------------------------------------------------------------------
+
+_NEEDS_ESC_B = re.compile(rb"[\\\t\n\r\x00]")
+
+
+def _enc_field_b(b: bytes) -> bytes:
+    if _NEEDS_ESC_B.search(b) is not None:
+        b = (
+            b.replace(b"\\", b"\\\\")
+            .replace(b"\t", b"\\t")
+            .replace(b"\n", b"\\n")
+            .replace(b"\r", b"\\r")
+            .replace(b"\x00", b"\\0")
+        )
+    return b
+
+
+def _encode_wire_lines(records, slice_bytes: int = 8 << 20):
+    """Yield (blob, count) slices of tab-framed lines for an iterable of
+    (key, message) pairs — the producer-side transport encoding."""
+    lines: list[str] = []
+    size = n = 0
+    last_key: object = _SENTINEL
+    ek = ""
+    for key, message in records:
+        if key is not last_key:
+            ek = "\x00" if key is None else _enc_field(key)
+            last_key = key
+        ln = ek + "\t" + _enc_field(message)
+        lines.append(ln)
+        size += len(ln) + 1
+        n += 1
+        if size >= slice_bytes:
+            yield ("\n".join(lines) + "\n").encode("utf-8"), n
+            lines, size, n = [], 0, 0
+    if lines:
+        yield ("\n".join(lines) + "\n").encode("utf-8"), n
+
+
+def _decode_wire_lines(blob: bytes):
+    """Inverse of _encode_wire_lines: yield (key, message) pairs."""
+    for line in blob.split(b"\n"):
+        if not line:
+            continue
+        rec = _FileConsumer._decode_line(line)
+        if rec is not None:
+            yield rec.key, rec.message
+
+
+def _encode_block_lines(block) -> bytes:
+    """A RecordBlock as a tab-framed line blob (poll response transport)."""
+    msgs = block.messages.tolist()
+    if block.keys is None:
+        return b"".join(b"\x00\t" + _enc_field_b(m) + b"\n" for m in msgs)
+    keys = block.keys.tolist()
+    nones = (
+        block.none_keys.tolist()
+        if block.none_keys is not None
+        else [False] * len(keys)
+    )
+    return b"".join(
+        (b"\x00" if nn else _enc_field_b(k)) + b"\t" + _enc_field_b(m) + b"\n"
+        for k, m, nn in zip(keys, msgs, nones)
+    )

@@ -1,0 +1,337 @@
+"""TCP bus: the file bus served over sockets — a real networked
+transport for multi-host deployments with no shared filesystem.
+
+The reference runs its topics on Kafka (framework/kafka-util/.../
+KafkaUtils.java:57-152). This module provides the deployment shape that
+matters from that: ONE host runs a bus server (`serve()`, or
+``python -m oryx_tpu bus-serve``) holding the topic logs in its local
+FileBroker; every layer process — on any host — speaks ``tcp://host:port``
+through :class:`NetBroker`, which implements the full Broker SPI
+(produce, consume-with-groups, offset ledger, admin). Offsets live in
+the server's ledger, so consumer groups resume across client restarts
+exactly like the file bus (and like Kafka consumer groups).
+
+Wire protocol (deliberately minimal, length-prefixed):
+  request  = u32 header_len | header JSON | u32 payload_len | payload
+  response = same shape; header {"ok": bool, "error": str?, ...}
+Payloads carry batched records in the file bus's tab-framed line format
+(one encode shared with the on-disk segments), so the server's produce
+path is a single append and the consumer's poll_block fast path is the
+same vectorized splitter the file consumer uses.
+
+A Kafka adapter proper (kafka-python client API) lives in
+``oryx_tpu.bus.kafkabus`` for sites that already run Kafka.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Iterable
+
+from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer
+
+log = logging.getLogger(__name__)
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+def _send_frame(sock: socket.socket, header: dict, payload: bytes = b"") -> None:
+    h = json.dumps(header).encode("utf-8")
+    sock.sendall(struct.pack(">II", len(h), len(payload)) + h + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> tuple[dict, bytes]:
+    hlen, plen = struct.unpack(">II", _recv_exact(sock, 8))
+    if hlen > _MAX_FRAME or plen > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame ({hlen}/{plen})")
+    header = json.loads(_recv_exact(sock, hlen)) if hlen else {}
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    """One connection = one client session. Consumers opened on this
+    connection are owned by it and torn down when it drops (a crashed
+    client leaks nothing server-side)."""
+
+    def handle(self) -> None:  # noqa: C901 - a flat op switch
+        broker = self.server.broker  # type: ignore[attr-defined]
+        consumers: dict[int, TopicConsumer] = {}
+        next_cid = 0
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    req, payload = _recv_frame(sock)
+                except (ConnectionError, struct.error):
+                    return
+                op = req.get("op")
+                try:
+                    if op == "produce":
+                        # payload: tab-framed lines, one per record
+                        from oryx_tpu.bus.filebus import _decode_wire_lines
+
+                        records = _decode_wire_lines(payload)
+                        with broker.producer(req["topic"]) as p:
+                            n = p.send_many(records)
+                        _send_frame(sock, {"ok": True, "n": n})
+                    elif op == "consumer_open":
+                        cid = next_cid
+                        next_cid += 1
+                        consumers[cid] = broker.consumer(
+                            req["topic"],
+                            group=req.get("group"),
+                            from_beginning=bool(req.get("from_beginning")),
+                        )
+                        _send_frame(sock, {"ok": True, "cid": cid})
+                    elif op == "poll":
+                        c = consumers[req["cid"]]
+                        block = c.poll_block(
+                            max_records=int(req.get("max_records", 1000)),
+                            timeout=float(req.get("timeout", 0.1)),
+                        )
+                        from oryx_tpu.bus.filebus import _encode_block_lines
+
+                        blob = _encode_block_lines(block) if block is not None else b""
+                        _send_frame(sock, {"ok": True, "n": 0 if block is None else len(block)}, blob)
+                    elif op == "commit":
+                        consumers[req["cid"]].commit()
+                        _send_frame(sock, {"ok": True})
+                    elif op == "positions":
+                        pos = consumers[req["cid"]].positions()
+                        _send_frame(sock, {"ok": True, "positions": {str(k): v for k, v in pos.items()}})
+                    elif op == "consumer_close":
+                        c = consumers.pop(req["cid"], None)
+                        if c is not None:
+                            c.close()
+                        _send_frame(sock, {"ok": True})
+                    elif op == "create_topic":
+                        broker.create_topic(
+                            req["topic"], int(req.get("partitions", 1)), req.get("config")
+                        )
+                        _send_frame(sock, {"ok": True})
+                    elif op == "topic_exists":
+                        _send_frame(sock, {"ok": True, "exists": broker.topic_exists(req["topic"])})
+                    elif op == "delete_topic":
+                        broker.delete_topic(req["topic"])
+                        _send_frame(sock, {"ok": True})
+                    elif op == "get_offsets":
+                        offs = broker.get_offsets(req["group"], req["topic"])
+                        _send_frame(sock, {"ok": True, "offsets": {str(k): v for k, v in offs.items()}})
+                    elif op == "set_offsets":
+                        broker.set_offsets(
+                            req["group"], req["topic"],
+                            {int(k): int(v) for k, v in req["offsets"].items()},
+                        )
+                        _send_frame(sock, {"ok": True})
+                    elif op == "latest_offsets":
+                        offs = broker.latest_offsets(req["topic"])
+                        _send_frame(sock, {"ok": True, "offsets": {str(k): v for k, v in offs.items()}})
+                    elif op == "ping":
+                        _send_frame(sock, {"ok": True})
+                    else:
+                        _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
+                except Exception as e:  # noqa: BLE001 - reported to client
+                    log.warning("bus-serve op %s failed", op, exc_info=True)
+                    try:
+                        _send_frame(sock, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+                    except OSError:
+                        return
+        finally:
+            for c in consumers.values():
+                try:
+                    c.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+class BusServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address: tuple[str, int], data_dir: str) -> None:
+        super().__init__(address, _Handler)
+        from oryx_tpu.bus.filebus import FileBroker
+
+        self.broker = FileBroker(data_dir)
+
+
+def serve(host: str, port: int, data_dir: str) -> BusServer:
+    """Start a bus server on a background thread; returns the server
+    (call ``.shutdown()`` to stop). Blocking use: ``serve_forever`` on
+    the returned object, which is what the CLI does."""
+    server = BusServer((host, port), data_dir)
+    t = threading.Thread(target=server.serve_forever, name="oryx-bus-serve", daemon=True)
+    t.start()
+    log.info("bus server on %s:%d over %s", host, server.server_address[1], data_dir)
+    return server
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class _Conn:
+    """One socket with a request lock (the protocol is strict
+    request/response, so a lock is all the multiplexing needed)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        with self._lock:
+            _send_frame(self._sock, header, payload)
+            resp, body = _recv_frame(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"bus server error: {resp.get('error')}")
+        return resp, body
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _NetProducer(TopicProducer):
+    def __init__(self, broker: "NetBroker", topic: str) -> None:
+        self._broker = broker
+        self._topic = topic
+
+    @property
+    def update_broker(self) -> str:
+        return self._broker.locator()
+
+    @property
+    def topic(self) -> str:
+        return self._topic
+
+    def send(self, key: str | None, message: str) -> None:
+        self.send_many([(key, message)])
+
+    def send_many(self, records: Iterable[tuple[str | None, str]]) -> int:
+        from oryx_tpu.bus.filebus import _encode_wire_lines
+
+        n = 0
+        # ship in bounded slices so one huge publish (a model) streams
+        for blob, count in _encode_wire_lines(records, slice_bytes=8 << 20):
+            self._broker._conn.call({"op": "produce", "topic": self._topic}, blob)
+            n += count
+        return n
+
+    def close(self) -> None:
+        pass
+
+
+class _NetConsumer(TopicConsumer):
+    def __init__(self, broker: "NetBroker", cid: int) -> None:
+        self._broker = broker
+        self._cid = cid
+        self._closed = False
+
+    def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
+        block = self.poll_block(max_records, timeout)
+        if block is None:
+            return []
+        return list(block.iter_key_messages())
+
+    def poll_block(self, max_records: int = 1000, timeout: float = 0.1):
+        from oryx_tpu.bus.filebus import _lines_to_block_standalone
+        from oryx_tpu.common.records import RecordBlock
+
+        resp, blob = self._broker._conn.call(
+            {"op": "poll", "cid": self._cid, "max_records": max_records, "timeout": timeout}
+        )
+        if not blob:
+            return None
+        return _lines_to_block_standalone(blob.split(b"\n")[:-1], RecordBlock)
+
+    def positions(self) -> dict[int, int]:
+        resp, _ = self._broker._conn.call({"op": "positions", "cid": self._cid})
+        return {int(k): int(v) for k, v in resp["positions"].items()}
+
+    def commit(self) -> None:
+        self._broker._conn.call({"op": "commit", "cid": self._cid})
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self._broker._conn.call({"op": "consumer_close", "cid": self._cid})
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def closed(self) -> bool:
+        return self._closed
+
+
+class NetBroker(Broker):
+    """Broker SPI over a ``tcp://host:port`` bus server."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._host, self._port = host, port
+        self._conn = _Conn(host, port)
+
+    def locator(self) -> str:
+        return f"tcp://{self._host}:{self._port}"
+
+    def create_topic(self, topic: str, partitions: int = 1, config: dict | None = None) -> None:
+        self._conn.call(
+            {"op": "create_topic", "topic": topic, "partitions": partitions, "config": config}
+        )
+
+    def topic_exists(self, topic: str) -> bool:
+        resp, _ = self._conn.call({"op": "topic_exists", "topic": topic})
+        return bool(resp["exists"])
+
+    def delete_topic(self, topic: str) -> None:
+        self._conn.call({"op": "delete_topic", "topic": topic})
+
+    def producer(self, topic: str) -> TopicProducer:
+        return _NetProducer(self, topic)
+
+    def consumer(
+        self, topic: str, group: str | None = None, from_beginning: bool = False
+    ) -> TopicConsumer:
+        resp, _ = self._conn.call(
+            {"op": "consumer_open", "topic": topic, "group": group, "from_beginning": from_beginning}
+        )
+        return _NetConsumer(self, int(resp["cid"]))
+
+    def get_offsets(self, group: str, topic: str) -> dict[int, int]:
+        resp, _ = self._conn.call({"op": "get_offsets", "group": group, "topic": topic})
+        return {int(k): int(v) for k, v in resp["offsets"].items()}
+
+    def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None:
+        self._conn.call(
+            {"op": "set_offsets", "group": group, "topic": topic,
+             "offsets": {str(k): int(v) for k, v in offsets.items()}}
+        )
+
+    def latest_offsets(self, topic: str) -> dict[int, int]:
+        resp, _ = self._conn.call({"op": "latest_offsets", "topic": topic})
+        return {int(k): int(v) for k, v in resp["offsets"].items()}

@@ -32,7 +32,7 @@ from oryx_tpu.common.lang import close_at_shutdown
 
 log = logging.getLogger(__name__)
 
-COMMANDS = ("batch", "speed", "serving", "bus-setup", "bus-tail", "bus-input", "config")
+COMMANDS = ("batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail", "bus-input", "config")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -61,6 +61,15 @@ def _build_parser() -> argparse.ArgumentParser:
         help="config override, e.g. --set oryx.serving.api.port=9090; repeatable",
     )
     p.add_argument("--input-file", default=None, help="bus-input: file to send line-by-line")
+    p.add_argument(
+        "--bind", default="0.0.0.0:6378",
+        help="bus-serve: host:port to listen on (default 0.0.0.0:6378)",
+    )
+    p.add_argument(
+        "--data-dir", default=None,
+        help="bus-serve: directory for the served topic logs "
+        "(default: the path of the config's file: input-topic broker)",
+    )
     p.add_argument(
         "--from-beginning",
         action="store_true",
@@ -238,6 +247,31 @@ def run_config_dump(cfg: Config, out=None) -> None:
         print(f"{key}={props[key]}", file=out)
 
 
+def run_bus_serve(cfg: Config, bind: str, data_dir: str | None) -> None:
+    """Serve a bus over TCP (oryx_tpu.bus.netbus): topic logs live in
+    data_dir on THIS host; every layer on any host reaches them via a
+    tcp://host:port locator — the multi-host transport when no shared
+    filesystem (and no Kafka) is available."""
+    host, _, port = bind.partition(":")
+    if data_dir is None:
+        loc = cfg.get_string("oryx.input-topic.broker")
+        if not loc.startswith("file:"):
+            raise SystemExit(
+                "--data-dir required (input-topic broker is not a file: path)"
+            )
+        data_dir = loc[len("file:"):].lstrip("/") if loc.startswith("file://") else loc[len("file:"):]
+    from oryx_tpu.bus.netbus import BusServer
+
+    server = BusServer((host or "0.0.0.0", int(port or 6378)), data_dir)
+    log.info("bus-serve: tcp://%s:%s over %s", host, server.server_address[1], data_dir)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+
+
 def main(argv: list[str] | None = None) -> int:
     args = _build_parser().parse_args(argv)
     logging.basicConfig(
@@ -257,6 +291,8 @@ def main(argv: list[str] | None = None) -> int:
         run_serving(cfg)
     elif args.command == "bus-setup":
         run_bus_setup(cfg)
+    elif args.command == "bus-serve":
+        run_bus_serve(cfg, args.bind, args.data_dir)
     elif args.command == "bus-tail":
         run_bus_tail(cfg, from_beginning=args.from_beginning)
     elif args.command == "bus-input":

@@ -108,7 +108,10 @@ class NeighborBucket:
     rows: np.ndarray  # [n] int32 global row ids, -1 = pad slot
     idx: np.ndarray  # [n, D] int32 col indices into the other side
     val: np.ndarray  # [n, D] float32 rating values (0 where padded)
-    mask: np.ndarray  # [n, D] float32 1/0 validity
+    deg: np.ndarray  # [n] int32 real entries per slot (0 for pad slots);
+    #   entries fill positions 0..deg-1, so the [n, D] validity mask is
+    #   exactly (iota < deg) and never needs to be materialized — a third
+    #   of the bucket bytes on host AND device at scale
     chunk: int  # rows per lax.map step (n is a multiple of chunk*shards)
 
     @property
@@ -122,6 +125,15 @@ class NeighborBucket:
 
 def _pow2_at_least(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
+
+
+def _mask_from_deg(shape, deg):
+    """[C, D] f32 validity mask from per-slot degrees: bucket entries
+    occupy positions 0..deg-1, so the mask is a comparison against an
+    iota — computed in-register on device instead of stored in HBM."""
+    return (
+        jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1) < deg[..., None]
+    ).astype(jnp.float32)
 
 
 def build_neighbor_buckets(
@@ -146,24 +158,55 @@ def build_neighbor_buckets(
     row_idx = np.asarray(row_idx)
     col_idx = np.asarray(col_idx)
     values = np.asarray(values)
-    order = np.argsort(row_idx, kind="stable")
-    r, c, v = row_idx[order], col_idx[order], values[order]
-    counts = np.bincount(r, minlength=num_rows)
-    starts = np.concatenate([[0], np.cumsum(counts)])
-    pos = np.arange(len(r)) - starts[r]
+    nnz = len(row_idx)
+    if not num_rows or not nnz:
+        return []
+    counts = np.bincount(row_idx, minlength=num_rows)
 
     # bucket width per row: next power of two >= degree (min min_width);
     # log2 of an exact power of two is exact in float64, so ceil is safe
     safe = np.maximum(counts, 1)
     widths = np.maximum(
         min_width, (2 ** np.ceil(np.log2(safe)).astype(np.int64)).astype(np.int64)
-    ) if num_rows else np.zeros(0, np.int64)
-    active = counts > 0
-    # row -> bucket slot assignment, one pass per distinct width
+    )
+    del safe
+
+    # ONE sort by (bucket width, row): every bucket becomes a contiguous
+    # slice of the sorted arrays and all later temporaries are
+    # bucket-sized, not nnz-sized — this is what bounds packing RSS at
+    # the 1B-rating scale (the old per-bucket path re-materialized
+    # multiple nnz-length masks/gathers for every width). The stable sort
+    # also preserves arrival order within each row, so slot contents are
+    # identical to the per-bucket path's.
+    wcode = np.log2(widths).astype(np.int64)  # [num_rows], values < 40
+    key = (wcode[row_idx] << 40) | row_idx.astype(np.int64)
+    order = np.argsort(key, kind="stable")
+    del key
+    r = row_idx[order]
+    c = col_idx[order]
+    v = values[order]
+    del order
+
+    # row-run boundaries in sorted order -> per-entry position within row
+    bounds = np.flatnonzero(np.r_[True, r[1:] != r[:-1]]).astype(np.int64)
+    row_start = np.zeros(nnz, dtype=np.int64)
+    row_start[bounds] = bounds
+    np.maximum.accumulate(row_start, out=row_start)
+    pos = (np.arange(nnz, dtype=np.int64) - row_start).astype(np.int32)
+    del row_start
+
+    # bucket slice boundaries: wcode is non-decreasing along the sort
+    codes_present = np.unique(wcode[r[bounds]])
+    code_of_bound = wcode[r[bounds]]
     buckets: list[NeighborBucket] = []
-    for w in sorted(set(widths[active].tolist())) if num_rows else []:
-        w = int(w)
-        rows_w = np.flatnonzero(active & (widths == w)).astype(np.int32)
+    for code in codes_present.tolist():
+        w = 1 << int(code)
+        b_lo, b_hi = np.searchsorted(code_of_bound, [code, code + 1])
+        first_bounds = bounds[b_lo:b_hi]  # entry offset of each row's run
+        lo = int(first_bounds[0])
+        hi = int(bounds[b_hi]) if b_hi < len(bounds) else nnz
+        rows_w = r[first_bounds].astype(np.int32)
+        counts_w = np.diff(np.r_[first_bounds, hi]).astype(np.int32)
         chunk = max(1, workspace_elems // (w * max(features, 1)))
         chunk = 1 << (chunk.bit_length() - 1)  # floor to power of two
         chunk = min(chunk, 1 << 16)
@@ -177,16 +220,22 @@ def build_neighbor_buckets(
             n = pad_to_multiple(len(rows_w), granule)
         rows = np.full(n, -1, dtype=np.int32)
         rows[: len(rows_w)] = rows_w
-        idx = np.zeros((n, w), dtype=np.int32)
-        val = np.zeros((n, w), dtype=np.float32)
-        mask = np.zeros((n, w), dtype=np.float32)
-        slot_of = np.full(num_rows, -1, dtype=np.int64)
-        slot_of[rows_w] = np.arange(len(rows_w))
-        sel = slot_of[r] >= 0
-        idx[slot_of[r[sel]], pos[sel]] = c[sel]
-        val[slot_of[r[sel]], pos[sel]] = v[sel]
-        mask[slot_of[r[sel]], pos[sel]] = 1.0
-        buckets.append(NeighborBucket(rows, idx, val, mask, chunk))
+        deg = np.zeros(n, dtype=np.int32)
+        deg[: len(rows_w)] = counts_w
+        # slot index per entry: which row-run of this bucket it belongs to
+        slot = np.repeat(
+            np.arange(len(rows_w), dtype=np.int64), counts_w.astype(np.int64)
+        )
+        flat = slot * w + pos[lo:hi]
+        del slot
+        idx = np.zeros(n * w, dtype=np.int32)
+        idx[flat] = c[lo:hi]
+        val = np.zeros(n * w, dtype=np.float32)
+        val[flat] = v[lo:hi]
+        del flat
+        buckets.append(
+            NeighborBucket(rows, idx.reshape(n, w), val.reshape(n, w), deg, chunk)
+        )
     return buckets
 
 
@@ -223,7 +272,7 @@ def _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, matmul_dtype
 def _sweep_buckets(
     other: jnp.ndarray,  # [M(+1), k] factors of the other side (full copy)
     out_shape: int,  # rows in the output factor matrix (incl. pad slot)
-    bucket_args: list[tuple],  # per bucket: (rows, idx, val, mask, chunk)
+    bucket_args: list[tuple],  # per bucket: (rows, idx, val, deg, chunk)
     lam: float,
     alpha: float,
     implicit: bool,
@@ -242,24 +291,25 @@ def _sweep_buckets(
     )
 
     def solve_chunk(args):
-        cidx, cval, cmask = args
+        cidx, cval, cdeg = args
+        cmask = _mask_from_deg(cval.shape, cdeg)
         v = other[cidx] * cmask[..., None]  # [C, D, k]
         a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, md)
         return jnp.linalg.solve(a, b[..., None])[..., 0]
 
     out = jnp.zeros((out_shape, k), dtype=jnp.float32)
-    for rows, idx, val, mask, chunk in bucket_args:
+    for rows, idx, val, deg, chunk in bucket_args:
         n, d = idx.shape
         num_chunks = n // chunk
         if num_chunks <= 1:
-            solved = solve_chunk((idx, val, mask))
+            solved = solve_chunk((idx, val, deg))
         else:
             solved = jax.lax.map(
                 solve_chunk,
                 (
                     idx.reshape(num_chunks, chunk, d),
                     val.reshape(num_chunks, chunk, d),
-                    mask.reshape(num_chunks, chunk, d),
+                    deg.reshape(num_chunks, chunk),
                 ),
             ).reshape(n, k)
         # pad slots carry row -1 -> scatter to the sacrificial last row
@@ -364,14 +414,14 @@ def train_als(
         out = []
         for b in buckets:
             if row_sh is None:
-                out.append((jnp.asarray(b.rows), jnp.asarray(b.idx), jnp.asarray(b.val), jnp.asarray(b.mask)))
+                out.append((jnp.asarray(b.rows), jnp.asarray(b.idx), jnp.asarray(b.val), jnp.asarray(b.deg)))
             else:
                 out.append(
                     (
                         jax.device_put(b.rows, row_sh),
                         jax.device_put(b.idx, row_sh2),
                         jax.device_put(b.val, row_sh2),
-                        jax.device_put(b.mask, row_sh2),
+                        jax.device_put(b.deg, row_sh),
                     )
                 )
         return out
@@ -466,11 +516,11 @@ def _train_als_sharded(
     u_arrs = []
     for b in u_buckets:
         ish, ilo = _translate_to_shards(b.idx, pos_y, i_loc)
-        u_arrs.append((ish, ilo, b.val, b.mask))
+        u_arrs.append((ish, ilo, b.val, b.deg))
     i_arrs = []
     for b in i_buckets:
         ish, ilo = _translate_to_shards(b.idx, pos_x, u_loc)
-        i_arrs.append((ish, ilo, b.val, b.mask))
+        i_arrs.append((ish, ilo, b.val, b.deg))
     u_chunks = [b.chunk for b in u_buckets]
     i_chunks = [b.chunk for b in i_buckets]
 
@@ -521,22 +571,28 @@ def _train_als_sharded(
             else None
         )
         outs = []
-        for (ish, ilo, val, mask), chunk in zip(arrs, chunks):
+        for (ish, ilo, val, deg), chunk in zip(arrs, chunks):
             n_loc, d = ish.shape
 
             def solve_chunk(args):
-                ish_c, ilo_c, cval, cmask = args
+                ish_c, ilo_c, cval, cdeg = args
+                cmask = _mask_from_deg(cval.shape, cdeg)
                 v = ring_fill(other_loc, ish_c, ilo_c) * cmask[..., None]
                 a, b = _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, md)
                 return jnp.linalg.solve(a, b[..., None])[..., 0]
 
             nch = n_loc // chunk
             if nch <= 1:
-                solved = solve_chunk((ish, ilo, val, mask))
+                solved = solve_chunk((ish, ilo, val, deg))
             else:
                 solved = jax.lax.map(
                     solve_chunk,
-                    tuple(a.reshape(nch, chunk, d) for a in (ish, ilo, val, mask)),
+                    (
+                        ish.reshape(nch, chunk, d),
+                        ilo.reshape(nch, chunk, d),
+                        val.reshape(nch, chunk, d),
+                        deg.reshape(nch, chunk),
+                    ),
                 ).reshape(n_loc, k)
             outs.append(solved)
         return jnp.concatenate(outs, axis=0)
@@ -554,8 +610,9 @@ def _train_als_sharded(
         return jax.lax.fori_loop(0, iterations, body, (x_loc, y_loc0))
 
     spec2 = P(DATA_AXIS, None)
-    arr_specs_u = [(spec2,) * 4 for _ in u_arrs]
-    arr_specs_i = [(spec2,) * 4 for _ in i_arrs]
+    spec1 = P(DATA_AXIS)  # the rank-1 per-slot degree column
+    arr_specs_u = [(spec2, spec2, spec2, spec1) for _ in u_arrs]
+    arr_specs_i = [(spec2, spec2, spec2, spec1) for _ in i_arrs]
     run_c = jax.jit(
         shard_map(
             run,
@@ -566,8 +623,15 @@ def _train_als_sharded(
     )
 
     sh2 = NamedSharding(mesh, spec2)
-    u_dev = [tuple(jax.device_put(a, sh2) for a in t) for t in u_arrs]
-    i_dev = [tuple(jax.device_put(a, sh2) for a in t) for t in i_arrs]
+    sh1 = NamedSharding(mesh, spec1)
+    u_dev = [
+        tuple(jax.device_put(a, sh1 if a.ndim == 1 else sh2) for a in t)
+        for t in u_arrs
+    ]
+    i_dev = [
+        tuple(jax.device_put(a, sh1 if a.ndim == 1 else sh2) for a in t)
+        for t in i_arrs
+    ]
     x_p, y_p = run_c(u_dev, i_dev, jax.device_put(y0, sh2))
 
     x = np.zeros((num_users, features), np.float32)

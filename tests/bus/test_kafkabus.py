@@ -1,0 +1,141 @@
+"""Kafka adapter tests.
+
+The unit half runs everywhere (locator wiring, graceful absence of the
+optional kafka-python dependency). The integration half needs a real
+broker: run with ``-m kafka`` and ``ORYX_KAFKA_BOOTSTRAP=host:port`` in
+an environment where kafka-python is installed.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+_HAS_KAFKA_LIB = True
+try:
+    import kafka  # noqa: F401
+except ImportError:
+    _HAS_KAFKA_LIB = False
+
+_BOOTSTRAP = os.environ.get("ORYX_KAFKA_BOOTSTRAP")
+
+kafka_integration = pytest.mark.skipif(
+    not (_HAS_KAFKA_LIB and _BOOTSTRAP),
+    reason="needs kafka-python + ORYX_KAFKA_BOOTSTRAP pointing at a broker",
+)
+
+
+def test_kafka_locator_without_library_raises_helpfully():
+    if _HAS_KAFKA_LIB:
+        pytest.skip("kafka-python installed; absence path not testable")
+    from oryx_tpu import bus
+
+    with pytest.raises(RuntimeError, match="kafka-python"):
+        bus.get_broker("kafka://localhost:9092")
+
+
+@pytest.mark.kafka
+@kafka_integration
+def test_kafka_roundtrip_with_group_resume():
+    """Full Broker SPI against a real Kafka: create topic, produce,
+    consume with a group, commit, resume from the committed offset."""
+    from oryx_tpu import bus
+
+    broker = bus.get_broker(f"kafka://{_BOOTSTRAP}")
+    topic = f"oryx-it-{uuid.uuid4().hex[:10]}"
+    group = f"g-{uuid.uuid4().hex[:8]}"
+    broker.create_topic(topic, 1)
+    try:
+        assert broker.topic_exists(topic)
+        with broker.producer(topic) as p:
+            p.send_many((None if j % 2 else "k", f"m{j}") for j in range(10))
+        assert sum(broker.latest_offsets(topic).values()) == 10
+
+        c1 = broker.consumer(topic, group=group, from_beginning=True)
+        got = []
+        while len(got) < 4:
+            got.extend(c1.poll(max_records=4 - len(got), timeout=2.0))
+        c1.commit()
+        c1.close()
+        assert broker.get_offsets(group, topic)
+
+        c2 = broker.consumer(topic, group=group)
+        rest = []
+        import time
+
+        deadline = time.time() + 20
+        while len(rest) < 6 and time.time() < deadline:
+            rest.extend(c2.poll(timeout=2.0))
+        c2.close()
+        assert [km.message for km in got + rest] == [f"m{j}" for j in range(10)]
+    finally:
+        broker.delete_topic(topic)
+
+
+@pytest.mark.kafka
+@kafka_integration
+def test_speed_layer_over_kafka(tmp_path):
+    """The real SpeedLayer against kafka:// locators — the 'layers run
+    against a real broker with offsets resuming' contract."""
+    import time
+
+    import numpy as np
+
+    from oryx_tpu import bus
+    from oryx_tpu.app.pmml import add_extension, add_extension_content
+    from oryx_tpu.common import config as C
+    from oryx_tpu.common import pmml as pmml_io
+    from oryx_tpu.lambda_.speed import SpeedLayer
+
+    locator = f"kafka://{_BOOTSTRAP}"
+    suffix = uuid.uuid4().hex[:8]
+    input_topic, update_topic = f"OryxInput-{suffix}", f"OryxUpdate-{suffix}"
+    broker = bus.get_broker(locator)
+    broker.create_topic(input_topic, 2)
+    broker.create_topic(update_topic, 1)
+    try:
+        root = pmml_io.build_skeleton_pmml()
+        add_extension(root, "features", 2)
+        add_extension(root, "implicit", "true")
+        add_extension_content(root, "XIDs", ["u0", "u1"])
+        add_extension_content(root, "YIDs", ["i0", "i1"])
+        with broker.producer(update_topic) as p:
+            p.send("MODEL", pmml_io.to_string(root))
+        cfg = C.get_default().with_overlay(
+            f"""
+            oryx.id = "KafkaSpeed-{suffix}"
+            oryx.speed.model-manager-class = "oryx_tpu.app.als.speed:ALSSpeedModelManager"
+            oryx.als.implicit = true
+            oryx.als.no-known-items = true
+            oryx.input-topic.broker = "{locator}"
+            oryx.input-topic.message.topic = "{input_topic}"
+            oryx.update-topic.broker = "{locator}"
+            oryx.update-topic.message.topic = "{update_topic}"
+            oryx.speed.streaming.generation-interval-sec = 3600
+            """
+        )
+        layer = SpeedLayer(cfg)
+        layer.start()
+        try:
+            deadline = time.time() + 30
+            while layer.manager.model is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert layer.manager.model is not None
+            m = layer.manager.model
+            gen = np.random.default_rng(3)
+            m.set_user_vectors(["u0", "u1"], gen.standard_normal((2, 2)).astype(np.float32))
+            m.set_item_vectors(["i0", "i1"], gen.standard_normal((2, 2)).astype(np.float32))
+            with broker.producer(input_topic) as p:
+                p.send_many((None, f"u{j % 2},i{j % 2},1.0,{j}") for j in range(20))
+            sent = 0
+            deadline = time.time() + 30
+            while sent == 0 and time.time() < deadline:
+                sent = layer.run_one_batch()
+            assert sent > 0
+        finally:
+            layer.close()
+    finally:
+        broker.delete_topic(input_topic)
+        broker.delete_topic(update_topic)

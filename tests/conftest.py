@@ -46,3 +46,11 @@ def _reset_inproc_brokers():
 def tmp_bus(tmp_path):
     """A fresh file-backed bus locator."""
     return f"file:{tmp_path}/bus"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "kafka: integration tests needing a real Kafka broker "
+        "(kafka-python + ORYX_KAFKA_BOOTSTRAP); deselect with -m 'not kafka'",
+    )

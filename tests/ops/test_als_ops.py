@@ -232,7 +232,7 @@ def test_build_neighbor_buckets_power_law():
     wide = [b for b in buckets if b.width == 512][0]
     assert (wide.rows >= 0).sum() == 1
     # every entry lands exactly once
-    assert sum(int(b.mask.sum()) for b in buckets) == len(rows)
+    assert sum(int(b.deg.sum()) for b in buckets) == len(rows)
     # zero-degree rows excluded entirely
     covered = np.concatenate([b.rows[b.rows >= 0] for b in buckets])
     assert len(covered) == 101

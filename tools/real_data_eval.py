@@ -107,10 +107,93 @@ def eval_covtype(data_dir: Path) -> dict:
     }
 
 
+def eval_bundled_iris() -> dict:
+    """REAL Iris through our k-means vs sklearn's KMeans as an
+    independent reference implementation on the identical data — the
+    kmeans-example.conf quality bar (BASELINE.json row) with no network."""
+    from sklearn.cluster import KMeans
+    from sklearn.datasets import load_iris
+
+    from oryx_tpu.ops import kmeans as km
+
+    x = load_iris().data.astype(np.float32)
+    t0 = time.perf_counter()
+    centers, cost = None, np.inf
+    for restart in range(5):  # KMeansUpdate-style restarts, best SSE wins
+        cen, _counts, c = km.train_kmeans(x, 3, iterations=50, seed=5 + restart)
+        if c < cost:
+            centers, cost = cen, c
+    wall = time.perf_counter() - t0
+    ours_sse = float(km.sum_squared_error(x, centers))
+    ours_sil = float(km.silhouette_coefficient(x, centers))
+    ref = KMeans(n_clusters=3, n_init=5, random_state=5).fit(x)
+    ref_sse = float(
+        km.sum_squared_error(x, ref.cluster_centers_.astype(np.float32))
+    )
+    return {
+        "metric": "k-means SSE, REAL Iris (k=3, 5 restarts) vs sklearn KMeans "
+        f"SSE {ref_sse:.2f} on identical data",
+        "value": round(ours_sse, 2),
+        "unit": "sse (lower better)",
+        "vs_baseline": round(ref_sse / ours_sse, 4),
+        "silhouette": round(ours_sil, 3),
+        "wall_sec": round(wall, 2),
+    }
+
+
+def eval_bundled_digits() -> dict:
+    """REAL handwritten digits (1797x64, 10 classes) through our
+    histogram forest vs sklearn's RandomForest at matched size on the
+    identical split — an independent-implementation accuracy bar (the
+    covtype row's stand-in while the sandbox has no network)."""
+    from sklearn.datasets import load_digits
+    from sklearn.ensemble import RandomForestClassifier
+
+    from oryx_tpu.ops import forest as forest_ops
+
+    d = load_digits()
+    x = d.data.astype(np.float32)
+    y = d.target.astype(np.int32)
+    gen = np.random.default_rng(13)
+    perm = gen.permutation(len(y))
+    x, y = x[perm], y[perm]
+    n_test = 400
+    xtr, ytr, xte, yte = x[:-n_test], y[:-n_test], x[-n_test:], y[-n_test:]
+    xb_tr = np.clip(xtr, 0, 16).astype(np.int32)  # pixel values are 0..16
+    xb_te = np.clip(xte, 0, 16).astype(np.int32)
+    t0 = time.perf_counter()
+    forest = forest_ops.train_forest(
+        xb_tr, ytr, num_bins=17, num_classes=10,
+        num_trees=50, max_depth=10, impurity="entropy", seed=77,
+    )
+    wall = time.perf_counter() - t0
+    votes = forest_ops.predict_forest_binned(forest, xb_te)
+    acc = float((votes.argmax(axis=1) == yte).mean())
+    ref = RandomForestClassifier(
+        n_estimators=50, max_depth=10, random_state=77
+    ).fit(xtr, ytr)
+    ref_acc = float(ref.score(xte, yte))
+    return {
+        "metric": "RDF held-out accuracy, REAL digits (1797x64, 50 trees depth "
+        f"10) vs sklearn RandomForest {ref_acc:.4f} on the identical split",
+        "value": round(acc, 4),
+        "unit": "accuracy",
+        "vs_baseline": round(acc / ref_acc, 4),
+        "wall_sec": round(wall, 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default="data/real")
     ap.add_argument("--out", default=None)
+    ap.add_argument(
+        "--bundled",
+        action="store_true",
+        help="also evaluate on sklearn's BUNDLED real datasets (Iris, "
+        "digits) against sklearn's own estimators — the no-network "
+        "quality-parity path",
+    )
     args = ap.parse_args()
     data_dir = Path(args.data)
     results = []
@@ -122,6 +205,9 @@ def main() -> None:
         results.append(eval_covtype(data_dir))
     else:
         print("covtype missing — run tools/fetch_datasets.py first", file=sys.stderr)
+    if args.bundled:
+        results.append(eval_bundled_iris())
+        results.append(eval_bundled_digits())
     for r in results:
         print(json.dumps(r), flush=True)
     if args.out and results:

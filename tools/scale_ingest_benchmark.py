@@ -37,6 +37,43 @@ def rss_gb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
+class RssSampler:
+    """Background sampler of current (not peak) RSS from /proc/self/statm:
+    the shape of the curve is the evidence that packing stays bounded,
+    which ru_maxrss alone can't show."""
+
+    def __init__(self, period: float = 5.0) -> None:
+        import threading
+
+        self.period = period
+        self.samples: list[tuple[float, float]] = []
+        self._stop = threading.Event()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        page = os.sysconf("SC_PAGE_SIZE")
+        while not self._stop.wait(self.period):
+            try:
+                with open("/proc/self/statm") as f:
+                    rss = int(f.read().split()[1]) * page / 1e9
+            except OSError:
+                continue
+            self.samples.append((time.perf_counter() - self._t0, rss))
+
+    def stop(self) -> str:
+        self._stop.set()
+        self._thread.join(timeout=self.period + 1)
+        if not self.samples:
+            return "rss curve: (no samples)"
+        step = max(1, len(self.samples) // 12)
+        pts = self.samples[::step]
+        return "rss curve (t_s: GB): " + " ".join(
+            f"{t:.0f}:{r:.1f}" for t, r in pts
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ratings", type=int, default=100_000_000)
@@ -112,9 +149,11 @@ def main() -> None:
     )
     update = ALSUpdate(cfg)
     past = FileRecords(data_dir)
+    sampler = RssSampler()
     t0 = time.perf_counter()
     update.run_update(2_000_000_000, [], past, str(model_dir), None)
     train_wall = time.perf_counter() - t0
+    curve = sampler.stop()
 
     promoted = model_dir / "2000000000"
     ok = (promoted / "model.pmml").exists() and (promoted / "Y").is_dir()
@@ -128,6 +167,7 @@ def main() -> None:
         f"train (parse->decay->aggregate->ALS->export->promote): {train_wall:.0f}s "
         f"({args.ratings / train_wall / 1e6:.2f}M ratings/s end-to-end)",
         f"peak RSS: {peak:.1f} GB; model promoted: {ok}",
+        curve,
     ]
     print("\n".join(lines), flush=True)
     print(
