@@ -74,6 +74,7 @@ def _grow_level_impl(
     stats_chan,  # [n, S] float32 per-example stat channels (w-weighted)
     node_of,  # [n] int32 heap index or -1 (inactive)
     feat_mask,  # [L, p] float32 1/0 mtry mask for this level
+    allowed_mask,  # [p] float32 1/0: features splits may EVER use
     level_start: int,  # heap index of first node at this depth (2^d - 1)
     num_level_nodes: int,  # L = 2^d
     num_bins: int,  # B
@@ -127,7 +128,10 @@ def _grow_level_impl(
     valid = (l_cnt >= min_node_size) & (r_cnt >= min_node_size)
     # last candidate bin (B-1) sends everything left: never a real split
     valid = valid & (jnp.arange(num_bins)[None, None, :] < num_bins - 1)
+    # excluded features (id/ignored/target columns) are out of bounds for
+    # the mtry-widening fallback too, not just for the sampled mask
     gain_all = jnp.where(valid, gain, -jnp.inf)
+    gain_all = jnp.where(allowed_mask[:, None, None] > 0, gain_all, -jnp.inf)
     gain_masked = jnp.where(feat_mask.T[:, :, None] > 0, gain_all, -jnp.inf)
 
     def best_of(g):
@@ -169,6 +173,7 @@ def _grow_level_trees_impl(
     stats_t,  # [T, n, S] per-tree weighted stat channels
     node_t,  # [T, n] per-tree heap index or -1
     mask_t,  # [T, L, p] per-tree mtry masks for this level
+    allowed_mask,  # [p] float32, shared by every tree
     level_start: int,
     num_level_nodes: int,
     num_bins: int,
@@ -190,8 +195,9 @@ def _grow_level_trees_impl(
     def one_tree(carry, args):
         sc, no, fm = args
         out = _grow_level_impl(
-            binned, sc, no, fm, level_start, num_level_nodes, num_bins,
-            impurity, min_node_size, min_info_gain, is_last_level, axis_name,
+            binned, sc, no, fm, allowed_mask, level_start, num_level_nodes,
+            num_bins, impurity, min_node_size, min_info_gain, is_last_level,
+            axis_name,
         )
         return carry, out
 
@@ -199,7 +205,7 @@ def _grow_level_trees_impl(
     return outs  # (sf [T,L], sb [T,L], gain [T,L], node_tot [T,L,S], node_of [T,n])
 
 
-_grow_level_trees = functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 10))(
+_grow_level_trees = functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 11))(
     _grow_level_trees_impl
 )
 
@@ -220,7 +226,7 @@ def _grow_level_trees_mesh(mesh, axis_name: str):
     trow1 = P(None, axis_name)
     repl = P()
 
-    def wrapped(binned, stats_t, node_t, mask_t, level_start,
+    def wrapped(binned, stats_t, node_t, mask_t, allowed_mask, level_start,
                 num_level_nodes, num_bins, impurity, min_node_size,
                 min_info_gain, is_last_level):
         fn = functools.partial(
@@ -237,11 +243,11 @@ def _grow_level_trees_mesh(mesh, axis_name: str):
         return shard_map(
             fn,
             mesh=mesh,
-            in_specs=(rows, trows, trow1, repl),
+            in_specs=(rows, trows, trow1, repl, repl),
             out_specs=(repl, repl, repl, repl, trow1),
-        )(binned, stats_t, node_t, mask_t)
+        )(binned, stats_t, node_t, mask_t, allowed_mask)
 
-    return functools.partial(jax.jit, static_argnums=(4, 5, 6, 7, 8, 9, 10))(wrapped)
+    return functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))(wrapped)
 
 
 def train_forest(
@@ -272,6 +278,8 @@ def train_forest(
     )
     if len(allowed) == 0:
         raise ValueError("no usable features")
+    allowed_vec = np.zeros(p, dtype=np.float32)
+    allowed_vec[allowed] = 1.0
     if num_classes is None:
         y = np.asarray(targets, dtype=np.float32)
         stats_base = np.stack([np.ones(n, np.float32), y, y * y], axis=1)
@@ -384,6 +392,7 @@ def train_forest(
                 stats_dev,
                 node_dev,
                 jnp.asarray(mask_t),
+                allowed_vec,
                 level_start,
                 num_level,
                 num_bins,

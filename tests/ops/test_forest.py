@@ -1,6 +1,7 @@
 """Forest kernel mesh tests: sharded histogram growth on the CPU mesh."""
 
 import numpy as np
+import pytest
 
 from oryx_tpu.ops import forest as forest_ops
 
@@ -20,3 +21,165 @@ def test_forest_mesh_matches_single_device():
     np.testing.assert_array_equal(single.split_feature, meshed.split_feature)
     np.testing.assert_array_equal(single.split_bin, meshed.split_bin)
     np.testing.assert_allclose(single.node_stats, meshed.node_stats, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# kernel-level properties vs a naive reference (VERDICT r4 weak #7)
+# ---------------------------------------------------------------------------
+
+
+def _naive_best_split(x, y_stats, num_bins, kind):
+    """Exhaustive (feature, bin) split search, straight from the math:
+    gain = imp(parent) - (n_l*imp(l) + n_r*imp(r)) / n, entropy in nats,
+    split 'bin <= b' goes left, last bin never valid."""
+    import math
+
+    def imp(stats):
+        if kind == "variance":
+            w, wy, wyy = stats
+            if w <= 0:
+                return 0.0
+            m = wy / w
+            return max(wyy / w - m * m, 0.0)
+        tot = sum(stats)
+        if tot <= 0:
+            return 0.0
+        e = 0.0
+        for c in stats:
+            p = c / tot
+            if p > 0:
+                e += p * p if kind == "gini" else -p * math.log(p)
+        return 1.0 - e if kind == "gini" else e
+
+    def count(stats):
+        return stats[0] if kind == "variance" else sum(stats)
+
+    n, p = x.shape
+    parent = [sum(y_stats[i][s] for i in range(n)) for s in range(len(y_stats[0]))]
+    best = (-np.inf, None, None)
+    for f in range(p):
+        for b in range(num_bins - 1):
+            left = [0.0] * len(parent)
+            for i in range(n):
+                if x[i, f] <= b:
+                    for s in range(len(parent)):
+                        left[s] += y_stats[i][s]
+            right = [parent[s] - left[s] for s in range(len(parent))]
+            if count(left) < 1.0 or count(right) < 1.0:
+                continue
+            g = imp(parent) - (count(left) * imp(left) + count(right) * imp(right)) / count(parent)
+            if g > best[0] + 1e-12:
+                best = (g, f, b)
+    return best
+
+
+@pytest.mark.parametrize("kind", ["entropy", "gini", "variance"])
+def test_root_split_matches_exhaustive_search(kind):
+    """The fused histogram/gain kernel must choose exactly the split an
+    exhaustive scalar search finds, with the same gain value."""
+    gen = np.random.default_rng(123)
+    n, p, num_bins = 300, 5, 8
+    x = gen.integers(0, num_bins, (n, p)).astype(np.int32)
+    if kind == "variance":
+        y = (x[:, 2] * 1.7 - (x[:, 4] > 3) * 5.0 + gen.standard_normal(n)).astype(
+            np.float32
+        )
+        stats = [(1.0, float(v), float(v * v)) for v in y]
+        forest = forest_ops.train_forest(
+            x, y, num_bins=num_bins, num_classes=None, num_trees=1,
+            max_depth=1, impurity="variance", mtry=p, seed=5,
+        )
+    else:
+        y = ((x[:, 1] > 4).astype(int) * 2 + (x[:, 3] > 2).astype(int)) % 3
+        y = np.where(gen.random(n) < 0.1, gen.integers(0, 3, n), y).astype(np.int32)
+        stats = [tuple(1.0 if c == yi else 0.0 for c in range(3)) for yi in y]
+        forest = forest_ops.train_forest(
+            x, y, num_bins=num_bins, num_classes=3, num_trees=1,
+            max_depth=1, impurity=kind, mtry=p, seed=5,
+        )
+    want_gain, want_f, want_b = _naive_best_split(x, stats, num_bins, kind)
+    assert forest.split_feature[0, 0] == want_f
+    assert forest.split_bin[0, 0] == want_b
+    np.testing.assert_allclose(forest.gains[0, 0], want_gain, rtol=1e-4)
+
+
+def test_regression_stats_channels_and_leaf_means():
+    """Regression trees carry (w, wy, wy^2) stats; leaf predictions are
+    the routed examples' mean, and predict_forest_binned returns them."""
+    gen = np.random.default_rng(9)
+    n = 400
+    x = gen.integers(0, 8, (n, 3)).astype(np.int32)
+    y = np.where(x[:, 0] <= 3, 2.0, 7.0).astype(np.float32)
+    forest = forest_ops.train_forest(
+        x, y, num_bins=8, num_classes=None, num_trees=1, max_depth=1,
+        impurity="variance", mtry=3, seed=1,
+    )
+    # root stats = exact sums over all examples
+    np.testing.assert_allclose(
+        forest.node_stats[0, 0], [n, y.sum(), (y * y).sum()], rtol=1e-5
+    )
+    assert forest.split_feature[0, 0] == 0 and forest.split_bin[0, 0] == 3
+    # children stats partition the root's
+    left, right = forest.node_stats[0, 1], forest.node_stats[0, 2]
+    np.testing.assert_allclose(left + right, forest.node_stats[0, 0], rtol=1e-5)
+    np.testing.assert_allclose(left[1] / left[0], 2.0, rtol=1e-5)
+    np.testing.assert_allclose(right[1] / right[0], 7.0, rtol=1e-5)
+    # inference pools the stats channels; the mean is wy/w (app tier)
+    pred = forest_ops.predict_forest_binned(forest, x)
+    np.testing.assert_allclose(pred[:, 1] / pred[:, 0], y, rtol=1e-4)
+
+
+def test_mtry_mask_varies_features_across_trees():
+    """With mtry=1 on equally-informative features, different trees must
+    root-split on different features (the mask is per-node random, not a
+    constant), and with min_info_gain unreachable the root stays a leaf."""
+    gen = np.random.default_rng(4)
+    n, p = 600, 8
+    x = gen.integers(0, 4, (n, p)).astype(np.int32)
+    # every feature equally (and strongly) informative for its own bit
+    y = (x.sum(axis=1) > (1.5 * p)).astype(np.int32)
+    forest = forest_ops.train_forest(
+        x, y, num_bins=4, num_classes=2, num_trees=24, max_depth=1,
+        mtry=1, seed=7,
+    )
+    roots = set(forest.split_feature[:, 0].tolist()) - {-1}
+    assert len(roots) >= 4, f"mtry mask not varying: {roots}"
+    # unreachable min_info_gain: no split anywhere
+    stump = forest_ops.train_forest(
+        x, y, num_bins=4, num_classes=2, num_trees=2, max_depth=3,
+        min_info_gain=1e9, seed=7,
+    )
+    assert (stump.split_feature == -1).all()
+
+
+def test_exclude_features_never_split():
+    gen = np.random.default_rng(2)
+    n = 300
+    x = gen.integers(0, 8, (n, 4)).astype(np.int32)
+    y = (x[:, 1] > 3).astype(np.int32)  # feature 1 is perfectly predictive
+    forest = forest_ops.train_forest(
+        x, y, num_bins=8, num_classes=2, num_trees=5, max_depth=3,
+        exclude_features={1}, seed=3,
+    )
+    assert not (forest.split_feature == 1).any()
+
+
+def test_min_node_size_respected():
+    """No split may produce a child below min_node_size examples."""
+    gen = np.random.default_rng(8)
+    n = 200
+    x = gen.integers(0, 16, (n, 4)).astype(np.int32)
+    y = gen.integers(0, 2, n).astype(np.int32)
+    min_sz = 40.0
+    forest = forest_ops.train_forest(
+        x, y, num_bins=16, num_classes=2, num_trees=1, max_depth=4,
+        min_node_size=min_sz, seed=6,
+    )
+    t = 0
+    for node in range(forest.split_feature.shape[1]):
+        f = forest.split_feature[t, node]
+        if f < 0:
+            continue
+        left, right = 2 * node + 1, 2 * node + 2
+        assert forest.node_counts[t, left] >= min_sz
+        assert forest.node_counts[t, right] >= min_sz
