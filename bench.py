@@ -561,7 +561,10 @@ def _print_summary(json_lines: list[str]) -> None:
     sys.stderr.flush()
     print("=== BENCH SUMMARY ===", flush=True)
     for r in final:
-        r.pop("order", None)
+        # keep summary rows compact — the driver records a bounded tail;
+        # the full rows (latencies, detail) live in tools/bench_evidence.txt
+        for k in ("order", "p50_ms", "p99_ms"):
+            r.pop(k, None)
         print(json.dumps(r), flush=True)
     sys.stdout.flush()
 
