@@ -84,6 +84,7 @@ def main() -> None:
     ap.add_argument("--features", type=int, default=50)
     ap.add_argument("--workers", type=int, default=64)
     ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
 
     from oryx_tpu.common import config as C
@@ -141,6 +142,23 @@ def main() -> None:
             t.join()
         elapsed = time.perf_counter() - t0
         report(latencies, errors, elapsed, args.workers, label="/recommend")
+        if args.out:
+            import jax
+
+            lat = sorted(latencies)
+            n = max(1, len(lat))
+            with open(args.out, "a", encoding="utf-8") as f:
+                f.write(
+                    f"=== load_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===\n"
+                    f"{args.users}u x {args.items}i x {args.features}f, "
+                    f"{args.workers} workers x {args.seconds:.0f}s, backend "
+                    f"{jax.default_backend()}/"
+                    f"{getattr(jax.devices()[0], 'device_kind', '?')}\n"
+                    f"{len(latencies)} ok / {len(errors)} failed; "
+                    f"{len(latencies) / elapsed:.1f} qps; p50 "
+                    f"{lat[min(n - 1, int(0.5 * n))] * 1000:.0f} ms, p99 "
+                    f"{lat[min(n - 1, int(0.99 * n))] * 1000:.0f} ms\n"
+                )
     finally:
         layer.close()
 

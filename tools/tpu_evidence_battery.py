@@ -1,0 +1,118 @@
+"""Wait for the TPU tunnel, then run the round's TPU evidence battery.
+
+The axon tunnel can wedge for hours (jax backend init HANGS rather than
+erroring). This tool probes in fresh subprocesses (a failed backend is
+cached for a process's lifetime) and, once a probe sees a real device,
+runs in order, each appended to the evidence files:
+
+1. driver bench (all serving shapes incl. 5M/20M, training, speed) —
+   the same `python bench.py` the driver runs, so BENCH-shaped rows
+   land in tools/bench_evidence.txt with backend=tpu labels;
+2. full-HTTP serving load (tools/load_benchmark.py, 1M x 50 bf16,
+   64 workers) — the VERDICT item-5 measurement;
+3. rank-200 ALS scale (nnz from --scale-nnz, bf16 Gramians).
+
+Usage:
+    python tools/tpu_evidence_battery.py [--probe-interval 180]
+        [--max-wait-hours 12] [--scale-nnz 100000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+def probe(timeout: float = 100.0) -> bool:
+    code = (
+        "import jax, jax.numpy as jnp; "
+        "jnp.ones(3).sum().block_until_ready(); "
+        "print('PROBE-OK', jax.default_backend())"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # probe the real backend
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        return "PROBE-OK tpu" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run(label: str, cmd: list[str], timeout: float, env_extra: dict | None = None) -> None:
+    print(f"[battery] {label}: {' '.join(cmd)}", flush=True)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            cmd, cwd=_ROOT, env=env, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        tail = (r.stdout + r.stderr)[-2500:]
+        print(f"[battery] {label}: rc={r.returncode} in {time.time() - t0:.0f}s\n{tail}", flush=True)
+    except subprocess.TimeoutExpired:
+        print(f"[battery] {label}: TIMEOUT after {time.time() - t0:.0f}s", flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--probe-interval", type=float, default=180.0)
+    ap.add_argument("--max-wait-hours", type=float, default=12.0)
+    ap.add_argument("--scale-nnz", type=int, default=100_000_000)
+    ap.add_argument("--once", action="store_true", help="probe once, no wait loop")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_wait_hours * 3600
+    while True:
+        if probe():
+            print("[battery] TPU reachable — running evidence battery", flush=True)
+            break
+        if args.once or time.time() > deadline:
+            print("[battery] TPU never became reachable; giving up", flush=True)
+            sys.exit(4)
+        print(
+            f"[battery] TPU unreachable; retrying in {args.probe_interval:.0f}s",
+            flush=True,
+        )
+        time.sleep(args.probe_interval)
+
+    # 1. the driver bench — identical to what the round-end driver runs
+    run("bench", [sys.executable, "bench.py"], timeout=3600,
+        env_extra={"ORYX_BENCH_ATTEMPTS": "2"})
+    # 2. full-HTTP serving with the device scan
+    run(
+        "http-load",
+        [
+            sys.executable, "tools/load_benchmark.py",
+            "--users", "100000", "--items", "1000000", "--features", "50",
+            "--workers", "64", "--seconds", "20",
+            "--out", "tools/http_load_evidence.txt",
+        ],
+        timeout=1800,
+    )
+    # 3. rank-200 scale, bf16 Gramians
+    run(
+        "als-scale-rank200",
+        [sys.executable, "tools/train_benchmark.py", "als-scale"],
+        timeout=3600,
+        env_extra={
+            "ORYX_TB_SCALE_NNZ": str(args.scale_nnz),
+            "ORYX_TB_SCALE_RANK": "200",
+            "ORYX_TB_MATMUL_DTYPE": "bfloat16",
+        },
+    )
+    print("[battery] done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
