@@ -67,8 +67,28 @@ def wait_for(pred, timeout=15.0):
     return False
 
 
-def test_full_als_pipeline(tmp_path):
-    broker_loc = "inproc://als-e2e"
+@pytest.fixture(params=["inproc", "tcp"])
+def broker_loc(request, tmp_path):
+    """The pipeline runs identically over the in-process broker and over
+    the networked TCP bus (every layer<->bus hop crossing a socket)."""
+    if request.param == "inproc":
+        yield "inproc://als-e2e"
+        return
+    import threading
+
+    from oryx_tpu.bus.netbus import BusServer
+
+    server = BusServer(("127.0.0.1", 0), str(tmp_path / "busdata"))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"tcp://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_full_als_pipeline(tmp_path, broker_loc):
     cfg = make_config(tmp_path, broker_loc)
     batch = BatchLayer(cfg)
     batch.prepare()
