@@ -259,7 +259,11 @@ def run_bus_serve(cfg: Config, bind: str, data_dir: str | None) -> None:
             raise SystemExit(
                 "--data-dir required (input-topic broker is not a file: path)"
             )
-        data_dir = loc[len("file:"):].lstrip("/") if loc.startswith("file://") else loc[len("file:"):]
+        # normalize exactly like get_broker: strip leading '//' pairs so
+        # file:///var/x serves the same /var/x a co-located layer opens
+        data_dir = loc[len("file:"):]
+        while data_dir.startswith("//"):
+            data_dir = data_dir[1:]
     from oryx_tpu.bus.netbus import BusServer
 
     server = BusServer((host or "0.0.0.0", int(port or 6378)), data_dir)

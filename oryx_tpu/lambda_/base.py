@@ -38,6 +38,8 @@ class AbstractLayer:
         # starts at latest (reference.conf:14-20 comment).
         self.group_id = f"OryxGroup-{layer_name}" + (f"-{self.id}" if self.id else "")
         self._stop_event = threading.Event()
+        self._input_broker: Broker | None = None
+        self._update_broker: Broker | None = None
         # multi-host: join the JAX multi-controller runtime before any
         # backend is touched, so jax.devices() spans the whole pod slice
         # (no-op unless oryx.batch.compute.distributed.* is configured)
@@ -52,11 +54,19 @@ class AbstractLayer:
     # -- topics -------------------------------------------------------------
 
     def input_broker(self) -> Broker:
-        return get_broker(self.input_broker_loc)
+        # one broker handle per layer: a file broker is cheap to rebuild,
+        # but tcp:// holds a live socket and kafka:// a client with
+        # metadata — per-micro-batch reconstruction would churn a
+        # connection (and defeat producer batching) every generation
+        if self._input_broker is None:
+            self._input_broker = get_broker(self.input_broker_loc)
+        return self._input_broker
 
     def update_broker(self) -> Broker | None:
         if self.update_broker_loc and self.update_topic:
-            return get_broker(self.update_broker_loc)
+            if self._update_broker is None:
+                self._update_broker = get_broker(self.update_broker_loc)
+            return self._update_broker
         return None
 
     def init_topics(self) -> None:

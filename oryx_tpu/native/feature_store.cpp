@@ -583,6 +583,10 @@ int64_t parse_float_csv(const char* buf, int64_t len, float* out, int64_t cap) {
   if (len == 0) return 0;
   while (p < end) {
     if (n >= cap) return -1;
+    // from_chars (unlike the strtof it replaced) rejects leading spaces;
+    // tolerate them so json.dumps-style "a, b" fallback formatting stays
+    // on the fast path
+    while (p < end && *p == ' ') ++p;
     auto [next, ec] = std::from_chars(p, end, out[n]);
     if (ec != std::errc() || next == p) return -1;  // malformed token
     ++n;

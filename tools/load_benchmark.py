@@ -146,7 +146,13 @@ def main() -> None:
             import jax
 
             lat = sorted(latencies)
-            n = max(1, len(lat))
+            n = len(lat)
+            pcts = (
+                f"p50 {lat[min(n - 1, int(0.5 * n))] * 1000:.0f} ms, p99 "
+                f"{lat[min(n - 1, int(0.99 * n))] * 1000:.0f} ms"
+                if n
+                else "no successful requests"
+            )
             with open(args.out, "a", encoding="utf-8") as f:
                 f.write(
                     f"=== load_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===\n"
@@ -155,9 +161,7 @@ def main() -> None:
                     f"{jax.default_backend()}/"
                     f"{getattr(jax.devices()[0], 'device_kind', '?')}\n"
                     f"{len(latencies)} ok / {len(errors)} failed; "
-                    f"{len(latencies) / elapsed:.1f} qps; p50 "
-                    f"{lat[min(n - 1, int(0.5 * n))] * 1000:.0f} ms, p99 "
-                    f"{lat[min(n - 1, int(0.99 * n))] * 1000:.0f} ms\n"
+                    f"{len(latencies) / elapsed:.1f} qps; {pcts}\n"
                 )
     finally:
         layer.close()
