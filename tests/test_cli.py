@@ -165,3 +165,72 @@ def test_example_confs_parse_and_name_real_classes(conf_file, monkeypatch):
         assert load_class(name) is not None
     assert cfg.get_optional_strings("oryx.serving.application-resources")
     assert cfg.get_string("oryx.input-topic.broker").startswith("file:")
+
+
+def test_bus_serve_cli_resolves_file_locator_and_serves(tmp_path):
+    """`bus-serve` with no --data-dir must serve EXACTLY the directory a
+    co-located layer's get_broker resolves for the same file: locator
+    (file:///abs/path — the lstrip('/') regression made it cwd-relative),
+    and a tcp:// client must see topics written through the file path."""
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    from oryx_tpu import bus
+
+    bus_dir = tmp_path / "busdata"
+    conf = tmp_path / "oryx.conf"
+    conf.write_text(
+        f'oryx.input-topic.broker = "file://{bus_dir}"\n'
+        f'oryx.update-topic.broker = "file://{bus_dir}"\n'
+    )
+    # a layer-side write through the file locator (triple-slash form)
+    fb = bus.get_broker(f"file://{bus_dir}")
+    fb.create_topic("T", 1)
+    with fb.producer("T") as p:
+        p.send("k", "through-the-file-path")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    import os
+    from pathlib import Path
+
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    env = dict(os.environ)
+    # run from an unrelated cwd (the regression made file:/// paths
+    # cwd-relative) with the repo importable
+    env["PYTHONPATH"] = str(Path(__file__).resolve().parent.parent)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "oryx_tpu", "bus-serve",
+            "--conf", str(conf), "--bind", f"127.0.0.1:{port}",
+        ],
+        cwd=elsewhere,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        nb = None
+        deadline = time.time() + 30
+        while nb is None and time.time() < deadline:
+            try:
+                nb = bus.get_broker(f"tcp://127.0.0.1:{port}")
+            except OSError:
+                time.sleep(0.2)
+        assert nb is not None, "bus server never came up"
+        assert nb.topic_exists("T")  # sees the file-written topic
+        c = nb.consumer("T", from_beginning=True)
+        got = []
+        deadline = time.time() + 10
+        while not got and time.time() < deadline:
+            got = c.poll(timeout=0.5)
+        assert [km.message for km in got] == ["through-the-file-path"]
+        c.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
