@@ -28,13 +28,18 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def build_model(users: int, items: int, features: int, seed: int = 1234):
+def build_model(users: int, items: int, features: int, seed: int = 1234,
+                lsh_sample_rate: float = 1.0):
     """LoadTestALSModelFactory.buildTestModel: random unit-ish factors,
-    a handful of known items per user."""
+    a handful of known items per user. lsh_sample_rate < 1 enables the
+    LSH-pruned CPU-parity path (the reference's published-table mode);
+    1.0 keeps the exact device scan."""
     from oryx_tpu.app.als.serving_model import ALSServingModel
 
     gen = np.random.default_rng(seed)
-    model = ALSServingModel(features=features, implicit=True)
+    model = ALSServingModel(
+        features=features, implicit=True, sample_rate=lsh_sample_rate
+    )
     x = gen.standard_normal((users, features)).astype(np.float32)
     y = gen.standard_normal((items, features)).astype(np.float32)
     for j in range(users):
@@ -84,6 +89,11 @@ def main() -> None:
     ap.add_argument("--features", type=int, default=50)
     ap.add_argument("--workers", type=int, default=64)
     ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument(
+        "--lsh", type=float, default=1.0,
+        help="LSH sample rate (oryx.test.als.benchmark.lshSampleRate "
+        "analogue); < 1 switches to the LSH-pruned path, 1.0 = exact scan",
+    )
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
 
@@ -108,7 +118,7 @@ def main() -> None:
     )
 
     t0 = time.perf_counter()
-    model = build_model(args.users, args.items, args.features)
+    model = build_model(args.users, args.items, args.features, lsh_sample_rate=args.lsh)
     print(f"model built in {time.perf_counter() - t0:.1f}s", flush=True)
 
     layer = ServingLayer(cfg)
@@ -156,7 +166,7 @@ def main() -> None:
             with open(args.out, "a", encoding="utf-8") as f:
                 f.write(
                     f"=== load_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===\n"
-                    f"{args.users}u x {args.items}i x {args.features}f, "
+                    f"{args.users}u x {args.items}i x {args.features}f, lsh {args.lsh}, "
                     f"{args.workers} workers x {args.seconds:.0f}s, backend "
                     f"{jax.default_backend()}/"
                     f"{getattr(jax.devices()[0], 'device_kind', '?')}\n"
