@@ -679,8 +679,9 @@ def main() -> None:
     attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 3))
     init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 150))
     # generous: metrics stream as they complete, so a watchdog kill only
-    # costs whatever is still running
-    child_timeout = init_timeout + 1800
+    # costs whatever is still running (r5 adds the 5M/20M serving shapes
+    # and the 20M-rating scale row — first-compile-heavy on a cold cache)
+    child_timeout = init_timeout + 2700
 
     # attempts=1 is the documented fail-fast-TPU contract: no probe-driven
     # CPU fallback there either
