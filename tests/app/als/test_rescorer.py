@@ -1,0 +1,100 @@
+"""Rescorer SPI tests: NaN-removal/filter semantics, Multi* combination,
+config-driven provider loading, and the applied effect on top_n
+(reference: app/oryx-app-api .../als/{Rescorer,MultiRescorer,
+MultiRescorerProvider}.java + RescorerProviderTest patterns)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from oryx_tpu.app.als.rescorer import (
+    MultiRescorer,
+    MultiRescorerProvider,
+    Rescorer,
+    RescorerProvider,
+)
+from oryx_tpu.common import config as C
+
+
+class Halve(Rescorer):
+    def rescore(self, id_, original_score):
+        return original_score / 2.0
+
+
+class DropOdd(Rescorer):
+    def rescore(self, id_, original_score):
+        return math.nan if id_.endswith(("1", "3", "5", "7", "9")) else original_score
+
+    def is_filtered(self, id_):
+        return id_.endswith("9")
+
+
+class HalveProvider(RescorerProvider):
+    def get_recommend_rescorer(self, user_ids, args):
+        return Halve()
+
+
+class DropOddProvider(RescorerProvider):
+    def get_recommend_rescorer(self, user_ids, args):
+        return DropOdd()
+
+    def get_most_popular_items_rescorer(self, args):
+        return DropOdd()
+
+
+def test_multi_rescorer_chains_and_nan_short_circuits():
+    m = MultiRescorer([Halve(), DropOdd()])
+    assert m.rescore("i2", 8.0) == 4.0
+    assert math.isnan(m.rescore("i3", 8.0))
+    assert m.is_filtered("i9") and not m.is_filtered("i2")
+
+
+def test_multi_provider_combines_and_collapses():
+    mp = MultiRescorerProvider([HalveProvider(), DropOddProvider()])
+    r = mp.get_recommend_rescorer(["u1"], [])
+    assert isinstance(r, MultiRescorer) and len(r.rescorers) == 2
+    # endpoints where only one provider contributes collapse to it
+    r2 = mp.get_most_popular_items_rescorer([])
+    assert isinstance(r2, DropOdd)
+    # endpoints where none contributes return None
+    assert mp.get_most_active_users_rescorer([]) is None
+
+
+def test_provider_chain_loads_from_config_and_applies_to_top_n():
+    from oryx_tpu.app.als.serving_model import ALSServingModelManager
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx.als.rescorer-provider-class = [
+          "tests.app.als.test_rescorer:HalveProvider",
+          "tests.app.als.test_rescorer:DropOddProvider",
+        ]
+        oryx.als.implicit = true
+        """
+    )
+    mgr = ALSServingModelManager(cfg)
+    provider = mgr.rescorer_provider
+    assert provider is not None
+    rescorer = provider.get_recommend_rescorer(["u0"], [])
+    assert rescorer is not None
+
+    # applied through the real top_n scoring path: NaN-dropped ids are
+    # gone, surviving scores are halved, filtered ids never appear
+    from oryx_tpu.app.als.serving_model import ALSServingModel
+
+    gen = np.random.default_rng(6)
+    m = ALSServingModel(4, True)
+    m.set_item_vectors(
+        [f"i{j}" for j in range(20)], gen.standard_normal((20, 4)).astype(np.float32)
+    )
+    q = gen.standard_normal(4).astype(np.float32)
+    plain = m.top_n(q, 20)
+    scored = m.top_n(q, 20, rescorer=rescorer)
+    plain_scores = dict(plain)
+    assert scored, "rescored recommendations empty"
+    for id_, score in scored:
+        assert not id_.endswith(("1", "3", "5", "7", "9")), id_
+        np.testing.assert_allclose(score, plain_scores[id_] / 2.0, rtol=1e-5)
+    assert len(scored) == 10  # the even half survives
