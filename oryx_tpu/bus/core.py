@@ -89,6 +89,14 @@ class TopicConsumer(abc.ABC):
     def positions(self) -> dict[int, int]:
         """Current partition -> next-offset map."""
 
+    def seek(self, positions: dict[int, int]) -> None:
+        """Move the read position of the given partitions (absolute
+        offsets). The redelivery primitive: the fault bus rewinds a
+        consumer to simulate a dropped delivery, and the net-bus client
+        restores a reopened consumer after a reconnect. Optional —
+        brokers that cannot seek raise."""
+        raise NotImplementedError(f"{type(self).__name__} does not support seek")
+
     @abc.abstractmethod
     def commit(self) -> None:
         """Persist current positions to the group offset ledger."""
@@ -167,11 +175,19 @@ def get_broker(locator: str) -> Broker:
 
     inproc://<name> — process-local named broker (tests, single-process runs)
     file:/<dir> or file://<dir> or a bare path — file-backed broker
-    tcp://host:port — networked bus server (oryx_tpu.bus.netbus; start one
-        with `python -m oryx_tpu bus-serve`)
+    tcp://host:port[?connect_timeout=S&retry_max_attempts=N&...] —
+        networked bus server (oryx_tpu.bus.netbus; start one with
+        `python -m oryx_tpu bus-serve`)
     kafka://host:port[,host:port...] — Apache Kafka via kafka-python
         (optional dependency; oryx_tpu.bus.kafkabus)
+    fault+<inner>://...?drop=0.1&delay_ms=20&dup=0.01&fail_connect=N&seed=S
+        — chaos wrapper injecting seeded faults around any inner broker
+        (oryx_tpu.bus.faultbus; docs/resilience.md has the grammar)
     """
+    if locator.startswith("fault+"):
+        from oryx_tpu.bus.faultbus import FaultBroker
+
+        return FaultBroker.from_locator(locator)
     if locator.startswith("inproc://"):
         from oryx_tpu.bus.inproc import InProcessBroker
 
@@ -179,8 +195,9 @@ def get_broker(locator: str) -> Broker:
     if locator.startswith("tcp://"):
         from oryx_tpu.bus.netbus import NetBroker
 
-        host, _, port = locator[len("tcp://") :].partition(":")
-        return NetBroker(host, int(port))
+        rest, _, query = locator[len("tcp://") :].partition("?")
+        host, _, port = rest.partition(":")
+        return NetBroker(host, int(port), **NetBroker.options_from_query(query))
     if locator.startswith("kafka://"):
         from oryx_tpu.bus.kafkabus import KafkaBroker
 

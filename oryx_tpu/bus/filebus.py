@@ -596,6 +596,13 @@ class _FileConsumer(TopicConsumer):
     def positions(self) -> dict[int, int]:
         return dict(self._pos)
 
+    def seek(self, positions: dict[int, int]) -> None:
+        for i, off in positions.items():
+            i = int(i)
+            self._pos[i] = int(off)
+            # drop the cached byte cursor; the next read re-establishes it
+            self._cursor.pop(i, None)
+
     def commit(self) -> None:
         if self._group:
             self._broker.set_offsets(self._group, self._topic, self._pos)

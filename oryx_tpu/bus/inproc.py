@@ -191,6 +191,11 @@ class _InProcConsumer(TopicConsumer):
     def positions(self) -> dict[int, int]:
         return dict(self._pos)
 
+    def seek(self, positions: dict[int, int]) -> None:
+        with self._broker._cond:
+            for i, off in positions.items():
+                self._pos[int(i)] = int(off)
+
     def commit(self) -> None:
         if self._group:
             self._broker.set_offsets(self._group, self._topic, self._pos)

@@ -31,9 +31,11 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Iterable
 
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer
+from oryx_tpu.common import metrics
 
 log = logging.getLogger(__name__)
 
@@ -114,9 +116,24 @@ class _Handler(socketserver.BaseRequestHandler):
                         from oryx_tpu.bus.filebus import _encode_block_lines
 
                         blob = _encode_block_lines(block) if block is not None else b""
-                        _send_frame(sock, {"ok": True, "n": 0 if block is None else len(block)}, blob)
+                        _send_frame(
+                            sock,
+                            {
+                                "ok": True,
+                                "n": 0 if block is None else len(block),
+                                # positions ride along so the client can
+                                # restore this consumer after a reconnect
+                                "positions": {str(k): v for k, v in c.positions().items()},
+                            },
+                            blob,
+                        )
                     elif op == "commit":
                         consumers[req["cid"]].commit()
+                        _send_frame(sock, {"ok": True})
+                    elif op == "seek":
+                        consumers[req["cid"]].seek(
+                            {int(k): int(v) for k, v in req["positions"].items()}
+                        )
                         _send_frame(sock, {"ok": True})
                     elif op == "positions":
                         pos = consumers[req["cid"]].positions()
@@ -175,6 +192,37 @@ class BusServer(socketserver.ThreadingTCPServer):
         from oryx_tpu.bus.filebus import FileBroker
 
         self.broker = FileBroker(data_dir)
+        self._client_socks: set = set()
+        self._client_lock = threading.Lock()
+
+    # live-connection tracking: server_close() must sever established
+    # client connections too, not just the listener — otherwise a
+    # "stopped" server keeps serving through old handler threads and
+    # clients never exercise their reconnect path
+    def process_request(self, request, client_address):
+        with self._client_lock:
+            self._client_socks.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._client_lock:
+            self._client_socks.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        with self._client_lock:
+            socks = list(self._client_socks)
+            self._client_socks.clear()
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def serve(host: str, port: int, data_dir: str) -> BusServer:
@@ -193,28 +241,53 @@ def serve(host: str, port: int, data_dir: str) -> BusServer:
 # ---------------------------------------------------------------------------
 
 
-class _Conn:
-    """One socket with a request lock (the protocol is strict
-    request/response, so a lock is all the multiplexing needed)."""
+DEFAULT_CONNECT_TIMEOUT = 30.0
 
-    def __init__(self, host: str, port: int) -> None:
-        self._sock = socket.create_connection((host, port), timeout=30)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+
+class _Conn:
+    """One socket. The broker serializes requests (strict request/response
+    protocol) and owns reconnection, so this class is deliberately dumb:
+    callers must hold the broker's I/O lock."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float) -> None:
+        self._host, self._port = host, port
+        self._connect_timeout = connect_timeout
+        self._sock: socket.socket | None = None
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None
+
+    def connect(self) -> None:
+        self.drop()
+        sock = socket.create_connection((self._host, self._port), timeout=self._connect_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
 
     def call(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
-        with self._lock:
+        if self._sock is None:
+            raise ConnectionError("not connected")
+        try:
             _send_frame(self._sock, header, payload)
             resp, body = _recv_frame(self._sock)
+        except (ConnectionError, OSError, struct.error):
+            self.drop()
+            raise
         if not resp.get("ok"):
+            # a server-side op error: the connection itself is fine
             raise RuntimeError(f"bus server error: {resp.get('error')}")
         return resp, body
 
+    def drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self.drop()
 
 
 class _NetProducer(TopicProducer):
@@ -237,9 +310,11 @@ class _NetProducer(TopicProducer):
         from oryx_tpu.bus.filebus import _encode_wire_lines
 
         n = 0
-        # ship in bounded slices so one huge publish (a model) streams
+        # ship in bounded slices so one huge publish (a model) streams.
+        # A slice retried after a reconnect may already have landed
+        # server-side: at-least-once, like every broker here.
         for blob, count in _encode_wire_lines(records, slice_bytes=8 << 20):
-            self._broker._conn.call({"op": "produce", "topic": self._topic}, blob)
+            self._broker._invoke(lambda: {"op": "produce", "topic": self._topic}, blob)
             n += count
         return n
 
@@ -248,9 +323,20 @@ class _NetProducer(TopicProducer):
 
 
 class _NetConsumer(TopicConsumer):
-    def __init__(self, broker: "NetBroker", cid: int) -> None:
+    """Client-side consumer handle. Remembers how it was opened and its
+    last server-reported positions so the broker can transparently reopen
+    and re-seek it after a reconnect (server-side consumers die with the
+    connection)."""
+
+    def __init__(
+        self, broker: "NetBroker", cid: int, topic: str, group: str | None, from_beginning: bool
+    ) -> None:
         self._broker = broker
         self._cid = cid
+        self._topic = topic
+        self._group = group
+        self._from_beginning = from_beginning
+        self._last_positions: dict[int, int] | None = None
         self._closed = False
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
@@ -263,24 +349,46 @@ class _NetConsumer(TopicConsumer):
         from oryx_tpu.bus.filebus import _lines_to_block_standalone
         from oryx_tpu.common.records import RecordBlock
 
-        resp, blob = self._broker._conn.call(
-            {"op": "poll", "cid": self._cid, "max_records": max_records, "timeout": timeout}
+        resp, blob = self._broker._invoke(
+            lambda: {"op": "poll", "cid": self._cid, "max_records": max_records, "timeout": timeout},
+            consumer=self,
         )
+        if "positions" in resp:
+            self._last_positions = {int(k): int(v) for k, v in resp["positions"].items()}
         if not blob:
             return None
         return _lines_to_block_standalone(blob.split(b"\n")[:-1], RecordBlock)
 
     def positions(self) -> dict[int, int]:
-        resp, _ = self._broker._conn.call({"op": "positions", "cid": self._cid})
-        return {int(k): int(v) for k, v in resp["positions"].items()}
+        resp, _ = self._broker._invoke(
+            lambda: {"op": "positions", "cid": self._cid}, consumer=self
+        )
+        pos = {int(k): int(v) for k, v in resp["positions"].items()}
+        self._last_positions = dict(pos)
+        return pos
+
+    def seek(self, positions: dict[int, int]) -> None:
+        self._broker._invoke(
+            lambda: {
+                "op": "seek",
+                "cid": self._cid,
+                "positions": {str(k): int(v) for k, v in positions.items()},
+            },
+            consumer=self,
+        )
+        merged = dict(self._last_positions or {})
+        merged.update({int(k): int(v) for k, v in positions.items()})
+        self._last_positions = merged
 
     def commit(self) -> None:
-        self._broker._conn.call({"op": "commit", "cid": self._cid})
+        self._broker._invoke(lambda: {"op": "commit", "cid": self._cid}, consumer=self)
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            self._broker._forget_consumer(self)
             try:
+                # best-effort, no reconnect dance just to close
                 self._broker._conn.call({"op": "consumer_close", "cid": self._cid})
             except (RuntimeError, ConnectionError, OSError):
                 pass
@@ -290,26 +398,134 @@ class _NetConsumer(TopicConsumer):
 
 
 class NetBroker(Broker):
-    """Broker SPI over a ``tcp://host:port`` bus server."""
+    """Broker SPI over a ``tcp://host:port`` bus server, with
+    reconnect-with-backoff.
 
-    def __init__(self, host: str, port: int) -> None:
+    The connection is opened lazily and re-opened on demand: any call that
+    hits a connection error retries under `retry` (a RetryPolicy), and a
+    successful reconnect first reopens every live consumer server-side and
+    seeks it to its last known positions, so consumption resumes
+    mid-stream across a bus-server restart. Produce retries are
+    at-least-once (a request that died in flight may have landed)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
+        retry: "RetryPolicy | None" = None,
+    ) -> None:
+        from oryx_tpu.common.resilience import RetryPolicy
+
         self._host, self._port = host, port
-        self._conn = _Conn(host, port)
+        self._conn = _Conn(host, port, connect_timeout)
+        self._retry = retry or RetryPolicy(
+            max_attempts=5, initial_backoff=0.1, max_backoff=5.0
+        )
+        self._io_lock = threading.RLock()
+        self._consumers: list[_NetConsumer] = []
+
+    @staticmethod
+    def options_from_query(query: str) -> dict:
+        """Constructor kwargs from tcp:// locator query params:
+        connect_timeout (seconds), retry_max_attempts,
+        retry_initial_backoff_ms, retry_max_backoff_ms."""
+        from urllib.parse import parse_qsl
+
+        from oryx_tpu.common.resilience import RetryPolicy
+
+        params = dict(parse_qsl(query, keep_blank_values=True))
+        opts: dict = {}
+        if "connect_timeout" in params:
+            opts["connect_timeout"] = float(params["connect_timeout"])
+        retry_kw: dict = {}
+        if "retry_max_attempts" in params:
+            retry_kw["max_attempts"] = int(params["retry_max_attempts"])
+        if "retry_initial_backoff_ms" in params:
+            retry_kw["initial_backoff"] = float(params["retry_initial_backoff_ms"]) / 1000.0
+        if "retry_max_backoff_ms" in params:
+            retry_kw["max_backoff"] = float(params["retry_max_backoff_ms"]) / 1000.0
+        if retry_kw:
+            opts["retry"] = RetryPolicy(**retry_kw)
+        return opts
 
     def locator(self) -> str:
         return f"tcp://{self._host}:{self._port}"
 
+    # -- connection management ----------------------------------------------
+
+    def _reconnect(self) -> None:
+        """Caller holds _io_lock. Connect, then restore server-side
+        consumer sessions for every live client handle."""
+        self._conn.connect()
+        for c in self._consumers:
+            resp, _ = self._conn.call(
+                {
+                    "op": "consumer_open",
+                    "topic": c._topic,
+                    "group": c._group,
+                    "from_beginning": c._from_beginning,
+                }
+            )
+            c._cid = int(resp["cid"])
+            if c._last_positions:
+                self._conn.call(
+                    {
+                        "op": "seek",
+                        "cid": c._cid,
+                        "positions": {str(k): int(v) for k, v in c._last_positions.items()},
+                    }
+                )
+
+    def _invoke(self, header_fn, payload: bytes = b"", consumer: _NetConsumer | None = None):
+        """Run one request, transparently (re)connecting with backoff.
+        `header_fn` is re-evaluated per attempt so consumer ops pick up the
+        cid assigned by a reconnect's reopen."""
+        failures = 0
+        with self._io_lock:
+            while True:
+                try:
+                    if not self._conn.connected:
+                        self._reconnect()
+                        if failures:
+                            metrics.registry.counter("bus.net.reconnects").inc()
+                    return self._conn.call(header_fn(), payload)
+                except (ConnectionError, OSError) as e:
+                    self._conn.drop()
+                    if consumer is not None and consumer.closed():
+                        raise
+                    failures += 1
+                    delay = self._retry.backoff_or_none(failures)
+                    if delay is None:
+                        metrics.registry.counter("bus.net.reconnect-failures").inc()
+                        raise ConnectionError(
+                            f"bus server {self._host}:{self._port} unreachable "
+                            f"after {failures} attempts: {e}"
+                        ) from e
+                    log.warning(
+                        "bus connection to %s:%d failed (%s); retry %d in %.2fs",
+                        self._host, self._port, e, failures, delay,
+                    )
+                    time.sleep(delay)
+
+    def _forget_consumer(self, consumer: _NetConsumer) -> None:
+        with self._io_lock:
+            if consumer in self._consumers:
+                self._consumers.remove(consumer)
+
+    # -- Broker SPI ----------------------------------------------------------
+
     def create_topic(self, topic: str, partitions: int = 1, config: dict | None = None) -> None:
-        self._conn.call(
-            {"op": "create_topic", "topic": topic, "partitions": partitions, "config": config}
+        self._invoke(
+            lambda: {"op": "create_topic", "topic": topic, "partitions": partitions, "config": config}
         )
 
     def topic_exists(self, topic: str) -> bool:
-        resp, _ = self._conn.call({"op": "topic_exists", "topic": topic})
+        resp, _ = self._invoke(lambda: {"op": "topic_exists", "topic": topic})
         return bool(resp["exists"])
 
     def delete_topic(self, topic: str) -> None:
-        self._conn.call({"op": "delete_topic", "topic": topic})
+        self._invoke(lambda: {"op": "delete_topic", "topic": topic})
 
     def producer(self, topic: str) -> TopicProducer:
         return _NetProducer(self, topic)
@@ -317,21 +533,29 @@ class NetBroker(Broker):
     def consumer(
         self, topic: str, group: str | None = None, from_beginning: bool = False
     ) -> TopicConsumer:
-        resp, _ = self._conn.call(
-            {"op": "consumer_open", "topic": topic, "group": group, "from_beginning": from_beginning}
-        )
-        return _NetConsumer(self, int(resp["cid"]))
+        with self._io_lock:
+            resp, _ = self._invoke(
+                lambda: {
+                    "op": "consumer_open",
+                    "topic": topic,
+                    "group": group,
+                    "from_beginning": from_beginning,
+                }
+            )
+            c = _NetConsumer(self, int(resp["cid"]), topic, group, from_beginning)
+            self._consumers.append(c)
+            return c
 
     def get_offsets(self, group: str, topic: str) -> dict[int, int]:
-        resp, _ = self._conn.call({"op": "get_offsets", "group": group, "topic": topic})
+        resp, _ = self._invoke(lambda: {"op": "get_offsets", "group": group, "topic": topic})
         return {int(k): int(v) for k, v in resp["offsets"].items()}
 
     def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None:
-        self._conn.call(
-            {"op": "set_offsets", "group": group, "topic": topic,
-             "offsets": {str(k): int(v) for k, v in offsets.items()}}
+        self._invoke(
+            lambda: {"op": "set_offsets", "group": group, "topic": topic,
+                     "offsets": {str(k): int(v) for k, v in offsets.items()}}
         )
 
     def latest_offsets(self, topic: str) -> dict[int, int]:
-        resp, _ = self._conn.call({"op": "latest_offsets", "topic": topic})
+        resp, _ = self._invoke(lambda: {"op": "latest_offsets", "topic": topic})
         return {int(k): int(v) for k, v in resp["offsets"].items()}

@@ -32,7 +32,10 @@ from oryx_tpu.common.lang import close_at_shutdown
 
 log = logging.getLogger(__name__)
 
-COMMANDS = ("batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail", "bus-input", "config")
+COMMANDS = (
+    "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
+    "bus-input", "config", "health",
+)
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -238,6 +241,41 @@ def run_bus_input(cfg: Config, input_file: str | None) -> int:
     return sent
 
 
+def run_health(cfg: Config, out=None) -> int:
+    """Probe the serving layer's /healthz and /readyz (docs/resilience.md)
+    and print one line per endpoint; exit 0 only when both are green."""
+    import json
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    out = out or sys.stdout
+    scheme = "https" if cfg.get_optional_string("oryx.serving.api.keystore-file") else "http"
+    port = cfg.get_int(
+        "oryx.serving.api.secure-port" if scheme == "https" else "oryx.serving.api.port"
+    )
+    ctx_path = cfg.get_string("oryx.serving.api.context-path").rstrip("/")
+    ok = True
+    for endpoint in ("/healthz", "/readyz"):
+        url = f"{scheme}://localhost:{port}{ctx_path}{endpoint}"
+        try:
+            with urlopen(url, timeout=5) as resp:
+                status, body = resp.status, resp.read()
+        except URLError as e:
+            resp = getattr(e, "fp", None)
+            if resp is None:
+                print(f"{endpoint}: unreachable ({e})", file=out)
+                ok = False
+                continue
+            status, body = e.code, resp.read()
+        try:
+            detail = json.loads(body)
+        except ValueError:
+            detail = None
+        print(f"{endpoint}: {status}" + (f" {detail}" if detail is not None else ""), file=out)
+        ok = ok and status == 200
+    return 0 if ok else 1
+
+
 def run_config_dump(cfg: Config, out=None) -> None:
     """ConfigToProperties analogue: dump the resolved oryx.* tree as
     key=value lines for shell consumption (used at oryx-run.sh:87)."""
@@ -303,6 +341,8 @@ def main(argv: list[str] | None = None) -> int:
         run_bus_input(cfg, args.input_file)
     elif args.command == "config":
         run_config_dump(cfg)
+    elif args.command == "health":
+        return run_health(cfg)
     return 0
 
 

@@ -13,7 +13,9 @@ import threading
 from typing import Iterator
 
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, get_broker
+from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
 
 log = logging.getLogger(__name__)
 
@@ -40,6 +42,11 @@ class AbstractLayer:
         self._stop_event = threading.Event()
         self._input_broker: Broker | None = None
         self._update_broker: Broker | None = None
+        # resilience: every long-lived thread in a layer runs supervised
+        # (restart with backoff under oryx.<layer>.retry.*, give up after
+        # max-attempts consecutive failures -> the layer reports unhealthy)
+        self.retry_policy = RetryPolicy.from_config(config, f"oryx.{layer_name}.retry")
+        self._supervised: list[SupervisedThread] = []
         # multi-host: join the JAX multi-controller runtime before any
         # backend is touched, so jax.devices() spans the whole pod slice
         # (no-op unless oryx.batch.compute.distributed.* is configured)
@@ -109,18 +116,25 @@ class AbstractLayer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - stdlib contract
-                if self.path not in ("/", "/metrics", "/status"):
+                if self.path not in ("/", "/metrics", "/status", "/healthz"):
                     self.send_error(404)
                     return
-                body = dict(_metrics.registry.snapshot())
-                body["layer"] = {
-                    "type": "status",
-                    "name": layer.layer_name,
-                    "id": layer.id,
-                    "stopped": layer.is_stopped(),
-                }
+                healthy = layer.healthy()
+                if self.path == "/healthz":
+                    body = {"healthy": healthy, "layer": layer.layer_name}
+                    status = 200 if healthy else 503
+                else:
+                    body = dict(_metrics.registry.snapshot())
+                    body["layer"] = {
+                        "type": "status",
+                        "name": layer.layer_name,
+                        "id": layer.id,
+                        "stopped": layer.is_stopped(),
+                        "healthy": healthy,
+                    }
+                    status = 200
                 data = _json.dumps(body, indent=1).encode("utf-8")
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
@@ -135,11 +149,50 @@ class AbstractLayer:
         t = threading.Thread(target=srv.serve_forever, name=f"{self.layer_name}-ui", daemon=True)
         t.start()
 
+    def supervise(
+        self, name: str, target, *, loop: bool = False, metrics_prefix: str | None = None,
+        on_failure=None,
+    ) -> SupervisedThread:
+        """Start a supervised daemon thread under this layer's retry
+        policy; it counts toward `healthy()`."""
+        t = SupervisedThread(
+            name,
+            target,
+            self.retry_policy,
+            self._stop_event,
+            loop=loop,
+            metrics_prefix=metrics_prefix or f"{self.layer_name}.{name}",
+            on_failure=on_failure,
+        )
+        self._supervised.append(t)
+        t.start()
+        return t
+
+    def healthy(self) -> bool:
+        """False once any supervised thread has exhausted its restart
+        policy and given up."""
+        return all(t.healthy for t in self._supervised)
+
     def is_stopped(self) -> bool:
         return self._stop_event.is_set()
 
     def await_termination(self, timeout: float | None = None) -> None:
         self._stop_event.wait(timeout)
+
+    def join_or_report_leak(self, *threads, timeout: float = 10.0) -> None:
+        """Join each thread; one that outlives the timeout is logged and
+        counted in `layer.threads.leaked` instead of silently abandoned."""
+        for t in threads:
+            if t is None:
+                continue
+            t.join(timeout=timeout)
+            if t.is_alive():
+                name = getattr(t, "name", repr(t))
+                log.warning(
+                    "%s layer thread %r still alive after %.0fs join; leaking it",
+                    self.layer_name, name, timeout,
+                )
+                metrics.registry.counter("layer.threads.leaked").inc()
 
     def close(self) -> None:
         self._stop_event.set()
@@ -155,6 +208,77 @@ def blocking_iterator(consumer: TopicConsumer, stop_event: threading.Event) -> I
     while not stop_event.is_set() and not consumer.closed():
         for rec in consumer.poll(timeout=0.2):
             yield rec
+
+
+class GuardedBlockFeed:
+    """A restartable block feed with poison-message quarantine.
+
+    Wraps a consumer for use under a SupervisedThread: call `blocks()` for
+    a FRESH generator on every (re)start, and `record_failure` from the
+    supervisor's failure hook. A block that was mid-consume when the
+    manager raised is retried on restart; after `max_failures` failures of
+    the SAME block it is published to the dead-letter topic instead and
+    the stream moves on. A failure with no block in flight (the poll
+    itself raised — broker outage) is not counted against any block.
+    """
+
+    def __init__(
+        self,
+        consumer: TopicConsumer,
+        stop_event: threading.Event,
+        max_failures: int,
+        dead_letter,
+        on_block=None,
+    ) -> None:
+        self._consumer = consumer
+        self._stop_event = stop_event
+        self._max_failures = max(1, max_failures)
+        self._dead_letter = dead_letter  # callable(block) -> None
+        self._on_block = on_block  # callable(block) after each successful poll
+        self._in_flight = None
+        self._pending_retry = None
+        self._failures = 0
+
+    def blocks(self):
+        """A fresh generator; an abandoned predecessor (after a failure)
+        holds no state — everything lives on the feed object."""
+        while not self._stop_event.is_set() and not self._consumer.closed():
+            if self._pending_retry is not None:
+                block = self._pending_retry
+                self._pending_retry = None
+            else:
+                block = self._consumer.poll_block(max_records=10_000, timeout=0.2)
+                if block is None:
+                    continue
+                if self._on_block is not None:
+                    self._on_block(block)
+            self._in_flight = block
+            yield block
+            # reaching here means the manager pulled the next block: the
+            # previous one was fully consumed (on a failure the generator
+            # is abandoned at the yield and these lines never run)
+            self._in_flight = None
+            self._failures = 0
+
+    def record_failure(self, exc: BaseException) -> None:
+        """Supervisor failure hook (same thread as the consume loop)."""
+        block = self._in_flight
+        self._in_flight = None
+        if block is None:
+            return  # poll-side failure; nothing to quarantine
+        self._failures += 1
+        if self._failures >= self._max_failures:
+            self._failures = 0
+            log.error(
+                "block of %d update record(s) failed consume %d times (%s); dead-lettering",
+                len(block), self._max_failures, exc,
+            )
+            try:
+                self._dead_letter(block)
+            except Exception:  # noqa: BLE001 - a DL failure must not kill the stream
+                log.exception("dead-letter publish failed; block lost")
+        else:
+            self._pending_retry = block
 
 
 def blocking_block_iterator(consumer: TopicConsumer, stop_event: threading.Event):

@@ -20,7 +20,6 @@ disjoint, matching the reference's foreachRDD registration order
 from __future__ import annotations
 
 import logging
-import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
@@ -50,7 +49,7 @@ class BatchLayer(AbstractLayer):
         )
         self._update = load_instance_of(self.update_class, config)
         self._consumer = None
-        self._thread: threading.Thread | None = None
+        self._thread = None
         self._generation_count = 0
 
     # -- public lifecycle ---------------------------------------------------
@@ -66,16 +65,19 @@ class BatchLayer(AbstractLayer):
 
     def start(self) -> None:
         self.prepare()
-        self._thread = threading.Thread(target=self._loop, name="BatchLayer", daemon=True)
-        self._thread.start()
+        # supervised: a failed generation restarts the loop with backoff
+        # under oryx.batch.retry.*; max-attempts consecutive failures and
+        # the layer reports unhealthy (docs/resilience.md)
+        self._thread = self.supervise(
+            "BatchLayer", self._one_interval, loop=True, metrics_prefix="batch.loop"
+        )
         log.info("BatchLayer started: interval=%ss update=%s", self.generation_interval_sec, self.update_class)
 
     def close(self) -> None:
         super().close()
         if self._consumer is not None:
             self._consumer.close()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
+        self.join_or_report_leak(self._thread)
 
     @property
     def generation_count(self) -> int:
@@ -83,15 +85,11 @@ class BatchLayer(AbstractLayer):
 
     # -- generation loop ----------------------------------------------------
 
-    def _loop(self) -> None:
-        while not self.is_stopped():
-            self._stop_event.wait(self.generation_interval_sec)
-            if self.is_stopped():
-                break
-            try:
-                self.run_one_generation()
-            except Exception:
-                log.exception("batch generation failed")
+    def _one_interval(self) -> None:
+        """One supervised generation interval (wait, then generation)."""
+        self._stop_event.wait(self.generation_interval_sec)
+        if not self.is_stopped():
+            self.run_one_generation()
 
     def run_one_generation(self, timestamp_ms: int | None = None) -> None:
         """One full generation; callable directly for deterministic tests."""

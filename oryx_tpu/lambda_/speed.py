@@ -9,21 +9,35 @@ SpeedLayer.java:56-214, SpeedLayerUpdate.java:37-66; call stack §3.2):
 - every generation interval, the input micro-batch is handed to
   manager.build_updates and each returned delta is published to the update
   topic with key "UP".
+
+Resilience (docs/resilience.md): both threads run supervised — restart
+with backoff under ``oryx.speed.retry.*``, give up after max-attempts
+consecutive failures and report the layer unhealthy. An update block that
+repeatedly fails ``consume_blocks`` is quarantined to the dead-letter
+topic instead of killing the consume thread, and delta publishes are
+retried under the same policy.
 """
 
 from __future__ import annotations
 
 import logging
-import threading
-import time
 
 from oryx_tpu.common.records import BlockRecords
 from oryx_tpu.common import metrics, profiling
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
-from oryx_tpu.lambda_.base import AbstractLayer, blocking_block_iterator
+from oryx_tpu.lambda_.base import AbstractLayer, GuardedBlockFeed
 
 log = logging.getLogger(__name__)
+
+
+def dead_letter_topic_for(config: Config) -> str:
+    """The dead-letter topic name: oryx.update-topic.dead-letter.topic, or
+    '<update topic>.dead-letter' when unset."""
+    explicit = config.get_optional_string("oryx.update-topic.dead-letter.topic")
+    if explicit:
+        return explicit
+    return config.get_string("oryx.update-topic.message.topic") + ".dead-letter"
 
 
 class SpeedLayer(AbstractLayer):
@@ -31,11 +45,15 @@ class SpeedLayer(AbstractLayer):
         super().__init__(config, "speed")
         self.model_manager_class = config.get_string("oryx.speed.model-manager-class")
         self.max_batch_events = config.get_int("oryx.speed.streaming.max-batch-events")
+        self.dead_letter_topic = dead_letter_topic_for(config)
+        self.dead_letter_max_failures = (
+            config.get_optional_int("oryx.update-topic.dead-letter.max-consume-failures") or 3
+        )
         self.manager = load_instance_of(self.model_manager_class, config)
         self._input_consumer = None
         self._update_consumer = None
-        self._consume_thread: threading.Thread | None = None
-        self._batch_thread: threading.Thread | None = None
+        self._consume_thread = None
+        self._batch_thread = None
         self._batch_count = 0
 
     def prepare_input(self) -> None:
@@ -50,13 +68,22 @@ class SpeedLayer(AbstractLayer):
         if ub is None:
             raise ValueError("speed layer requires an update topic")
         self._update_consumer = ub.consumer(self.update_topic, from_beginning=True)
-        self._consume_thread = threading.Thread(
-            target=self._consume_updates, name="SpeedLayerUpdateConsumer", daemon=True
+        feed = GuardedBlockFeed(
+            self._update_consumer,
+            self._stop_event,
+            self.dead_letter_max_failures,
+            self._dead_letter,
         )
-        self._consume_thread.start()
+        self._consume_thread = self.supervise(
+            "SpeedLayerUpdateConsumer",
+            lambda: self.manager.consume_blocks(feed.blocks()),
+            metrics_prefix="speed.consume",
+            on_failure=feed.record_failure,
+        )
         self.prepare_input()
-        self._batch_thread = threading.Thread(target=self._loop, name="SpeedLayer", daemon=True)
-        self._batch_thread.start()
+        self._batch_thread = self.supervise(
+            "SpeedLayer", self._one_interval, loop=True, metrics_prefix="speed.batch"
+        )
         log.info(
             "SpeedLayer started: interval=%ss manager=%s",
             self.generation_interval_sec,
@@ -68,9 +95,7 @@ class SpeedLayer(AbstractLayer):
         for c in (self._input_consumer, self._update_consumer):
             if c is not None:
                 c.close()
-        for t in (self._consume_thread, self._batch_thread):
-            if t is not None:
-                t.join(timeout=10)
+        self.join_or_report_leak(self._consume_thread, self._batch_thread)
         self.manager.close()
 
     @property
@@ -79,28 +104,36 @@ class SpeedLayer(AbstractLayer):
 
     # -- internals ----------------------------------------------------------
 
-    def _consume_updates(self) -> None:
-        try:
-            self.manager.consume_blocks(
-                blocking_block_iterator(self._update_consumer, self._stop_event)
-            )
-        except Exception:
-            if not self.is_stopped():
-                log.exception("speed model consume thread failed")
+    def _dead_letter(self, block) -> None:
+        """Publish a poison update block to the dead-letter topic with the
+        original keys, so operators can inspect and replay it."""
+        ub = self.update_broker()
+        if ub is None:
+            return
+        ub.create_topic(self.dead_letter_topic, 1)
+        records = [(km.key, km.message) for km in block.iter_key_messages()]
+        with ub.producer(self.dead_letter_topic) as producer:
+            n = producer.send_many(records)
+        metrics.registry.counter("speed.deadletter.records").inc(n)
+        log.warning("dead-lettered %d record(s) to %s", n, self.dead_letter_topic)
 
-    def _loop(self) -> None:
-        while not self.is_stopped():
-            self._stop_event.wait(self.generation_interval_sec)
-            if self.is_stopped():
-                break
-            try:
-                self.run_one_batch()
-            except Exception:
-                log.exception("speed micro-batch failed")
+    def _one_interval(self) -> None:
+        """One supervised micro-batch interval (wait, then batch)."""
+        self._stop_event.wait(self.generation_interval_sec)
+        if not self.is_stopped():
+            self.run_one_batch()
 
     def run_one_batch(self) -> int:
         """Process one input micro-batch; returns updates published.
         Callable directly for deterministic tests."""
+        try:
+            return self._run_one_batch()
+        except Exception:
+            # operators alert on this (the loop's supervisor also logs it)
+            metrics.registry.counter("speed.batch.failures").inc()
+            raise
+
+    def _run_one_batch(self) -> int:
         if self._input_consumer is None:
             self._input_consumer = self.make_input_consumer()
         # columnar drain: blocks of byte-string arrays, no per-record
@@ -128,11 +161,19 @@ class SpeedLayer(AbstractLayer):
             ub = self.update_broker()
             sent = 0
             if ub is not None:
+                # each delta goes out with key "UP" (SpeedLayerUpdate.java:
+                # 58-60); one batched publish per micro-batch so the bus
+                # pays one lock/write cycle, not one per delta. The publish
+                # retries under the layer policy (transient bus faults);
+                # materialized so a retry resends the same records.
+                records = [("UP", update) for update in updates]
                 with ub.producer(self.update_topic) as producer:
-                    # each delta goes out with key "UP" (SpeedLayerUpdate.java:
-                    # 58-60); one batched publish per micro-batch so the bus
-                    # pays one lock/write cycle, not one per delta
-                    sent = producer.send_many(("UP", update) for update in updates)
+                    sent = self.retry_policy.call(
+                        lambda: producer.send_many(records),
+                        retry_on=(ConnectionError, OSError),
+                        metrics_prefix="speed.publish",
+                        stop_event=self._stop_event,
+                    )
             if self.id:
                 self._input_consumer.commit()
         metrics.registry.counter("speed.events").inc(total)

@@ -35,6 +35,7 @@ from oryx_tpu.common import pmml as pmml_io, rng, storage
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import collect_in_parallel
 from oryx_tpu.common.records import ChainRecords, ListRecords, as_records
+from oryx_tpu.common.resilience import RetryPolicy
 from oryx_tpu.ml import param as hp
 
 log = logging.getLogger(__name__)
@@ -65,6 +66,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             log.info("test-fraction = 0 so forcing candidates to 1")
             candidates = 1
         self.candidates = max(1, candidates)
+        # a trained model that fails to publish over a transient bus fault
+        # is an entire generation of compute lost — retry under the batch
+        # layer's policy before giving up
+        self.publish_retry = RetryPolicy.from_config(config, "oryx.batch.retry")
 
     # -- abstract app hooks --------------------------------------------------
 
@@ -194,10 +199,17 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 log.info("not publishing model to update topic since none is configured")
             else:
                 if pmml_text is not None:
-                    model_update_topic.send("MODEL", pmml_text)
+                    self.publish_retry.call(
+                        lambda: model_update_topic.send("MODEL", pmml_text),
+                        retry_on=(ConnectionError, OSError),
+                        metrics_prefix="batch.publish",
+                    )
                 else:
-                    model_update_topic.send(
-                        "MODEL-REF", storage.join(final_dir, MODEL_FILE_NAME)
+                    ref = storage.join(final_dir, MODEL_FILE_NAME)
+                    self.publish_retry.call(
+                        lambda: model_update_topic.send("MODEL-REF", ref),
+                        retry_on=(ConnectionError, OSError),
+                        metrics_prefix="batch.publish",
                     )
                 self.publish_additional_model_data(
                     best_pmml, new_data, past_records, final_dir, model_update_topic

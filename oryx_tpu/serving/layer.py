@@ -36,6 +36,7 @@ from oryx_tpu.bus.core import get_broker
 from oryx_tpu.common import metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
+from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
 from oryx_tpu.serving.web import (
     OryxServingException,
     Request,
@@ -137,12 +138,89 @@ def _import_recursively(module_name: str) -> None:
             importlib.import_module(info.name)
 
 
+class ServingHealth:
+    """Liveness/readiness state for the serving layer (docs/resilience.md).
+
+    The update-stream consumer reports in: every successful poll marks the
+    stream healthy, every poll error marks it down. When the stream is
+    down the layer keeps answering from the last good model — *degraded*,
+    not dead — and `staleness()` says how old that model's last delta is.
+    `stream_healthy` is None until the first poll (or when no update topic
+    is configured), which readiness treats as "not known to be down".
+    """
+
+    def __init__(self, clock=time.time) -> None:
+        self._clock = clock
+        self.stream_healthy: bool | None = None
+        self.last_update_time: float | None = None
+        self.consume_thread: SupervisedThread | None = None
+
+    def mark_stream_ok(self) -> None:
+        self.stream_healthy = True
+        metrics.registry.gauge("serving.update-stream.healthy").set(1)
+
+    def mark_stream_down(self) -> None:
+        self.stream_healthy = False
+        metrics.registry.gauge("serving.update-stream.healthy").set(0)
+
+    def mark_update(self) -> None:
+        self.last_update_time = self._clock()
+
+    def staleness(self) -> float | None:
+        """Seconds since the last model update was applied, or None if no
+        update has ever arrived. Also published as a gauge."""
+        if self.last_update_time is None:
+            return None
+        s = self._clock() - self.last_update_time
+        metrics.registry.gauge("serving.model.staleness-seconds").set(s)
+        return s
+
+    @property
+    def alive(self) -> bool:
+        """False only once the supervised consume thread exhausted its
+        restart policy — the layer can no longer recover by itself."""
+        t = self.consume_thread
+        return t is None or not t.gave_up
+
+    @property
+    def degraded(self) -> bool:
+        return self.stream_healthy is False
+
+
 @resource("GET", "/ready")
 def _ready(ctx: ServingContext, req: Request) -> Response:
     """503 until the model is sufficiently loaded (Ready.java:34-42)."""
     if _model_ready(ctx):
         return Response(200, None)
     return Response(503, None)
+
+
+@resource("GET", "/healthz")
+def _healthz(ctx: ServingContext, req: Request) -> Response:
+    """Liveness + degraded-mode report. 200 while the process can serve —
+    including degraded (update stream down, answering from the last good
+    model); 503 only when the update consumer has given up for good."""
+    health = ctx.health
+    if health is None:
+        return Response(200, {"alive": True}, content_type="application/json")
+    body = {
+        "alive": health.alive,
+        "degraded": health.degraded,
+        "stream_healthy": health.stream_healthy,
+        "staleness_seconds": health.staleness(),
+    }
+    return Response(200 if health.alive else 503, body, content_type="application/json")
+
+
+@resource("GET", "/readyz")
+def _readyz(ctx: ServingContext, req: Request) -> Response:
+    """Strict readiness for load balancers: the model must be loaded AND
+    the update stream must not be known-down. Degraded instances keep
+    /healthz green but drop out of /readyz rotation."""
+    ready = _model_ready(ctx)
+    stream_ok = ctx.health is None or ctx.health.stream_healthy is not False
+    body = {"model_ready": ready, "stream_ok": stream_ok}
+    return Response(200 if ready and stream_ok else 503, body, content_type="application/json")
 
 
 @resource("GET", "/metrics")
@@ -224,9 +302,12 @@ class ServingLayer:
         self.model_manager = None
         self.input_producer = None
         self._update_consumer = None
-        self._consume_thread: threading.Thread | None = None
+        self._consume_thread: SupervisedThread | None = None
         self._server: HTTPServer | None = None
         self._server_thread: threading.Thread | None = None
+        self._stop_event = threading.Event()
+        self.health = ServingHealth()
+        self.retry_policy = RetryPolicy.from_config(config, "oryx.serving.retry")
 
         self.router = Router()
         if self.app_resources:
@@ -267,15 +348,23 @@ class ServingLayer:
                         cfg.get_optional_int("oryx.update-topic.message.partitions") or 1,
                     )
                 # replay the update topic from offset 0 on every start
-                # (ModelManagerListener.java:118-132)
+                # (ModelManagerListener.java:118-132). Supervised: a poll
+                # failure marks the stream down (degraded mode — keep
+                # serving the last good model) and the thread restarts
+                # with backoff under oryx.serving.retry.*; only after
+                # max-attempts consecutive failures does /healthz go red.
                 self._update_consumer = broker.consumer(update_topic, from_beginning=True)
-                self._stop_event = threading.Event()
-                self._consume_thread = threading.Thread(
-                    target=self._consume_updates, name="ServingUpdateConsumer", daemon=True
+                self._consume_thread = SupervisedThread(
+                    "ServingUpdateConsumer",
+                    self._consume_updates,
+                    self.retry_policy,
+                    self._stop_event,
+                    metrics_prefix="serving.consume",
                 )
+                self.health.consume_thread = self._consume_thread
                 self._consume_thread.start()
 
-        ctx = ServingContext(self.model_manager, self.input_producer, self.config)
+        ctx = ServingContext(self.model_manager, self.input_producer, self.config, self.health)
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
         tls_ctx = None
@@ -304,14 +393,24 @@ class ServingLayer:
         log.info("ServingLayer listening on :%d%s", self.port, self.context_path or "/")
 
     def _consume_updates(self) -> None:
-        from oryx_tpu.lambda_.base import blocking_block_iterator
+        self.model_manager.consume_blocks(self._health_blocks())
 
-        try:
-            self.model_manager.consume_blocks(
-                blocking_block_iterator(self._update_consumer, self._stop_event)
-            )
-        except Exception:
-            log.exception("serving model consume thread failed")
+    def _health_blocks(self):
+        """blocking_block_iterator with a health reporter: every poll that
+        returns marks the update stream healthy, a poll that raises marks
+        it down (degraded mode) and propagates to the supervisor, and each
+        applied block timestamps the staleness clock."""
+        consumer = self._update_consumer
+        while not self._stop_event.is_set() and not consumer.closed():
+            try:
+                block = consumer.poll_block(max_records=10_000, timeout=0.2)
+            except Exception:
+                self.health.mark_stream_down()
+                raise
+            self.health.mark_stream_ok()
+            if block is not None:
+                yield block
+                self.health.mark_update()
 
     def await_termination(self, timeout: float | None = None) -> None:
         if self._server_thread is not None:
@@ -324,11 +423,17 @@ class ServingLayer:
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+        self._stop_event.set()
         if self._update_consumer is not None:
-            self._stop_event.set()
             self._update_consumer.close()
         if self._consume_thread is not None:
             self._consume_thread.join(timeout=5)
+            if self._consume_thread.is_alive():
+                log.warning(
+                    "serving thread %r still alive after 5s join; leaking it",
+                    self._consume_thread.name,
+                )
+                metrics.registry.counter("layer.threads.leaked").inc()
         if self.model_manager is not None:
             self.model_manager.close()
         if self.input_producer is not None:

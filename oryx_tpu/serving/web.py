@@ -100,10 +100,13 @@ class ServingContext:
     producer, and config (the reference stores these in servlet-context
     attributes, OryxResource.java:11-36 / AbstractOryxResource.java:54-73)."""
 
-    def __init__(self, model_manager, input_producer, config) -> None:
+    def __init__(self, model_manager, input_producer, config, health=None) -> None:
         self.model_manager = model_manager
         self.input_producer = input_producer
         self.config = config
+        # ServingHealth (oryx_tpu/serving/layer.py) when run under a full
+        # ServingLayer; None in bare router tests
+        self.health = health
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,181 @@
+"""Chaos bus tests: seeded drop/delay/dup injection over inproc and file
+brokers, connect-failure budgets, programmatic outages, and determinism.
+
+All faults are *delivery* faults — at-least-once semantics hold, so every
+test that retries around the injected errors must observe the complete
+message set eventually."""
+
+import time
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.bus import faultbus
+from oryx_tpu.bus.faultbus import FaultBroker, get_state, set_outage
+
+pytestmark = pytest.mark.chaos
+
+
+def _drain(consumer, want, timeout=10.0, max_records=1000):
+    """Poll until `want` messages arrive (drops redeliver, so this must
+    terminate); returns the messages in arrival order."""
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < want and time.monotonic() < deadline:
+        got.extend(km.message for km in consumer.poll(max_records, timeout=0.05))
+    return got
+
+
+def _produce_all(producer, records, timeout=10.0):
+    """send_many with retry around injected transient produce failures."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return producer.send_many(records)
+        except ConnectionError:
+            if time.monotonic() >= deadline:
+                raise
+
+
+@pytest.fixture(params=["inproc", "file"])
+def inner_locator(request, tmp_path):
+    if request.param == "inproc":
+        return "inproc://fault-under-test"
+    return f"file:{tmp_path}/bus"
+
+
+def test_fault_locator_resolves_via_get_broker(inner_locator):
+    broker = bus.get_broker(f"fault+{inner_locator}?drop=0.5&seed=1")
+    assert isinstance(broker, FaultBroker)
+    broker.create_topic("T", 1)
+    assert broker.topic_exists("T")  # admin passes through un-faulted
+
+
+def test_at_least_once_under_drop_and_dup(inner_locator):
+    loc = f"fault+{inner_locator}?drop=0.2&dup=0.1&seed=7"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    msgs = [f"m{j}" for j in range(40)]
+    with broker.producer("T") as p:
+        for m in msgs:  # one roll per send: plenty of injected failures
+            _produce_all(p, [(None, m)])
+    c = broker.consumer("T", from_beginning=True)
+    got = _drain(c, want=40, timeout=20.0)
+    # at-least-once: every message arrives; dups allowed, loss is not
+    assert set(msgs).issubset(set(got))
+    c.close()
+    st = get_state(loc)
+    assert st.injected_errors > 0 or st.dropped_records > 0  # chaos actually ran
+
+
+def test_poll_drop_rewinds_and_redelivers(inner_locator):
+    loc = f"fault+{inner_locator}?drop=0.5&seed=3"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    with broker.producer("T") as p:
+        _produce_all(p, [(None, f"r{j}") for j in range(10)])
+    c = broker.consumer("T", from_beginning=True)
+    # one record per poll = one drop roll per record: at drop=0.5 some of
+    # the >= 10 rolls inject a drop
+    got = _drain(c, want=10, timeout=20.0, max_records=1)
+    assert got.count("r0") >= 1 and set(got) == {f"r{j}" for j in range(10)}
+    assert get_state(loc).dropped_records > 0
+    c.close()
+
+
+def test_same_seed_same_fault_schedule(inner_locator):
+    """Determinism: with one consumer driving all rolls, the same seed
+    yields the same drop pattern (the property chaos e2e relies on)."""
+
+    def run(tag):
+        faultbus.reset()
+        loc = f"fault+{inner_locator}?drop=0.4&seed=11"
+        broker = bus.get_broker(loc)
+        topic = f"D{tag}"
+        broker.create_topic(topic, 1)
+        with bus.get_broker(inner_locator).producer(topic) as p:  # un-faulted feed
+            p.send_many([(None, f"x{j}") for j in range(12)])
+        c = broker.consumer(topic, from_beginning=True)
+        pattern = []
+        for _ in range(40):
+            batch = c.poll(max_records=1, timeout=0.05)
+            pattern.append(len(batch))
+            if sum(pattern) >= 12:
+                break
+        c.close()
+        return pattern
+
+    assert run("a") == run("b")
+
+
+def test_delay_adds_latency():
+    loc = "fault+inproc://fault-delay?delay_ms=50&seed=0"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    with broker.producer("T") as p:
+        t0 = time.monotonic()
+        p.send_many([(None, "slow")])
+        assert time.monotonic() - t0 >= 0.05
+
+
+def test_fail_connect_budget():
+    loc = "fault+inproc://fault-conn?fail_connect=2&seed=0"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    with pytest.raises(ConnectionError):
+        broker.producer("T")
+    with pytest.raises(ConnectionError):
+        broker.consumer("T")
+    # budget spent: connections succeed from now on
+    with broker.producer("T") as p:
+        p.send(None, "through")
+    c = broker.consumer("T", from_beginning=True)
+    assert _drain(c, want=1) == ["through"]
+    c.close()
+
+
+def test_programmatic_outage_lever():
+    loc = "fault+inproc://fault-outage?seed=0"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    producer = broker.producer("T")
+    consumer = broker.consumer("T", from_beginning=True)
+    producer.send(None, "before")
+    assert _drain(consumer, want=1) == ["before"]
+
+    set_outage(loc, True)
+    with pytest.raises(ConnectionError):
+        producer.send(None, "during")
+    with pytest.raises(ConnectionError):
+        consumer.poll(timeout=0.05)
+
+    set_outage(loc, False)
+    producer.send(None, "after")
+    assert _drain(consumer, want=1) == ["after"]
+    producer.close()
+    consumer.close()
+
+
+def test_fault_state_shared_across_get_broker_calls():
+    loc = "fault+inproc://fault-shared?fail_connect=1&seed=0"
+    b1 = bus.get_broker(loc)
+    b2 = bus.get_broker(loc)
+    b1.create_topic("T", 1)
+    with pytest.raises(ConnectionError):
+        b1.producer("T")
+    # the budget was consumed by b1: b2 sees the same (exhausted) schedule
+    with b2.producer("T") as p:
+        p.send(None, "ok")
+
+
+def test_unknown_query_keys_pass_through_to_inner(tmp_path):
+    """Non-fault query params stay on the inner locator (e.g. a netbus
+    connect_timeout travels through the fault+ wrapper)."""
+    from oryx_tpu.bus.faultbus import _split_locator
+
+    inner, params, canon = _split_locator(
+        "fault+tcp://h:1234?connect_timeout=5&drop=0.1&seed=2"
+    )
+    assert inner == "tcp://h:1234?connect_timeout=5"
+    assert params == {"drop": "0.1", "seed": "2"}
+    assert "drop=0.1" in canon and "connect_timeout" not in canon

@@ -1,0 +1,244 @@
+"""Resilience primitives: retry/backoff determinism, deadlines, circuit
+breaker transitions, and supervised-thread restart/give-up."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.common import metrics
+from oryx_tpu.common import config as C
+from oryx_tpu.common.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    RetryError,
+    RetryPolicy,
+    SupervisedThread,
+)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+
+def test_backoff_sequence_is_bounded_and_grows():
+    p = RetryPolicy(max_attempts=6, initial_backoff=0.1, max_backoff=0.5, multiplier=2.0, jitter=0.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.4, 0.5, 0.5]
+    assert p.backoff_or_none(6) is None
+
+
+def test_jitter_is_deterministic_for_same_seed_and_bounded():
+    a = list(RetryPolicy(max_attempts=5, jitter=0.1, seed=42).delays())
+    b = list(RetryPolicy(max_attempts=5, jitter=0.1, seed=42).delays())
+    assert a == b
+    for delay, base in zip(a, [0.1, 0.2, 0.4, 0.8]):
+        assert base * 0.9 <= delay <= base * 1.1
+    # different seed, different jitter draws
+    c = list(RetryPolicy(max_attempts=5, jitter=0.1, seed=43).delays())
+    assert a != c
+
+
+def test_from_config_reads_retry_block_with_ms_units():
+    cfg = C.get_default().with_overlay(
+        """
+        oryx.speed.retry {
+          max-attempts = 3
+          initial-backoff-ms = 50
+          max-backoff-ms = 200
+          multiplier = 3.0
+          jitter = 0
+        }
+        """
+    )
+    p = RetryPolicy.from_config(cfg, "oryx.speed.retry")
+    assert p.max_attempts == 3
+    assert list(p.delays()) == pytest.approx([0.05, 0.15])
+
+
+def test_call_retries_then_succeeds_and_counts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, initial_backoff=0.0, jitter=0.0)
+    before = metrics.registry.counter("t.retry.retries").value
+    assert p.call(flaky, metrics_prefix="t", sleep=lambda _: None) == "ok"
+    assert len(calls) == 3
+    assert metrics.registry.counter("t.retry.retries").value == before + 2
+
+
+def test_call_exhaustion_raises_retry_error_with_cause():
+    p = RetryPolicy(max_attempts=2, initial_backoff=0.0, jitter=0.0)
+    with pytest.raises(RetryError) as ei:
+        p.call(lambda: (_ for _ in ()).throw(ValueError("boom")), sleep=lambda _: None)
+    assert isinstance(ei.value.__cause__, ValueError)
+
+
+def test_call_does_not_retry_non_matching_exceptions():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise KeyError("not transient")
+
+    p = RetryPolicy(max_attempts=5, initial_backoff=0.0)
+    with pytest.raises(KeyError):
+        p.call(bad, retry_on=(ConnectionError,), sleep=lambda _: None)
+    assert len(calls) == 1
+
+
+def test_call_stop_event_aborts_backoff():
+    stop = threading.Event()
+    stop.set()
+    p = RetryPolicy(max_attempts=5, initial_backoff=10.0, jitter=0.0)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        p.call(
+            lambda: (_ for _ in ()).throw(ConnectionError("x")),
+            stop_event=stop,
+        )
+    assert time.monotonic() - t0 < 1.0
+
+
+# -- Deadline ----------------------------------------------------------------
+
+
+def test_deadline_remaining_and_check():
+    now = [0.0]
+    d = Deadline(5.0, clock=lambda: now[0])
+    assert d.remaining() == 5.0
+    assert d.clamp(10.0) == 5.0
+    now[0] = 6.0
+    assert d.expired()
+    with pytest.raises(DeadlineExceeded):
+        d.check("thing")
+
+
+def test_call_respects_deadline():
+    now = [0.0]
+    d = Deadline(0.5, clock=lambda: now[0])
+
+    def fail():
+        now[0] += 1.0
+        raise ConnectionError("x")
+
+    p = RetryPolicy(max_attempts=10, initial_backoff=0.0, jitter=0.0)
+    with pytest.raises(DeadlineExceeded):
+        p.call(fail, deadline=d, sleep=lambda _: None)
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+
+def test_breaker_closed_open_half_open_cycle():
+    now = [0.0]
+    cb = CircuitBreaker("dep", failure_threshold=2, reset_timeout=10.0, clock=lambda: now[0])
+    assert cb.state == CircuitBreaker.CLOSED
+
+    def boom():
+        raise ConnectionError("down")
+
+    for _ in range(2):
+        with pytest.raises(ConnectionError):
+            cb.call(boom)
+    assert cb.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: "ignored")  # refused while open
+
+    now[0] = 11.0  # timeout elapsed: one probe allowed
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    with pytest.raises(ConnectionError):
+        cb.call(boom)  # probe fails: re-open
+    assert cb.state == CircuitBreaker.OPEN
+
+    now[0] = 22.0
+    assert cb.call(lambda: "ok") == "ok"  # probe succeeds: closed
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_never_retried_by_policy():
+    cb = CircuitBreaker("dep2", failure_threshold=1, reset_timeout=100.0)
+    with pytest.raises(ConnectionError):
+        cb.call(lambda: (_ for _ in ()).throw(ConnectionError("x")))
+    calls = []
+
+    def guarded():
+        calls.append(1)
+        return cb.call(lambda: "ok")
+
+    p = RetryPolicy(max_attempts=5, initial_backoff=0.0)
+    with pytest.raises(CircuitOpenError):
+        p.call(guarded, sleep=lambda _: None)
+    assert len(calls) == 1  # a refusal is not a transient fault
+
+
+# -- SupervisedThread --------------------------------------------------------
+
+
+def _policy(attempts):
+    return RetryPolicy(max_attempts=attempts, initial_backoff=0.001, max_backoff=0.001, jitter=0.0)
+
+
+def test_supervised_restarts_until_success():
+    stop = threading.Event()
+    runs = []
+
+    def target():
+        runs.append(1)
+        if len(runs) < 3:
+            raise RuntimeError("crash")
+        # third run survives until stopped
+        stop.wait(5.0)
+
+    t = SupervisedThread("t1", target, _policy(5), stop)
+    t.start()
+    deadline = time.monotonic() + 5
+    while len(runs) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(runs) == 3
+    assert t.healthy and not t.gave_up
+    assert t.restarts == 2
+    stop.set()
+    t.join(5)
+    assert not t.is_alive()
+
+
+def test_supervised_gives_up_after_policy_exhausted():
+    stop = threading.Event()
+
+    def always_fail():
+        raise RuntimeError("crash")
+
+    t = SupervisedThread("t2", always_fail, _policy(3), stop, metrics_prefix="t2")
+    t.start()
+    t.join(5)
+    assert t.gave_up and not t.healthy
+    assert metrics.registry.counter("t2.giveups").value >= 1
+    assert metrics.registry.gauge("t2.healthy").value == 0
+    stop.set()
+
+
+def test_supervised_loop_mode_reruns_and_resets_failures():
+    stop = threading.Event()
+    runs = []
+
+    def one_iteration():
+        runs.append(1)
+        # every 2nd iteration fails; normal returns reset the failure count,
+        # so a max_attempts=2 policy never gives up
+        if len(runs) % 2 == 0:
+            raise RuntimeError("hiccup")
+        if len(runs) >= 9:
+            stop.set()
+
+    t = SupervisedThread("t3", one_iteration, _policy(2), stop, loop=True)
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+    assert len(runs) >= 9
+    assert t.healthy

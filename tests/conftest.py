@@ -37,9 +37,11 @@ def _deterministic_rng():
 @pytest.fixture(autouse=True)
 def _reset_inproc_brokers():
     yield
+    from oryx_tpu.bus import faultbus
     from oryx_tpu.bus.inproc import InProcessBroker
 
     InProcessBroker.reset_all()
+    faultbus.reset()
 
 
 @pytest.fixture()
@@ -53,4 +55,9 @@ def pytest_configure(config):
         "markers",
         "kafka: integration tests needing a real Kafka broker "
         "(kafka-python + ORYX_KAFKA_BOOTSTRAP); deselect with -m 'not kafka'",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (fault+ bus locators, "
+        "seeded); fast and tier-1-safe, select with -m chaos",
     )

@@ -234,3 +234,67 @@ def test_bus_serve_cli_resolves_file_locator_and_serves(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_chaos_example_conf_parses(monkeypatch):
+    """The shipped chaos conf must parse, carry a resolvable fault+
+    locator, and tune the retry blocks it documents."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("ORYX_CONF", os.path.join(repo_root, "conf/chaos-example.conf"))
+    cfg = config_utils.get_default()
+    loc = cfg.get_string("oryx.input-topic.broker")
+    assert loc.startswith("fault+file:")
+
+    from oryx_tpu.bus.faultbus import get_state
+
+    state = get_state(loc)
+    assert state.drop == 0.1 and state.dup == 0.01
+
+    from oryx_tpu.common.resilience import RetryPolicy
+
+    policy = RetryPolicy.from_config(cfg, "oryx.speed.retry")
+    assert policy.max_attempts == 8
+    assert cfg.get_int("oryx.update-topic.dead-letter.max-consume-failures") == 3
+
+
+def test_health_command_probes_serving_layer(tmp_path):
+    """`python -m oryx_tpu health` exits 0 with both endpoints green and
+    1 while the serving layer is not ready."""
+    from oryx_tpu.bus.core import get_broker
+    from oryx_tpu.serving.layer import ServingLayer
+
+    conf = _write_conf(
+        tmp_path,
+        extra="""
+          serving {
+            model-manager-class = "oryx_tpu.example.serving:ExampleServingModelManager"
+            application-resources = "oryx_tpu.example.serving"
+            api.port = 0
+          }
+        """,
+    )
+    cfg = cli.load_config(conf, [])
+    broker = get_broker(cfg.get_string("oryx.update-topic.broker"))
+    broker.create_topic("OryxUpdate", 1)
+
+    layer = ServingLayer(cfg)
+    layer.start()
+    try:
+        probe_cfg = cfg.with_overlay(f"oryx.serving.api.port = {layer.port}")
+        out = io.StringIO()
+        # no model yet: /healthz is green (alive) but /readyz is 503
+        assert cli.run_health(probe_cfg, out=out) == 1
+        assert "/readyz: 503" in out.getvalue()
+
+        with broker.producer("OryxUpdate") as p:
+            p.send("UP", "hello,3")
+        deadline = time.time() + 10
+        rc = 1
+        while rc != 0 and time.time() < deadline:
+            out = io.StringIO()
+            rc = cli.run_health(probe_cfg, out=out)
+            time.sleep(0.05)
+        assert rc == 0
+        assert "/healthz: 200" in out.getvalue() and "/readyz: 200" in out.getvalue()
+    finally:
+        layer.close()
