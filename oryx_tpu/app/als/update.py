@@ -120,10 +120,45 @@ class ALSUpdate(MLUpdate):
             shard_factors=mesh is not None
             and bool(self._config.get("oryx.batch.compute.shard-factors", False)),
             matmul_dtype=self._config.get("oryx.batch.compute.matmul-dtype", None),
+            init_y=self._warm_start_init_y(rm, features),
         )
         _save_features(candidate_path / "X", rm.user_ids, model.x)
         _save_features(candidate_path / "Y", rm.item_ids, model.y)
         return self._model_to_pmml(features, lam, alpha, rm)
+
+    def _warm_start_init_y(
+        self, rm: als_data.RatingMatrix, features: int
+    ) -> np.ndarray | None:
+        """Item-factor init from the champion generation's Y/ artifacts
+        (MLUpdate.load_previous_model). Rows whose item survives into this
+        generation start at the previous factor; new items get the usual
+        small random init. Returns None (cold start) when there is no
+        previous model, the feature count changed, or no item overlaps —
+        warm-start is an optimization, never a correctness dependency."""
+        if self.previous_model_dir is None:
+            return None
+        try:
+            ids_y, y_prev = _load_features(storage.join(self.previous_model_dir, "Y"))
+        except Exception:
+            log.warning("unreadable previous Y factors; cold-starting", exc_info=True)
+            return None
+        if y_prev.size == 0 or y_prev.shape[1] != features:
+            return None
+        num_items = len(rm.item_ids)
+        rows, found = _map_to_rows(
+            rm.item_ids, np.arange(num_items, dtype=np.int32), ids_y
+        )
+        if not found.any():
+            return None
+        init = 0.1 * rng.get_random().standard_normal(
+            (num_items, features)
+        ).astype(np.float32)
+        init[found] = y_prev[rows[found]]
+        log.info(
+            "warm-start from generation %s: %d/%d item factors carried over",
+            self.previous_generation_id, int(found.sum()), num_items,
+        )
+        return init
 
     def _model_to_pmml(
         self, features: int, lam: float, alpha: float, rm: als_data.RatingMatrix

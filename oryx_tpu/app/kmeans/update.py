@@ -73,10 +73,20 @@ class KMeansUpdate(MLUpdate):
         from oryx_tpu.parallel.mesh import mesh_from_config
 
         mesh = mesh_from_config(self._config)
+        # warm-start: run 0 seeds Lloyd from the champion's centers (the
+        # remaining runs stay independent restarts, so a drifted previous
+        # model can't trap every run in its basin); train_kmeans falls
+        # back to cold init when k or the feature dim changed
+        warm_centers = self._warm_start_centers()
         best = None
         for run in range(max(1, self.runs)):
             centers, counts, cost = km_ops.train_kmeans(
-                points, k, iterations=self.iterations, init=self.init_strategy, mesh=mesh
+                points,
+                k,
+                iterations=self.iterations,
+                init=self.init_strategy,
+                mesh=mesh,
+                initial_centers=warm_centers if run == 0 else None,
             )
             log.info("k-means run %d: cost=%.4f", run, cost)
             if best is None or cost < best[2]:
@@ -87,6 +97,23 @@ class KMeansUpdate(MLUpdate):
             for i in range(len(centers))
         ]
         return km.clusters_to_pmml(clusters, self.schema)
+
+    def _warm_start_centers(self) -> np.ndarray | None:
+        """Champion centers from MLUpdate.load_previous_model's PMML, or
+        None for a cold start."""
+        if self.previous_model is None:
+            return None
+        try:
+            clusters = km.pmml_to_clusters(self.previous_model)
+            centers = np.stack([c.center for c in clusters]).astype(np.float32)
+        except Exception:
+            log.warning("unreadable previous centers; cold-starting", exc_info=True)
+            return None
+        log.info(
+            "warm-start from generation %s: seeding %d centers",
+            self.previous_generation_id, len(centers),
+        )
+        return centers
 
     def evaluate(
         self,

@@ -143,10 +143,19 @@ def read_pmml_from_update_message(key: str, message: str) -> Element | None:
         return pmml_io.from_string(message)
     if key == "MODEL-REF":
         # the path may be local or an object-store URI (gs://...) — the
-        # reference reads referenced models from HDFS the same way. A
-        # poison reference (unknown scheme, missing driver, vanished
-        # path) must never kill a consumer loop: resolve to None.
+        # reference reads referenced models from HDFS the same way. The
+        # registry publishes refs as *generation dirs* (resolvable to
+        # manifest + artifacts, not just the document), so try
+        # <ref>/model.pmml first; a plain file path (legacy producers)
+        # still resolves. A poison reference (unknown scheme, missing
+        # driver, vanished path) must never kill a consumer loop:
+        # resolve to None.
         try:
+            from oryx_tpu.registry.store import MODEL_FILE_NAME
+
+            in_dir = storage.join(message, MODEL_FILE_NAME)
+            if storage.exists(in_dir):
+                return pmml_io.from_string(storage.read_text(in_dir))
             if not storage.exists(message):
                 return None
             return pmml_io.from_string(storage.read_text(message))

@@ -59,6 +59,13 @@ class RDFUpdate(MLUpdate):
         hyper_parameters: Sequence,
         candidate_path: Path,
     ) -> Element:
+        # Warm-start (MLUpdate.load_previous_model) is a deliberate no-op
+        # for RDF: level-wise histogram growth rebuilds every tree from
+        # the root, and seeding structure from a previous forest would
+        # bias split selection without saving any device work (unlike ALS
+        # factors / k-means centers, tree structure is not an iterate that
+        # later sweeps refine). self.previous_model stays available should
+        # an incremental variant (e.g. warm residual boosting) land.
         max_split_candidates = int(hyper_parameters[0])
         max_depth = int(hyper_parameters[1])
         impurity = str(hyper_parameters[2])

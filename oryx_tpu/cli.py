@@ -34,8 +34,10 @@ log = logging.getLogger(__name__)
 
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
-    "bus-input", "config", "health",
+    "bus-input", "config", "health", "models",
 )
+
+MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -44,6 +46,18 @@ def _build_parser() -> argparse.ArgumentParser:
         description="TPU-native lambda-architecture ML framework launcher",
     )
     p.add_argument("command", choices=COMMANDS, help="which layer or utility to run")
+    p.add_argument(
+        "subcommand",
+        nargs="?",
+        default=None,
+        help="models: list | show <generation> | rollback <generation> | gc",
+    )
+    p.add_argument(
+        "generation",
+        nargs="?",
+        default=None,
+        help="models show/rollback: the generation id (a <timestampMs> dir name)",
+    )
     p.add_argument(
         "--conf",
         default=None,
@@ -243,7 +257,11 @@ def run_bus_input(cfg: Config, input_file: str | None) -> int:
 
 def run_health(cfg: Config, out=None) -> int:
     """Probe the serving layer's /healthz and /readyz (docs/resilience.md)
-    and print one line per endpoint; exit 0 only when both are green."""
+    and print one line per endpoint, then compare the live generation
+    /healthz reports against the registry's CHAMPION pointer — serving
+    answering from a generation the registry no longer endorses is the
+    skew this probe exists to catch. Exit 0 only when everything is green
+    and in sync."""
     import json
     from urllib.error import URLError
     from urllib.request import urlopen
@@ -255,6 +273,7 @@ def run_health(cfg: Config, out=None) -> int:
     )
     ctx_path = cfg.get_string("oryx.serving.api.context-path").rstrip("/")
     ok = True
+    live_generation = None
     for endpoint in ("/healthz", "/readyz"):
         url = f"{scheme}://localhost:{port}{ctx_path}{endpoint}"
         try:
@@ -271,9 +290,95 @@ def run_health(cfg: Config, out=None) -> int:
             detail = json.loads(body)
         except ValueError:
             detail = None
+        if endpoint == "/healthz" and isinstance(detail, dict):
+            live_generation = detail.get("live_generation")
         print(f"{endpoint}: {status}" + (f" {detail}" if detail is not None else ""), file=out)
         ok = ok and status == 200
+
+    model_dir = cfg.get_optional_string("oryx.batch.storage.model-dir")
+    if model_dir:
+        from oryx_tpu.registry.store import RegistryStore
+
+        champion = RegistryStore(model_dir).champion_id()
+        if live_generation is not None and champion is not None:
+            if live_generation == champion:
+                print(f"generations: live={live_generation} champion={champion} (in sync)", file=out)
+            else:
+                print(f"generations: live={live_generation} champion={champion} SKEW", file=out)
+                ok = False
+        else:
+            print(f"generations: live={live_generation} champion={champion}", file=out)
     return 0 if ok else 1
+
+
+def run_models(cfg: Config, subcommand: str | None, generation: str | None, out=None) -> int:
+    """Registry operator surface (docs/model-registry.md):
+
+        models list             one line per generation + the champion
+        models show <gen>       the generation's manifest, as JSON
+        models rollback <gen>   republish an archived generation onto the
+                                update topic and move the CHAMPION pointer
+        models gc               apply oryx.ml.retention.max-generations now
+    """
+    from oryx_tpu.registry.store import RegistryStore, publish_generation
+
+    out = out or sys.stdout
+    if subcommand not in MODELS_SUBCOMMANDS:
+        raise SystemExit(
+            f"models requires a subcommand: {' | '.join(MODELS_SUBCOMMANDS)}"
+        )
+    model_dir = cfg.get_string("oryx.batch.storage.model-dir")
+    store = RegistryStore(model_dir)
+
+    if subcommand == "list":
+        champion = store.champion_id()
+        gens = store.list_generations()
+        if not gens:
+            print(f"no generations under {model_dir}", file=out)
+            return 0
+        for gen in gens:
+            manifest = store.read_manifest(gen)
+            status = manifest.status if manifest else "?"
+            metric = manifest.eval_metric if manifest else None
+            marker = " *champion*" if gen == champion else ""
+            print(f"{gen}\t{status}\teval={metric}{marker}", file=out)
+        return 0
+
+    if subcommand == "gc":
+        deleted = store.gc(cfg.get_int("oryx.ml.retention.max-generations"))
+        print(f"deleted {len(deleted)} generation(s): {deleted}", file=out)
+        return 0
+
+    if generation is None:
+        raise SystemExit(f"models {subcommand} requires a generation id")
+    if not store.has_generation(generation):
+        print(f"no such generation {generation} under {model_dir}", file=out)
+        return 1
+
+    if subcommand == "show":
+        manifest = store.read_manifest(generation)
+        if manifest is None:
+            print(f"generation {generation} has no manifest", file=out)
+            return 1
+        print(manifest.to_json(), file=out)
+        return 0
+
+    # rollback: same path the serving endpoint takes — republish, then
+    # move the champion so batch gates/warm-starts against it
+    from oryx_tpu.bus.core import get_broker
+
+    broker_loc = cfg.get_optional_string("oryx.update-topic.broker")
+    topic = cfg.get_optional_string("oryx.update-topic.message.topic")
+    if not broker_loc or not topic:
+        raise SystemExit("models rollback requires an update topic in config")
+    with get_broker(broker_loc).producer(topic) as producer:
+        key = publish_generation(
+            store, generation, producer,
+            cfg.get_int("oryx.update-topic.message.max-size"),
+        )
+    store.set_champion(generation)
+    print(f"republished generation {generation} as {key}; champion moved", file=out)
+    return 0
 
 
 def run_config_dump(cfg: Config, out=None) -> None:
@@ -343,6 +448,8 @@ def main(argv: list[str] | None = None) -> int:
         run_config_dump(cfg)
     elif args.command == "health":
         return run_health(cfg)
+    elif args.command == "models":
+        return run_models(cfg, args.subcommand, args.generation)
     return 0
 
 

@@ -12,9 +12,16 @@ Rebuild of framework/oryx-ml/.../MLUpdate.java:59-373. Per generation:
 4. promote the best candidate dir to `model_dir/<timestampMs>/`
    (temp→rename, MLUpdate.java:192-210),
 5. publish ("MODEL", <pmml xml>) inline when it fits the update topic's
-   max-size, else ("MODEL-REF", <path>) (MLUpdate.java:212-241),
+   max-size, else ("MODEL-REF", <generation dir>) (MLUpdate.java:212-241),
 6. call `publish_additional_model_data` (ALS streams its factor matrices
    here, ALSUpdate.java:194-230).
+
+Registry integration (oryx_tpu/registry/): each promoted generation gets
+its id + parent stamped into the PMML as Extensions and a manifest.json
+written next to model.pmml; a champion/challenger gate can archive a
+regressed candidate instead of publishing it; the champion's model is
+surfaced to `build_model` for warm-starting; and count-based retention GC
+trims old generations after each successful run.
 """
 
 from __future__ import annotations
@@ -25,6 +32,7 @@ import logging
 import math
 import shutil
 import tempfile
+import time
 from pathlib import Path
 from typing import Iterable, Sequence
 from xml.etree.ElementTree import Element
@@ -37,6 +45,16 @@ from oryx_tpu.common.lang import collect_in_parallel
 from oryx_tpu.common.records import ChainRecords, ListRecords, as_records
 from oryx_tpu.common.resilience import RetryPolicy
 from oryx_tpu.ml import param as hp
+from oryx_tpu.registry.gate import ChampionGate
+from oryx_tpu.registry.manifest import (
+    GENERATION_EXTENSION,
+    PARENT_EXTENSION,
+    STATUS_GATED,
+    STATUS_PUBLISHED,
+    GenerationManifest,
+    content_hash_of,
+)
+from oryx_tpu.registry.store import RegistryStore
 
 log = logging.getLogger(__name__)
 
@@ -70,6 +88,16 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         # is an entire generation of compute lost — retry under the batch
         # layer's policy before giving up
         self.publish_retry = RetryPolicy.from_config(config, "oryx.batch.retry")
+        self.gate = ChampionGate(config)
+        self.warm_start = config.get_bool("oryx.ml.warm-start")
+        self.retention_max_generations = config.get_int(
+            "oryx.ml.retention.max-generations"
+        )
+        # champion state surfaced to build_model for warm-starting; set per
+        # run by load_previous_model
+        self.previous_model: Element | None = None
+        self.previous_model_dir: str | None = None
+        self.previous_generation_id: str | None = None
 
     # -- abstract app hooks --------------------------------------------------
 
@@ -125,6 +153,37 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         test = [d for d, is_test in zip(new_data, mask) if is_test]
         return train, test
 
+    # -- warm-start ----------------------------------------------------------
+
+    def load_previous_model(self, store: RegistryStore) -> Element | None:
+        """Surface the champion generation's PMML (and dir, for side
+        artifacts like ALS factor shards) to ``build_model``. Called at
+        the top of every run when ``oryx.ml.warm-start`` is on; apps read
+        ``self.previous_model`` / ``self.previous_model_dir`` and decide
+        whether shapes still match. Any read failure degrades to a cold
+        start — warm-start is an optimization, never a correctness
+        dependency."""
+        self.previous_model = None
+        self.previous_model_dir = None
+        self.previous_generation_id = None
+        try:
+            champion = store.champion_id()
+            if champion is None:
+                return None
+            text = store.read_pmml_text(champion)
+            if text is None:
+                return None
+            self.previous_model = pmml_io.from_string(text)
+            self.previous_model_dir = store.generation_dir(champion)
+            self.previous_generation_id = champion
+            log.info("warm-start: loaded champion generation %s", champion)
+        except Exception:
+            log.warning("failed to load previous model; cold-starting", exc_info=True)
+            self.previous_model = None
+            self.previous_model_dir = None
+            self.previous_generation_id = None
+        return self.previous_model
+
     # -- the harness ---------------------------------------------------------
 
     def run_update(
@@ -160,27 +219,48 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 ),
             )
 
+        store = RegistryStore(str(model_dir))
+        generation_id = str(timestamp_ms)
+        parent_id = store.champion_id()
+        if self.warm_start:
+            self.load_previous_model(store)
+
         candidates_root = Path(tempfile.mkdtemp(prefix="oryx-candidates-"))
+        t0 = time.monotonic()
         try:
             best = self._find_best_candidate(candidates_root, combos, all_train, test_new)
             if best is None:
                 log.info("unable to build any model")
                 return
-            best_path, best_pmml = best
+            best_score, best_path, best_pmml, best_params = best
+
+            # stamp generation identity into the document itself, so an
+            # inline MODEL message is self-describing on the wire (the
+            # serving tracker reads it back out)
+            from oryx_tpu.app import pmml as app_pmml
+
+            app_pmml.add_extension(best_pmml, GENERATION_EXTENSION, generation_id)
+            if parent_id is not None:
+                app_pmml.add_extension(best_pmml, PARENT_EXTENSION, parent_id)
+            local_pmml = Path(best_path) / MODEL_FILE_NAME
+            pmml_io.write_pmml(best_pmml, local_pmml)
+
+            decision = self.gate.decide(store, best_score)
 
             # promote to model_dir/<timestampMs>/: temp -> rename locally,
             # recursive upload (PMML last) to an object store. Capture the
             # PMML bytes before the local copy disappears — publishing must
-            # not re-download what was on local disk a moment ago.
-            local_pmml = Path(best_path) / MODEL_FILE_NAME
-            pmml_size = local_pmml.stat().st_size
+            # not re-download what was on local disk a moment ago. A gated
+            # generation is promoted too (archived for forensics), it just
+            # never reaches the update topic or the CHAMPION pointer.
+            pmml_bytes = local_pmml.read_bytes()
             pmml_text = (
-                local_pmml.read_text(encoding="utf-8")
-                if pmml_size <= self.max_message_size
+                pmml_bytes.decode("utf-8")
+                if len(pmml_bytes) <= self.max_message_size
                 else None
             )
             if storage.is_remote(model_dir):
-                final_dir = storage.join(model_dir, str(timestamp_ms))
+                final_dir = storage.join(model_dir, generation_id)
                 # list, don't exists(): on object stores a bare prefix can
                 # report absent while stale blobs from a previous partial
                 # upload still live under it
@@ -189,11 +269,35 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 storage.upload_dir(best_path, final_dir)
                 shutil.rmtree(best_path, ignore_errors=True)
             else:
-                final_dir = storage.local_path(model_dir) / str(timestamp_ms)
+                final_dir = storage.local_path(model_dir) / generation_id
                 final_dir.parent.mkdir(parents=True, exist_ok=True)
                 if final_dir.exists():
                     shutil.rmtree(final_dir)
                 shutil.move(str(best_path), str(final_dir))
+
+            store.write_manifest(
+                GenerationManifest(
+                    generation_id=generation_id,
+                    parent_id=parent_id,
+                    status=STATUS_PUBLISHED if decision.publish else STATUS_GATED,
+                    hyperparams=list(best_params),
+                    eval_metric=best_score,
+                    train_count=sum(len(b) for b in all_train.blocks()),
+                    test_count=len(test_new),
+                    wall_time_sec=time.monotonic() - t0,
+                    content_hash=content_hash_of(pmml_bytes),
+                    created_at_ms=timestamp_ms,
+                    gate_reason=None if decision.publish else decision.reason,
+                )
+            )
+
+            if not decision.publish:
+                log.warning(
+                    "generation %s gated, not published: %s", generation_id, decision.reason
+                )
+                return
+
+            store.set_champion(generation_id, now_ms=timestamp_ms)
 
             if model_update_topic is None:
                 log.info("not publishing model to update topic since none is configured")
@@ -205,7 +309,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                         metrics_prefix="batch.publish",
                     )
                 else:
-                    ref = storage.join(final_dir, MODEL_FILE_NAME)
+                    # a MODEL-REF names the *generation dir* — registry-
+                    # resolvable (manifest + side artifacts travel with the
+                    # document), never a bare file path
+                    ref = store.generation_dir(generation_id)
                     self.publish_retry.call(
                         lambda: model_update_topic.send("MODEL-REF", ref),
                         retry_on=(ConnectionError, OSError),
@@ -216,6 +323,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 )
         finally:
             shutil.rmtree(candidates_root, ignore_errors=True)
+        store.gc(self.retention_max_generations, never_delete={generation_id})
 
     def _find_best_candidate(
         self,
@@ -223,7 +331,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         combos: list[list],
         all_train: Iterable[KeyMessage],
         test_data: list[KeyMessage],
-    ) -> tuple[Path, Element] | None:
+    ) -> tuple[float, Path, Element, Sequence] | None:
         # Disjoint sub-meshes: with N>1 parallel candidates and enough
         # devices, each candidate trains on its own contiguous device
         # subset — genuinely concurrent accelerator work, the analogue of
@@ -238,7 +346,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             else None
         )
 
-        def build_and_eval(i: int) -> tuple[float, Path, Element] | None:
+        def build_and_eval(i: int) -> tuple[float, Path, Element, Sequence] | None:
             candidate_path = candidates_root / str(i)
             candidate_path.mkdir(parents=True, exist_ok=True)
             hyper_parameters = combos[i]
@@ -265,12 +373,12 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                     log.exception("failed to evaluate candidate %d", i)
                     return None
             log.info("candidate %d params=%s eval=%s", i, hyper_parameters, eval_score)
-            return eval_score, candidate_path, model
+            return eval_score, candidate_path, model, hyper_parameters
 
         results = collect_in_parallel(
             len(combos), build_and_eval, parallelism=self.eval_parallelism
         )
-        best: tuple[float, Path, Element] | None = None
+        best: tuple[float, Path, Element, Sequence] | None = None
         for r in results:
             if r is None:
                 continue
@@ -285,4 +393,4 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         if best is None:
             return None
         log.info("best candidate eval=%s", best[0])
-        return best[1], best[2]
+        return best

@@ -342,8 +342,16 @@ def train_als(
     workspace_elems: int = 1 << 27,
     shard_factors: bool = False,
     matmul_dtype: str | None = None,
+    init_y: np.ndarray | None = None,
 ) -> ALSModel:
     """Full ALS training run.
+
+    ``init_y`` [num_items, features] warm-starts the item factors from a
+    previous generation (the first half-sweep then solves X against
+    near-converged Y instead of noise); rows default to the usual random
+    init where the caller has no previous factor (new items). A shape
+    mismatch silently falls back to cold init. Replicated-factor path
+    only — the sharded path's permuted layout cold-starts.
 
     COO inputs are int32/float32 numpy arrays. With ``mesh``, neighbor
     buckets are row-sharded over the 'data' axis; factors are replicated
@@ -388,9 +396,21 @@ def train_als(
     # row). Host RNG in natural row order so the sharded-factor mode
     # (which permutes the same init) is step-identical with this path.
     y0 = np.zeros((num_items + 1, features), np.float32)
-    y0[:num_items] = 0.1 * np.random.default_rng(seed_val).standard_normal(
-        (num_items, features)
-    ).astype(np.float32)
+    if init_y is not None and np.shape(init_y) == (num_items, features):
+        y0[:num_items] = np.asarray(init_y, dtype=np.float32)
+    else:
+        if init_y is not None:
+            # feature count or item universe changed under us: warm-start
+            # is an optimization, never a correctness dependency
+            import logging
+
+            logging.getLogger(__name__).info(
+                "init_y shape %s != (%d, %d); cold-starting",
+                np.shape(init_y), num_items, features,
+            )
+        y0[:num_items] = 0.1 * np.random.default_rng(seed_val).standard_normal(
+            (num_items, features)
+        ).astype(np.float32)
 
     u_chunks = [b.chunk for b in u_buckets]
     i_chunks = [b.chunk for b in i_buckets]

@@ -74,9 +74,13 @@ def train_kmeans(
     init: str = "k-means||",
     mesh: Optional[Mesh] = None,
     seed: int | None = None,
+    initial_centers: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray, float]:
     """Returns (centers [k,d], counts [k], cost). Padded internally so the
-    point rows shard evenly over the mesh."""
+    point rows shard evenly over the mesh. ``initial_centers`` [k, d]
+    seeds Lloyd directly (warm-start from a previous generation's
+    centers); a shape mismatch silently falls back to the configured
+    ``init`` so a changed k or feature dim cold-starts."""
     from oryx_tpu.common import rng as rng_mod
 
     points = np.asarray(points, dtype=np.float32)
@@ -87,6 +91,10 @@ def train_kmeans(
     gen = np.random.default_rng(rng_mod.next_seed() if seed is None else seed)
 
     def pick_init():
+        if initial_centers is not None:
+            warm = np.asarray(initial_centers, dtype=np.float32)
+            if warm.shape == (k, d):
+                return warm.copy()
         if init == "random":
             return points[gen.choice(n, size=k, replace=False)]
         return _kmeans_parallel_init(points, k, gen)

@@ -1,0 +1,47 @@
+"""Model registry: generation lineage, champion/challenger gating,
+warm-start surfacing, serving rollback, and retention GC.
+
+The reference's model lifecycle ends at MLUpdate's temp->rename promotion
+into ``model_dir/<timestampMs>/`` plus a fire-and-forget publish
+(MLUpdate.java:192-241). This package is the model-validation/lineage
+layer production pipelines put between training and serving (the TFX
+Evaluator/Pusher pattern):
+
+- ``manifest``  — one JSON manifest per generation, written atomically
+  next to ``model.pmml`` at promotion time (lineage, hyperparams, eval
+  metric, record counts, wall time, content hash).
+- ``store``     — lists/reads generations locally or remotely over
+  ``common/storage`` and maintains the ``CHAMPION`` pointer file
+  (atomic rename), plus count-based retention GC.
+- ``gate``      — champion/challenger gate: a candidate that regresses
+  the champion's eval metric beyond ``oryx.ml.gate.max-regression`` is
+  archived but not published.
+- ``tracking``  — serving-side live-generation tracking + duplicate
+  MODEL suppression (dedupe by generation id).
+
+See docs/model-registry.md for schema, gate semantics, and the rollback
+runbook.
+"""
+
+from oryx_tpu.registry.gate import ChampionGate, GateDecision
+from oryx_tpu.registry.manifest import (
+    GENERATION_EXTENSION,
+    MANIFEST_FILE_NAME,
+    PARENT_EXTENSION,
+    GenerationManifest,
+)
+from oryx_tpu.registry.store import CHAMPION_FILE_NAME, RegistryStore, publish_generation
+from oryx_tpu.registry.tracking import GenerationTracker
+
+__all__ = [
+    "CHAMPION_FILE_NAME",
+    "ChampionGate",
+    "GENERATION_EXTENSION",
+    "GateDecision",
+    "GenerationManifest",
+    "GenerationTracker",
+    "MANIFEST_FILE_NAME",
+    "PARENT_EXTENSION",
+    "RegistryStore",
+    "publish_generation",
+]

@@ -1,0 +1,103 @@
+"""Champion/challenger gate: should a new candidate be published?
+
+The MLUpdate evaluation contract is higher-is-better (MLUpdate.java's
+evaluate). The gate compares the freshly-trained challenger's eval metric
+against the current champion's (read from its manifest) and blocks the
+publish when the challenger regresses by more than
+``oryx.ml.gate.max-regression`` — an *absolute* tolerance in the metric's
+own units (negated RMSE for ALS, silhouette-like score for k-means, ...).
+A gated generation is still promoted to the model dir with
+``status = "gated"`` in its manifest — archived for forensics, invisible
+to serving.
+
+The gate is deliberately permissive on missing evidence: no champion yet,
+an unreadable champion manifest, a champion with no recorded metric, or a
+NaN challenger metric (test-fraction = 0 trains have nothing to evaluate
+against) all publish. Gating on absent data would wedge a pipeline that
+never evaluates.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass
+
+from oryx_tpu.common import metrics
+from oryx_tpu.common.config import Config
+from oryx_tpu.registry.store import RegistryStore
+
+log = logging.getLogger(__name__)
+
+GATED_COUNTER = "ml.gate.gated"
+PASSED_COUNTER = "ml.gate.passed"
+
+
+@dataclass
+class GateDecision:
+    publish: bool
+    reason: str | None = None
+    champion_id: str | None = None
+    champion_metric: float | None = None
+    candidate_metric: float | None = None
+
+
+class ChampionGate:
+    def __init__(self, config: Config) -> None:
+        self.max_regression = config.get_optional_float("oryx.ml.gate.max-regression")
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_regression is not None
+
+    def decide(self, store: RegistryStore, candidate_metric: float | None) -> GateDecision:
+        if not self.enabled:
+            return GateDecision(publish=True, reason="gate disabled")
+        champion_id = store.champion_id()
+        if champion_id is None:
+            return self._passed(GateDecision(publish=True, reason="no champion yet"))
+        manifest = store.read_manifest(champion_id)
+        champion_metric = manifest.eval_metric if manifest is not None else None
+        if champion_metric is None or math.isnan(champion_metric):
+            return self._passed(
+                GateDecision(
+                    publish=True,
+                    reason="champion has no recorded eval metric",
+                    champion_id=champion_id,
+                )
+            )
+        if candidate_metric is None or math.isnan(candidate_metric):
+            return self._passed(
+                GateDecision(
+                    publish=True,
+                    reason="candidate has no eval metric (nothing to compare)",
+                    champion_id=champion_id,
+                    champion_metric=champion_metric,
+                )
+            )
+        regression = champion_metric - candidate_metric
+        decision = GateDecision(
+            publish=regression <= self.max_regression,
+            champion_id=champion_id,
+            champion_metric=champion_metric,
+            candidate_metric=candidate_metric,
+        )
+        if decision.publish:
+            decision.reason = (
+                f"candidate {candidate_metric} within {self.max_regression} "
+                f"of champion {champion_metric}"
+            )
+            return self._passed(decision)
+        decision.reason = (
+            f"candidate {candidate_metric} regresses champion {champion_metric} "
+            f"(generation {champion_id}) by {regression}, beyond "
+            f"max-regression {self.max_regression}"
+        )
+        metrics.registry.counter(GATED_COUNTER).inc()
+        log.warning("challenger gated: %s", decision.reason)
+        return decision
+
+    @staticmethod
+    def _passed(decision: GateDecision) -> GateDecision:
+        metrics.registry.counter(PASSED_COUNTER).inc()
+        return decision

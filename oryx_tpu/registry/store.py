@@ -1,0 +1,187 @@
+"""Registry store: generation listing, CHAMPION pointer, retention GC.
+
+A thin, stateless view over ``oryx.batch.storage.model-dir`` through
+``common/storage``, so it works identically on a local filesystem and on
+an object store (``gs://...``). Layout::
+
+    model_dir/
+      CHAMPION                  <- pointer file: JSON {"generation_id": ...}
+      <timestampMs>/            <- one generation
+        model.pmml
+        manifest.json
+        ...side artifacts (X/, Y/, ...)
+
+The CHAMPION pointer is updated by atomic rename (``storage.write_text``
+goes through temp+rename locally, temp+mv on object stores) so every
+reader sees either the old champion or the new one, never a torn write.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import time
+
+from oryx_tpu.common import metrics, storage
+from oryx_tpu.registry.manifest import MANIFEST_FILE_NAME, GenerationManifest
+
+log = logging.getLogger(__name__)
+
+CHAMPION_FILE_NAME = "CHAMPION"
+MODEL_FILE_NAME = "model.pmml"
+
+_GENERATION_RE = re.compile(r"^\d+$")
+
+
+def is_generation_id(name: str) -> bool:
+    return bool(_GENERATION_RE.match(name))
+
+
+def generation_id_from_ref(ref: str) -> str | None:
+    """Parse the generation id out of a registry-resolvable MODEL-REF
+    path (a generation dir, or a file directly under one). None when the
+    path does not point into a registry layout."""
+    parts = str(ref).rstrip("/").split("/")
+    for name in reversed(parts):
+        if is_generation_id(name):
+            return name
+    return None
+
+
+class RegistryStore:
+    """List/read generations and maintain the CHAMPION pointer."""
+
+    def __init__(self, model_dir: str) -> None:
+        self.model_dir = str(model_dir).rstrip("/")
+
+    # -- paths ---------------------------------------------------------------
+
+    def generation_dir(self, generation_id: str) -> str:
+        return storage.join(self.model_dir, str(generation_id))
+
+    def pmml_uri(self, generation_id: str) -> str:
+        return storage.join(self.generation_dir(generation_id), MODEL_FILE_NAME)
+
+    def manifest_uri(self, generation_id: str) -> str:
+        return storage.join(self.generation_dir(generation_id), MANIFEST_FILE_NAME)
+
+    # -- listing / manifests -------------------------------------------------
+
+    def list_generations(self) -> list[str]:
+        """Generation ids (numeric dir names), oldest first."""
+        return sorted(
+            (n for n in storage.list_names(self.model_dir) if is_generation_id(n)),
+            key=int,
+        )
+
+    def read_manifest(self, generation_id: str) -> GenerationManifest | None:
+        uri = self.manifest_uri(generation_id)
+        try:
+            if not storage.exists(uri):
+                return None
+            return GenerationManifest.from_json(storage.read_text(uri))
+        except Exception:
+            log.warning("unreadable manifest for generation %s", generation_id, exc_info=True)
+            return None
+
+    def write_manifest(self, manifest: GenerationManifest) -> None:
+        storage.write_text(self.manifest_uri(manifest.generation_id), manifest.to_json())
+
+    def read_pmml_text(self, generation_id: str) -> str | None:
+        uri = self.pmml_uri(generation_id)
+        if not storage.exists(uri):
+            return None
+        return storage.read_text(uri)
+
+    def has_generation(self, generation_id: str) -> bool:
+        return storage.exists(self.pmml_uri(generation_id))
+
+    # -- champion pointer ----------------------------------------------------
+
+    def champion_id(self) -> str | None:
+        uri = storage.join(self.model_dir, CHAMPION_FILE_NAME)
+        try:
+            if not storage.exists(uri):
+                return None
+            data = json.loads(storage.read_text(uri))
+            return str(data["generation_id"])
+        except Exception:
+            log.warning("unreadable CHAMPION pointer under %s", self.model_dir, exc_info=True)
+            return None
+
+    def champion_manifest(self) -> GenerationManifest | None:
+        champion = self.champion_id()
+        return self.read_manifest(champion) if champion else None
+
+    def set_champion(self, generation_id: str, now_ms: int | None = None) -> None:
+        """Atomic-rename update of the CHAMPION pointer."""
+        storage.write_text(
+            storage.join(self.model_dir, CHAMPION_FILE_NAME),
+            json.dumps(
+                {
+                    "generation_id": str(generation_id),
+                    "updated_at_ms": int(time.time() * 1000) if now_ms is None else now_ms,
+                }
+            ),
+        )
+
+    # -- retention GC --------------------------------------------------------
+
+    def gc(self, max_generations: int, never_delete: set[str] | None = None) -> list[str]:
+        """Keep the newest ``max_generations`` generations plus the
+        champion plus every id in ``never_delete`` (the serving layer's
+        live generation). Returns the deleted ids. ``max_generations < 0``
+        disables."""
+        if max_generations < 0:
+            return []
+        keep: set[str] = set(never_delete or ())
+        champion = self.champion_id()
+        if champion:
+            keep.add(champion)
+        gens = self.list_generations()
+        newest = gens[len(gens) - max_generations :] if max_generations > 0 else []
+        keep.update(newest)
+        deleted = []
+        for gen in gens:
+            if gen in keep:
+                continue
+            storage.delete(self.generation_dir(gen), recursive=True)
+            deleted.append(gen)
+            metrics.registry.counter("ml.registry.gc.deleted").inc()
+        if deleted:
+            log.info(
+                "registry GC: deleted %d generation(s) %s (kept %d)",
+                len(deleted), deleted, len(gens) - len(deleted),
+            )
+        return deleted
+
+
+def publish_generation(
+    store: RegistryStore,
+    generation_id: str,
+    producer,
+    max_message_size: int,
+    retry_policy=None,
+) -> str:
+    """(Re)publish an archived generation onto the update topic: inline
+    MODEL when the PMML fits the topic's max message size, MODEL-REF to
+    the *generation dir* otherwise (the registry-resolvable form — never
+    a bare file path). Shared by MLUpdate's publish path and the serving
+    layer's rollback endpoint. Returns the key used."""
+    pmml_text = store.read_pmml_text(generation_id)
+    if pmml_text is None:
+        raise FileNotFoundError(f"generation {generation_id} has no {MODEL_FILE_NAME}")
+    if len(pmml_text.encode("utf-8")) <= max_message_size:
+        key, payload = "MODEL", pmml_text
+    else:
+        key, payload = "MODEL-REF", store.generation_dir(generation_id)
+    if retry_policy is not None:
+        retry_policy.call(
+            lambda: producer.send(key, payload),
+            retry_on=(ConnectionError, OSError),
+            metrics_prefix="batch.publish",
+        )
+    else:
+        producer.send(key, payload)
+    return key

@@ -1,0 +1,110 @@
+"""A PMML-aware probe ServingModelManager for registry tests.
+
+The example app's manager speaks JSON word counts; registry e2e tests
+need a manager that resolves MODEL / MODEL-REF messages exactly the way
+the real apps do (app_pmml.read_pmml_from_update_message) and then lets
+the test ask *which* generation it is serving — including through its
+own ``/probe/model`` resource, so HTTP-level assertions exercise the full
+router + manager + tracker stack. Configure with
+
+    oryx.serving.model-manager-class =
+        "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+    oryx.serving.application-resources = ["oryx_tpu.registry.testing"]
+
+Lives in the package (not tests/) because model-manager-class must be an
+importable module path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.ml.update import MLUpdate
+from oryx_tpu.serving.web import OryxServingException, Request, Response, ServingContext, resource
+
+
+class ScriptedMetricUpdate(MLUpdate):
+    """An MLUpdate whose eval metric is scripted by config
+    (``oryx.test.scripted-metric``) — the knob registry e2e tests turn to
+    push one generation past the champion gate and throw the next into it.
+    The train/test split is overridden to a deterministic half/half so
+    ``evaluate`` always runs (NaN metrics pass the gate by design)."""
+
+    def __init__(self, config) -> None:
+        super().__init__(config)
+        self.scripted_metric = config.get_float("oryx.test.scripted-metric")
+
+    def build_model(self, train_data, hyper_parameters, candidate_path):
+        root = pmml_io.build_skeleton_pmml()
+        pmml_io.sub(
+            root,
+            "Extension",
+            {"name": "scripted-metric", "value": str(self.scripted_metric)},
+        )
+        return root
+
+    def evaluate(self, model, model_parent_path, test_data, train_data):
+        return self.scripted_metric
+
+    def split_new_data_to_train_test(self, new_data):
+        half = max(1, len(new_data) // 2)
+        return new_data[:half], new_data[half:]
+
+
+class PMMLProbeModel(ServingModel):
+    def __init__(self, generation_id: str | None, extensions: dict[str, str]) -> None:
+        self.generation_id = generation_id
+        self.extensions = extensions
+
+    def get_fraction_loaded(self) -> float:
+        return 1.0
+
+
+class PMMLProbeServingModelManager(AbstractServingModelManager):
+    """Swaps in whatever PMML generation arrives; counts swaps so dedupe
+    tests can assert a duplicate MODEL never re-triggered one."""
+
+    def __init__(self, config) -> None:
+        super().__init__(config)
+        self._lock = threading.Lock()
+        self._model: PMMLProbeModel | None = None
+        self.model_swaps = 0
+
+    def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
+        from oryx_tpu.app import pmml as app_pmml
+        from oryx_tpu.common import pmml as pmml_io
+        from oryx_tpu.registry.manifest import GENERATION_EXTENSION
+
+        for km in update_iterator:
+            if km.key not in ("MODEL", "MODEL-REF"):
+                continue
+            pmml = app_pmml.read_pmml_from_update_message(km.key, km.message)
+            if pmml is None:
+                continue
+            extensions = {
+                e.get("name"): e.get("value")
+                for e in pmml_io.findall(pmml, "Extension")
+                if e.get("name")
+            }
+            with self._lock:
+                self._model = PMMLProbeModel(
+                    extensions.get(GENERATION_EXTENSION), extensions
+                )
+                self.model_swaps += 1
+
+    def get_model(self) -> PMMLProbeModel | None:
+        with self._lock:
+            return self._model
+
+
+@resource("GET", "/probe/model")
+def probe_model(ctx: ServingContext, req: Request) -> Response:
+    model = ctx.model_manager.get_model() if ctx.model_manager else None
+    if model is None:
+        raise OryxServingException(503, "model not yet available")
+    body = {"generation_id": model.generation_id, "extensions": model.extensions}
+    return Response(200, body, content_type="application/json")

@@ -1,0 +1,106 @@
+"""Serving-side generation tracking and duplicate-MODEL suppression.
+
+The serving layer replays the update topic from offset 0 and then follows
+it live. Every MODEL / MODEL-REF record that flows past carries its
+generation identity — a ``generation`` Extension inside inline PMML, the
+generation dir name inside a ref — and this tracker watches the stream to
+answer "which generation is live right now?" for /healthz, /metrics, and
+the ``models``/``health`` CLI probes.
+
+It also makes the stream idempotent per generation: an at-least-once bus
+(and the fault+ chaos wrapper deliberately) can deliver the same MODEL
+twice, and without suppression the second delivery would re-trigger a
+full model swap and skew the staleness clock. A record whose generation
+equals the *current* live generation is filtered out of the block before
+the model manager sees it. Only the current generation is deduped — a
+rollback republish of an *older* generation changes the id and passes
+through, which is exactly what rollback needs.
+
+Records without a parseable generation (legacy inline PMML, foreign
+paths) pass through untouched and reset tracking to "unknown" — never
+dropped, so a registry-less producer keeps working.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from oryx_tpu.common import metrics
+from oryx_tpu.common.records import RecordBlock
+from oryx_tpu.registry.manifest import GENERATION_EXTENSION
+from oryx_tpu.registry.store import generation_id_from_ref
+
+log = logging.getLogger(__name__)
+
+_MODEL_KEYS = (b"MODEL", b"MODEL-REF")
+
+LIVE_GENERATION_GAUGE = "serving.model.live-generation"
+DUPLICATES_COUNTER = "serving.model.duplicates-suppressed"
+
+
+def generation_of_model_message(key: str, message: str) -> str | None:
+    """The generation id a MODEL / MODEL-REF record carries, if any."""
+    if key == "MODEL":
+        try:
+            from oryx_tpu.app import pmml as app_pmml
+            from oryx_tpu.common import pmml as pmml_io
+
+            return app_pmml.get_extension_value(
+                pmml_io.from_string(message), GENERATION_EXTENSION
+            )
+        except Exception:
+            return None
+    if key == "MODEL-REF":
+        return generation_id_from_ref(message)
+    return None
+
+
+class GenerationTracker:
+    """Tracks the live generation over a stream of update RecordBlocks and
+    filters duplicate deliveries of the live generation's MODEL record."""
+
+    def __init__(self, health=None) -> None:
+        self.live_generation: str | None = None
+        self._health = health
+
+    def _set_live(self, generation_id: str | None) -> None:
+        self.live_generation = generation_id
+        if self._health is not None:
+            self._health.live_generation = generation_id
+        if generation_id is not None and generation_id.isdigit():
+            metrics.registry.gauge(LIVE_GENERATION_GAUGE).set(int(generation_id))
+
+    def filter_block(self, block: RecordBlock | None) -> RecordBlock | None:
+        """Apply tracking to one polled block; returns the block with
+        duplicate live-generation MODEL records removed (None when nothing
+        survives). Blocks without model records return unchanged — the
+        no-model fast path is one vectorized key compare."""
+        if block is None or len(block) == 0 or block.keys is None:
+            return block
+        keys = block.keys
+        is_model = (keys == _MODEL_KEYS[0]) | (keys == _MODEL_KEYS[1])
+        if not bool(is_model.any()):
+            return block
+        keep = np.ones(len(block), dtype=bool)
+        msgs = block.messages
+        for i in np.flatnonzero(is_model):
+            key = keys[i].decode("utf-8", "replace")
+            message = msgs[i].decode("utf-8", "replace")
+            generation = generation_of_model_message(key, message)
+            if generation is not None and generation == self.live_generation:
+                keep[i] = False
+                metrics.registry.counter(DUPLICATES_COUNTER).inc()
+                log.info("suppressed duplicate %s for live generation %s", key, generation)
+            else:
+                self._set_live(generation)
+        if bool(keep.all()):
+            return block
+        if not bool(keep.any()):
+            return None
+        return RecordBlock(
+            keys[keep],
+            msgs[keep],
+            block.none_keys[keep] if block.none_keys is not None else None,
+        )

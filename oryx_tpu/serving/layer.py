@@ -154,6 +154,10 @@ class ServingHealth:
         self.stream_healthy: bool | None = None
         self.last_update_time: float | None = None
         self.consume_thread: SupervisedThread | None = None
+        # generation id of the live model (set by the GenerationTracker as
+        # MODEL/MODEL-REF records flow past); None until one arrives or
+        # when models carry no generation identity
+        self.live_generation: str | None = None
 
     def mark_stream_ok(self) -> None:
         self.stream_healthy = True
@@ -208,6 +212,7 @@ def _healthz(ctx: ServingContext, req: Request) -> Response:
         "degraded": health.degraded,
         "stream_healthy": health.stream_healthy,
         "staleness_seconds": health.staleness(),
+        "live_generation": health.live_generation,
     }
     return Response(200 if health.alive else 503, body, content_type="application/json")
 
@@ -235,7 +240,64 @@ def _metrics(ctx: ServingContext, req: Request) -> Response:
             "type": "gauge",
             "value": getattr(model, "get_fraction_loaded", lambda: 1.0)(),
         }
+    if ctx.health is not None and ctx.health.live_generation is not None:
+        snap["serving.model.live_generation"] = {
+            "type": "gauge",
+            "value": ctx.health.live_generation,
+        }
     return Response(200, snap, content_type="application/json")
+
+
+@resource("GET", "/model/generations")
+def _model_generations(ctx: ServingContext, req: Request) -> Response:
+    """The registry's view of the model dir plus what this instance is
+    actually serving — the skew between the two is what the `health` CLI
+    probe alerts on (docs/model-registry.md)."""
+    registry = ctx.registry
+    if registry is None:
+        raise OryxServingException(404, "no model registry configured")
+    generations = []
+    for gen_id in registry.list_generations():
+        manifest = registry.read_manifest(gen_id)
+        entry = {"generation_id": gen_id}
+        if manifest is not None:
+            entry.update(
+                status=manifest.status,
+                parent_id=manifest.parent_id,
+                eval_metric=manifest.eval_metric,
+                created_at_ms=manifest.created_at_ms,
+            )
+        generations.append(entry)
+    body = {
+        "live_generation": ctx.health.live_generation if ctx.health else None,
+        "champion": registry.champion_id(),
+        "generations": generations,
+    }
+    return Response(200, body, content_type="application/json")
+
+
+@resource("POST", "/model/rollback/{generationID}")
+def _model_rollback(ctx: ServingContext, req: Request) -> Response:
+    """Republish an archived generation onto the update topic so every
+    consumer (this instance, other serving replicas, the speed layer)
+    converges on it, and move the CHAMPION pointer so subsequent batch
+    runs gate/warm-start against the rolled-back generation."""
+    registry = ctx.registry
+    if registry is None:
+        raise OryxServingException(404, "no model registry configured")
+    if ctx.config.get_bool("oryx.serving.api.read-only"):
+        raise OryxServingException(403, "serving layer is read-only")
+    if ctx.rollback_publisher is None:
+        raise OryxServingException(503, "no update topic configured")
+    generation_id = req.params["generationID"]
+    if not registry.has_generation(generation_id):
+        raise OryxServingException(404, f"no such generation {generation_id}")
+    key = ctx.rollback_publisher(generation_id)
+    registry.set_champion(generation_id)
+    metrics.registry.counter("serving.model.rollbacks").inc()
+    log.warning("rollback: republished generation %s as %s", generation_id, key)
+    body = {"generation_id": generation_id, "published_as": key}
+    return Response(200, body, content_type="application/json")
 
 
 def _observe_request(method: str, status: int, t0: float) -> None:
@@ -309,6 +371,18 @@ class ServingLayer:
         self.health = ServingHealth()
         self.retry_policy = RetryPolicy.from_config(config, "oryx.serving.retry")
 
+        # model registry over the batch model dir: /model/generations +
+        # rollback, and live-generation tracking with duplicate-MODEL
+        # suppression on the update stream
+        from oryx_tpu.registry.store import RegistryStore
+        from oryx_tpu.registry.tracking import GenerationTracker
+
+        model_dir = config.get_optional_string("oryx.batch.storage.model-dir")
+        self.registry_store = RegistryStore(model_dir) if model_dir else None
+        self.generation_tracker = GenerationTracker(self.health)
+        self._rollback_producer = None
+        self._rollback_lock = threading.Lock()
+
         self.router = Router()
         if self.app_resources:
             for mod in self.app_resources:
@@ -364,7 +438,36 @@ class ServingLayer:
                 self.health.consume_thread = self._consume_thread
                 self._consume_thread.start()
 
-        ctx = ServingContext(self.model_manager, self.input_producer, self.config, self.health)
+        rollback_publisher = None
+        if self.registry_store is not None and update_broker_loc and update_topic:
+            max_size = cfg.get_int("oryx.update-topic.message.max-size")
+
+            def rollback_publisher(generation_id: str) -> str:
+                from oryx_tpu.registry.store import publish_generation
+
+                # lazy producer: rollbacks are rare, no point holding an
+                # update-topic producer open on every serving instance
+                with self._rollback_lock:
+                    if self._rollback_producer is None:
+                        self._rollback_producer = get_broker(update_broker_loc).producer(
+                            update_topic
+                        )
+                    return publish_generation(
+                        self.registry_store,
+                        generation_id,
+                        self._rollback_producer,
+                        max_size,
+                        retry_policy=self.retry_policy,
+                    )
+
+        ctx = ServingContext(
+            self.model_manager,
+            self.input_producer,
+            self.config,
+            self.health,
+            registry=self.registry_store,
+            rollback_publisher=rollback_publisher,
+        )
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
         tls_ctx = None
@@ -408,7 +511,10 @@ class ServingLayer:
                 self.health.mark_stream_down()
                 raise
             self.health.mark_stream_ok()
-            if block is not None:
+            # track live generation + suppress duplicate deliveries of the
+            # live generation's MODEL before the manager sees the block
+            block = self.generation_tracker.filter_block(block)
+            if block is not None and len(block) > 0:
                 yield block
                 self.health.mark_update()
 
@@ -438,6 +544,8 @@ class ServingLayer:
             self.model_manager.close()
         if self.input_producer is not None:
             self.input_producer.close()
+        if self._rollback_producer is not None:
+            self._rollback_producer.close()
         if getattr(self, "_batcher_retained", False):
             self._batcher_retained = False
             from oryx_tpu.serving.batcher import release_default_batcher

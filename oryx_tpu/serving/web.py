@@ -100,13 +100,27 @@ class ServingContext:
     producer, and config (the reference stores these in servlet-context
     attributes, OryxResource.java:11-36 / AbstractOryxResource.java:54-73)."""
 
-    def __init__(self, model_manager, input_producer, config, health=None) -> None:
+    def __init__(
+        self,
+        model_manager,
+        input_producer,
+        config,
+        health=None,
+        registry=None,
+        rollback_publisher=None,
+    ) -> None:
         self.model_manager = model_manager
         self.input_producer = input_producer
         self.config = config
         # ServingHealth (oryx_tpu/serving/layer.py) when run under a full
         # ServingLayer; None in bare router tests
         self.health = health
+        # RegistryStore over the batch model dir (oryx_tpu/registry/store.py)
+        # when one is configured; backs /model/generations and rollback
+        self.registry = registry
+        # callable(generation_id) -> publish key, provided by ServingLayer
+        # (republishes an archived generation onto the update topic)
+        self.rollback_publisher = rollback_publisher
 
 
 # ---------------------------------------------------------------------------

@@ -61,3 +61,8 @@ def pytest_configure(config):
         "chaos: deterministic fault-injection tests (fault+ bus locators, "
         "seeded); fast and tier-1-safe, select with -m chaos",
     )
+    config.addinivalue_line(
+        "markers",
+        "registry: model-registry subsystem tests (manifests, gating, "
+        "rollback, retention GC); fast and tier-1-safe, select with -m registry",
+    )

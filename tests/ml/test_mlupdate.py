@@ -5,6 +5,7 @@ import math
 from pathlib import Path
 
 from oryx_tpu import bus
+from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.common import config as C, pmml as pmml_io
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.ml.update import MLUpdate
@@ -96,9 +97,15 @@ def test_model_ref_when_too_large(tmp_path):
         update.run_update(777, data(20), [], str(tmp_path / "model"), producer)
     msgs = tail.poll(timeout=1.0)
     assert [m.key for m in msgs] == ["MODEL-REF"]
+    # the ref is the registry-resolvable *generation dir*, not a bare
+    # file path: model.pmml and manifest.json live under it
     ref_path = Path(msgs[0].message)
-    assert ref_path.exists()
-    assert pmml_io.find(pmml_io.read_pmml(ref_path), "Extension") is not None
+    assert ref_path == tmp_path / "model" / "777"
+    assert (ref_path / "model.pmml").exists()
+    assert (ref_path / "manifest.json").exists()
+    resolved = app_pmml.read_pmml_from_update_message("MODEL-REF", msgs[0].message)
+    assert pmml_io.find(resolved, "Extension") is not None
+    assert app_pmml.get_extension_value(resolved, "generation") == "777"
 
 
 def test_no_data_no_model(tmp_path):

@@ -1,0 +1,134 @@
+"""Chaos coverage for model publish: duplicated and dropped MODEL
+deliveries over the fault+ bus must not desync the serving layer's
+live-generation tracking (satellite: dedupe by generation id)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.bus import faultbus
+from oryx_tpu.common import config as C, metrics, pmml as pmml_io
+from oryx_tpu.registry.tracking import DUPLICATES_COUNTER
+from oryx_tpu.serving.layer import ServingLayer
+
+pytestmark = [pytest.mark.registry, pytest.mark.chaos]
+
+
+def make_config(tmp_path, update_broker):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "RegChaos"
+          input-topic.broker = "inproc://reg-chaos-input"
+          update-topic.broker = "{update_broker}"
+          batch.storage {{ data-dir = "{tmp_path}/data/"
+                           model-dir = "{tmp_path}/model/" }}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+            application-resources = "oryx_tpu.registry.testing"
+          }}
+        }}
+        """
+    )
+
+
+def model_message(generation_id: str) -> str:
+    root = pmml_io.build_skeleton_pmml()
+    app_pmml.add_extension(root, "generation", generation_id)
+    return pmml_io.to_string(root)
+
+
+def probe_generation(serving):
+    model = serving.model_manager.get_model()
+    return model.generation_id if model is not None else None
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_duplicated_model_is_suppressed_by_generation(tmp_path):
+    """dup=1.0: every produce double-writes AND every consumer fetch is
+    redelivered — yet the manager swaps models exactly once per
+    generation, keyed by generation id."""
+    locator = "fault+inproc://reg-chaos-dup?dup=1.0&seed=3"
+    suppressed_before = metrics.registry.counter(DUPLICATES_COUNTER).value
+    serving = ServingLayer(make_config(tmp_path, locator))
+    serving.start()
+    try:
+        with bus.get_broker(locator).producer("OryxUpdate") as producer:
+            producer.send("MODEL", model_message("111"))
+        assert wait_for(lambda: probe_generation(serving) == "111")
+        # the duplicates flowed (chaos proven) and were all swallowed
+        assert wait_for(
+            lambda: metrics.registry.counter(DUPLICATES_COUNTER).value
+            >= suppressed_before + 1
+        )
+        assert faultbus.get_state(locator).duplicated_records > 0
+        time.sleep(0.5)  # let any straggler redelivery drain
+        assert serving.model_manager.model_swaps == 1
+
+        # tracking stays in sync: the NEXT generation still swaps in
+        with bus.get_broker(locator).producer("OryxUpdate") as producer:
+            producer.send("MODEL", model_message("112"))
+        assert wait_for(lambda: probe_generation(serving) == "112")
+        assert serving.model_manager.model_swaps == 2
+        assert serving.health.live_generation == "112"
+    finally:
+        serving.close()
+
+
+def test_dropped_model_is_redelivered(tmp_path):
+    """drop=0.6 on the consumer side: deliveries are lost in flight and
+    rewound, but the at-least-once bus eventually lands the MODEL and the
+    tracker converges on it exactly once. seed=1's roll sequence is
+    (0.512, 0.95, ...): the first delivery attempt is deterministically
+    dropped, the redelivery deterministically lands."""
+    locator = "fault+inproc://reg-chaos-drop?drop=0.6&seed=1"
+    serving = ServingLayer(make_config(tmp_path, locator))
+    serving.start()
+    try:
+        # produce over the unfaulted inner broker: this test aims the
+        # chaos at the delivery path only
+        with bus.get_broker("inproc://reg-chaos-drop").producer("OryxUpdate") as producer:
+            producer.send("MODEL", model_message("222"))
+        assert wait_for(lambda: probe_generation(serving) == "222", timeout=15.0)
+        state = faultbus.get_state(locator)
+        assert state.dropped_records > 0, "chaos never fired"
+        assert serving.model_manager.model_swaps == 1
+        assert serving.health.live_generation == "222"
+        # degraded-mode bookkeeping untouched: drops are silent rewinds,
+        # not poll errors
+        assert serving.health.stream_healthy is True
+    finally:
+        serving.close()
+
+
+def test_rollback_survives_duplication(tmp_path):
+    """A rollback republish of an OLDER generation must pass the dedupe
+    (only the current live id is suppressed) even when the bus duplicates
+    it."""
+    locator = "fault+inproc://reg-chaos-rb?dup=1.0&seed=9"
+    serving = ServingLayer(make_config(tmp_path, locator))
+    serving.start()
+    try:
+        with bus.get_broker(locator).producer("OryxUpdate") as producer:
+            producer.send("MODEL", model_message("300"))
+            producer.send("MODEL", model_message("400"))
+        assert wait_for(lambda: probe_generation(serving) == "400")
+        with bus.get_broker(locator).producer("OryxUpdate") as producer:
+            producer.send("MODEL", model_message("300"))  # the "rollback"
+        assert wait_for(lambda: probe_generation(serving) == "300")
+        assert serving.health.live_generation == "300"
+    finally:
+        serving.close()

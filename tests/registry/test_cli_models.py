@@ -1,0 +1,136 @@
+"""CLI operator surface: `oryx_tpu models list|show|rollback|gc` and the
+`health` probe's live-vs-champion skew detection (satellite f)."""
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+
+from oryx_tpu import bus, cli
+from oryx_tpu.common import config as C
+from oryx_tpu.registry.manifest import GenerationManifest
+from oryx_tpu.registry.store import RegistryStore
+from oryx_tpu.serving.layer import ServingLayer
+
+pytestmark = pytest.mark.registry
+
+BROKER = "inproc://registry-cli"
+
+
+def make_config(tmp_path, retention=-1):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "RegCLI"
+          input-topic.broker = "{BROKER}"
+          update-topic.broker = "{BROKER}"
+          batch.storage {{ data-dir = "{tmp_path}/data/"
+                           model-dir = "{tmp_path}/model/" }}
+          ml.retention.max-generations = {retention}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+            application-resources = "oryx_tpu.registry.testing"
+          }}
+        }}
+        """
+    )
+
+
+def seed_registry(tmp_path) -> RegistryStore:
+    from oryx_tpu.app import pmml as app_pmml
+    from oryx_tpu.common import pmml as pmml_io
+
+    store = RegistryStore(str(tmp_path / "model"))
+    for gen, metric in (("100", 0.8), ("200", 0.9), ("300", 0.85)):
+        d = tmp_path / "model" / gen
+        d.mkdir(parents=True)
+        root = pmml_io.build_skeleton_pmml()
+        app_pmml.add_extension(root, "generation", gen)
+        pmml_io.write_pmml(root, d / "model.pmml")
+        store.write_manifest(GenerationManifest(generation_id=gen, eval_metric=metric))
+    store.set_champion("200")
+    return store
+
+
+def test_models_list_and_show(tmp_path):
+    cfg = make_config(tmp_path)
+    seed_registry(tmp_path)
+    out = io.StringIO()
+    assert cli.run_models(cfg, "list", None, out=out) == 0
+    lines = out.getvalue().strip().splitlines()
+    assert len(lines) == 3
+    assert lines[1].startswith("200\tpublished\teval=0.9") and "*champion*" in lines[1]
+    assert "*champion*" not in lines[0]
+
+    out = io.StringIO()
+    assert cli.run_models(cfg, "show", "100", out=out) == 0
+    assert json.loads(out.getvalue())["eval_metric"] == 0.8
+    assert cli.run_models(cfg, "show", "404", out=io.StringIO()) == 1
+    with pytest.raises(SystemExit):
+        cli.run_models(cfg, "show", None, out=io.StringIO())
+    with pytest.raises(SystemExit):
+        cli.run_models(cfg, "frobnicate", None, out=io.StringIO())
+
+
+def test_models_rollback_republishes_and_moves_champion(tmp_path):
+    cfg = make_config(tmp_path)
+    store = seed_registry(tmp_path)
+    broker = bus.get_broker(BROKER)
+    broker.create_topic("OryxUpdate", 1)
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    out = io.StringIO()
+    assert cli.run_models(cfg, "rollback", "100", out=out) == 0
+    assert "republished generation 100" in out.getvalue()
+    assert store.champion_id() == "100"
+    msgs = tail.poll(timeout=1.0)
+    assert [m.key for m in msgs] == ["MODEL"]
+    from oryx_tpu.app import pmml as app_pmml
+    from oryx_tpu.common import pmml as pmml_io
+
+    republished = pmml_io.from_string(msgs[0].message)
+    assert app_pmml.get_extension_value(republished, "generation") == "100"
+
+
+def test_models_gc_applies_retention(tmp_path):
+    cfg = make_config(tmp_path, retention=1)
+    store = seed_registry(tmp_path)  # champion = 200, newest = 300
+    out = io.StringIO()
+    assert cli.run_models(cfg, "gc", None, out=out) == 0
+    assert "deleted 1 generation(s)" in out.getvalue()
+    assert store.list_generations() == ["200", "300"]
+
+
+def test_health_reports_generation_skew(tmp_path):
+    cfg = make_config(tmp_path)
+    store = seed_registry(tmp_path)
+    serving = ServingLayer(cfg)
+    serving.start()
+    try:
+        with bus.get_broker(BROKER).producer("OryxUpdate") as producer:
+            producer.send(
+                "MODEL", (tmp_path / "model" / "200" / "model.pmml").read_text()
+            )
+        base = f"http://127.0.0.1:{serving.port}"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+                if json.loads(resp.read()).get("live_generation") == "200":
+                    break
+            time.sleep(0.05)
+        probe_cfg = cfg.with_overlay(f"oryx.serving.api.port = {serving.port}")
+
+        out = io.StringIO()
+        assert cli.run_health(probe_cfg, out=out) == 0
+        assert "generations: live=200 champion=200 (in sync)" in out.getvalue()
+
+        # serving answering from a generation the registry no longer
+        # endorses is exactly the skew the probe exists to catch
+        store.set_champion("300")
+        out = io.StringIO()
+        assert cli.run_health(probe_cfg, out=out) == 1
+        assert "generations: live=200 champion=300 SKEW" in out.getvalue()
+    finally:
+        serving.close()

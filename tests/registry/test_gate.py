@@ -1,0 +1,85 @@
+"""ChampionGate semantics: permissive on missing evidence, strict on a
+measured regression beyond oryx.ml.gate.max-regression."""
+
+import math
+
+import pytest
+
+from oryx_tpu.common import config as C, metrics
+from oryx_tpu.registry.gate import GATED_COUNTER, PASSED_COUNTER, ChampionGate
+from oryx_tpu.registry.manifest import GenerationManifest
+from oryx_tpu.registry.store import RegistryStore
+
+pytestmark = pytest.mark.registry
+
+
+def gate_config(max_regression="0.05"):
+    return C.get_default().with_overlay(
+        f"oryx.ml.gate.max-regression = {max_regression}"
+    )
+
+
+def store_with_champion(tmp_path, metric) -> RegistryStore:
+    store = RegistryStore(str(tmp_path))
+    gen_dir = tmp_path / "1000"
+    gen_dir.mkdir(exist_ok=True)
+    (gen_dir / "model.pmml").write_text("<PMML/>")
+    store.write_manifest(GenerationManifest(generation_id="1000", eval_metric=metric))
+    store.set_champion("1000")
+    return store
+
+
+def test_gate_disabled_by_default(tmp_path):
+    gate = ChampionGate(C.get_default())
+    assert not gate.enabled
+    decision = gate.decide(store_with_champion(tmp_path, 100.0), -100.0)
+    assert decision.publish
+    assert decision.reason == "gate disabled"
+
+
+def test_no_champion_publishes(tmp_path):
+    gate = ChampionGate(gate_config())
+    assert gate.enabled
+    decision = gate.decide(RegistryStore(str(tmp_path)), 0.5)
+    assert decision.publish
+    assert "no champion" in decision.reason
+
+
+def test_champion_without_metric_publishes(tmp_path):
+    gate = ChampionGate(gate_config())
+    decision = gate.decide(store_with_champion(tmp_path, None), 0.5)
+    assert decision.publish
+    decision = gate.decide(store_with_champion(tmp_path, math.nan), 0.5)
+    assert decision.publish
+
+
+def test_nan_candidate_publishes(tmp_path):
+    # test-fraction = 0 pipelines evaluate nothing; gating on NaN would
+    # wedge them forever
+    gate = ChampionGate(gate_config())
+    store = store_with_champion(tmp_path, 0.9)
+    assert gate.decide(store, math.nan).publish
+    assert gate.decide(store, None).publish
+
+
+def test_regression_beyond_tolerance_is_gated(tmp_path):
+    gate = ChampionGate(gate_config("0.05"))
+    store = store_with_champion(tmp_path, 0.90)
+    gated_before = metrics.registry.counter(GATED_COUNTER).value
+    decision = gate.decide(store, 0.80)
+    assert not decision.publish
+    assert decision.champion_id == "1000"
+    assert decision.champion_metric == 0.90
+    assert decision.candidate_metric == 0.80
+    assert "1000" in decision.reason and "max-regression" in decision.reason
+    assert metrics.registry.counter(GATED_COUNTER).value == gated_before + 1
+
+
+def test_within_tolerance_passes(tmp_path):
+    gate = ChampionGate(gate_config("0.05"))
+    store = store_with_champion(tmp_path, 0.90)
+    passed_before = metrics.registry.counter(PASSED_COUNTER).value
+    assert gate.decide(store, 0.90).publish  # equal
+    assert gate.decide(store, 0.86).publish  # regressed but within tolerance
+    assert gate.decide(store, 0.95).publish  # improved
+    assert metrics.registry.counter(PASSED_COUNTER).value == passed_before + 3

@@ -1,0 +1,38 @@
+"""Wires tools/lint_registry into tier-1: the registry subsystem must
+lint clean (ruff when available, stdlib AST fallback otherwise)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.registry
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+import lint_registry  # noqa: E402
+
+
+def test_registry_package_lints_clean():
+    rc, problems, engine = lint_registry.run_lint()
+    assert rc == 0, f"[{engine}] " + "\n".join(problems)
+
+
+def test_fallback_catches_real_problems(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "from json import *\n"
+        "def f(x=[]):\n"
+        "    return x\n"
+    )
+    problems = lint_registry._fallback_lint_file(bad)
+    kinds = "\n".join(problems)
+    assert "wildcard import" in kinds
+    assert "mutable default argument" in kinds
+    assert "unused import 'os'" in kinds
+
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert "syntax error" in lint_registry._fallback_lint_file(broken)[0]

@@ -1,0 +1,143 @@
+"""Registry acceptance e2e (ISSUE: gate + rollback under the inproc bus):
+generation A publishes and goes live, a deliberately-regressed generation
+B is gated (archived on disk, never on the update topic), generation C
+passes and goes live, then POST /model/rollback/A makes serving answer
+with generation A again — champion pointer following each transition."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C
+from oryx_tpu.registry.manifest import STATUS_GATED, STATUS_PUBLISHED
+from oryx_tpu.registry.store import RegistryStore
+from oryx_tpu.registry.testing import ScriptedMetricUpdate
+from oryx_tpu.serving.layer import ServingLayer
+
+pytestmark = pytest.mark.registry
+
+BROKER = "inproc://registry-e2e"
+
+
+def make_config(tmp_path, metric=1.0):
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "RegE2E"
+          input-topic.broker = "{BROKER}"
+          update-topic.broker = "{BROKER}"
+          batch.storage {{ data-dir = "{tmp_path}/data/"
+                           model-dir = "{tmp_path}/model/" }}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+            application-resources = "oryx_tpu.registry.testing"
+          }}
+          ml {{
+            eval {{ candidates = 1, test-fraction = 0.5 }}
+            gate.max-regression = 0.05
+          }}
+          test.scripted-metric = {metric}
+        }}
+        """
+    )
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_generation(tmp_path, timestamp_ms, metric):
+    """One batch generation driven through the real MLUpdate harness."""
+    update = ScriptedMetricUpdate(make_config(tmp_path, metric))
+    broker = bus.get_broker(BROKER)
+    broker.create_topic("OryxUpdate", 1)
+    data = [KeyMessage(None, f"r{i}") for i in range(6)]
+    with broker.producer("OryxUpdate") as producer:
+        update.run_update(timestamp_ms, data, [], str(tmp_path / "model"), producer)
+
+
+def probe_generation(base):
+    status, body = http("GET", f"{base}/probe/model")
+    if status != 200:
+        return None
+    return json.loads(body)["generation_id"]
+
+
+def test_gate_and_rollback_e2e(tmp_path):
+    store = RegistryStore(str(tmp_path / "model"))
+    serving = ServingLayer(make_config(tmp_path))
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    try:
+        # --- generation A publishes and goes live --------------------------
+        run_generation(tmp_path, 1000, metric=0.90)
+        assert store.champion_id() == "1000"
+        assert store.read_manifest("1000").status == STATUS_PUBLISHED
+        assert wait_for(lambda: probe_generation(base) == "1000")
+
+        # --- generation B regresses beyond 0.05: gated, archived, silent ---
+        run_generation(tmp_path, 2000, metric=0.70)
+        manifest_b = store.read_manifest("2000")
+        assert manifest_b.status == STATUS_GATED
+        assert "max-regression" in manifest_b.gate_reason
+        assert (tmp_path / "model" / "2000" / "model.pmml").exists()  # forensics
+        assert store.champion_id() == "1000"  # pointer never moved
+
+        # --- generation C passes and goes live -----------------------------
+        run_generation(tmp_path, 3000, metric=0.95)
+        assert store.champion_id() == "3000"
+        assert wait_for(lambda: probe_generation(base) == "3000")
+        # exactly A then C reached the manager — had B been published it
+        # would have arrived (and swapped) before C
+        assert serving.model_manager.model_swaps == 2
+
+        # --- registry + health surfaces agree ------------------------------
+        status, body = http("GET", f"{base}/model/generations")
+        assert status == 200
+        listing = json.loads(body)
+        assert listing["live_generation"] == "3000"
+        assert listing["champion"] == "3000"
+        by_id = {g["generation_id"]: g for g in listing["generations"]}
+        assert set(by_id) == {"1000", "2000", "3000"}
+        assert by_id["2000"]["status"] == STATUS_GATED
+        assert by_id["1000"]["status"] == by_id["3000"]["status"] == STATUS_PUBLISHED
+        assert by_id["3000"]["parent_id"] == "1000"  # lineage skips gated B
+
+        status, body = http("GET", f"{base}/healthz")
+        assert status == 200 and json.loads(body)["live_generation"] == "3000"
+        status, body = http("GET", f"{base}/metrics")
+        assert json.loads(body)["serving.model.live_generation"]["value"] == "3000"
+
+        # --- rollback to A --------------------------------------------------
+        status, _ = http("POST", f"{base}/model/rollback/9999")
+        assert status == 404
+        status, body = http("POST", f"{base}/model/rollback/1000")
+        assert status == 200
+        assert json.loads(body) == {"generation_id": "1000", "published_as": "MODEL"}
+        assert wait_for(lambda: probe_generation(base) == "1000")
+        assert serving.model_manager.model_swaps == 3
+        # the champion pointer follows the rollback so the next batch run
+        # gates and warm-starts against generation A
+        assert store.champion_id() == "1000"
+    finally:
+        serving.close()

@@ -1,0 +1,148 @@
+"""RegistryStore: layout, manifests, CHAMPION pointer, retention GC."""
+
+import json
+import math
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.registry.manifest import (
+    STATUS_GATED,
+    STATUS_PUBLISHED,
+    GenerationManifest,
+    content_hash_of,
+)
+from oryx_tpu.registry.store import (
+    RegistryStore,
+    generation_id_from_ref,
+    is_generation_id,
+    publish_generation,
+)
+
+pytestmark = pytest.mark.registry
+
+
+def make_generation(store: RegistryStore, gen_id: str, pmml_text: str = "<PMML/>") -> None:
+    """Lay down a generation dir the way MLUpdate promotion does."""
+    import pathlib
+
+    d = pathlib.Path(store.generation_dir(gen_id))
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "model.pmml").write_text(pmml_text)
+
+
+def test_generation_id_parsing():
+    assert is_generation_id("12345")
+    assert not is_generation_id("12345a")
+    assert not is_generation_id("CHAMPION")
+    assert generation_id_from_ref("/data/model/12345") == "12345"
+    assert generation_id_from_ref("/data/model/12345/") == "12345"
+    assert generation_id_from_ref("/data/model/12345/model.pmml") == "12345"
+    assert generation_id_from_ref("gs://bucket/model/777") == "777"
+    assert generation_id_from_ref("/data/model/not-a-generation") is None
+
+
+def test_list_generations_numeric_sorted(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    for gen in ("100", "99", "3"):
+        make_generation(store, gen)
+    # non-generation entries are invisible to the listing
+    (tmp_path / "CHAMPION").write_text("{}")
+    (tmp_path / "scratch").mkdir()
+    assert store.list_generations() == ["3", "99", "100"]
+
+
+def test_manifest_round_trip(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    make_generation(store, "1000")
+    manifest = GenerationManifest(
+        generation_id="1000",
+        parent_id="999",
+        status=STATUS_PUBLISHED,
+        hyperparams=[4, 0.01],
+        eval_metric=-1.25,
+        train_count=80,
+        test_count=20,
+        wall_time_sec=1.5,
+        content_hash=content_hash_of(b"<PMML/>"),
+        created_at_ms=1000,
+    )
+    store.write_manifest(manifest)
+    back = store.read_manifest("1000")
+    assert back == manifest
+    assert back.published
+
+
+def test_manifest_nan_metric_serializes_null(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    make_generation(store, "5")
+    store.write_manifest(GenerationManifest(generation_id="5", eval_metric=math.nan))
+    raw = json.loads((tmp_path / "5" / "manifest.json").read_text())
+    assert raw["eval_metric"] is None
+    assert store.read_manifest("5").eval_metric is None
+
+
+def test_missing_or_corrupt_manifest_is_none(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    make_generation(store, "7")
+    assert store.read_manifest("7") is None
+    (tmp_path / "7" / "manifest.json").write_text("{not json")
+    assert store.read_manifest("7") is None
+
+
+def test_champion_pointer(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    assert store.champion_id() is None
+    make_generation(store, "111")
+    store.set_champion("111", now_ms=111)
+    assert store.champion_id() == "111"
+    pointer = json.loads((tmp_path / "CHAMPION").read_text())
+    assert pointer == {"generation_id": "111", "updated_at_ms": 111}
+    # a torn/corrupt pointer degrades to "no champion", never an exception
+    (tmp_path / "CHAMPION").write_text("garbage")
+    assert store.champion_id() is None
+
+
+def test_gc_keeps_champion_and_newest_and_live(tmp_path):
+    """Acceptance: retention 2 with 5 generations on disk -> exactly the
+    champion + the 2 newest survive; the live generation is never deleted
+    even when it is neither champion nor newest."""
+    store = RegistryStore(str(tmp_path))
+    for gen in ("1", "2", "3", "4", "5"):
+        make_generation(store, gen)
+    store.set_champion("1")  # an *old* champion (e.g. after a rollback)
+    deleted = store.gc(2, never_delete={"3"})  # serving is live on 3
+    assert deleted == ["2"]
+    assert store.list_generations() == ["1", "3", "4", "5"]
+    # champion + the newest 2 + the live one all survived
+    assert store.champion_id() == "1"
+
+
+def test_gc_disabled_and_zero(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    for gen in ("1", "2", "3"):
+        make_generation(store, gen)
+    store.set_champion("2")
+    assert store.gc(-1) == []  # -1 disables retention entirely
+    assert store.list_generations() == ["1", "2", "3"]
+    # 0 keeps only the protected set (champion here)
+    assert store.gc(0) == ["1", "3"]
+    assert store.list_generations() == ["2"]
+
+
+def test_publish_generation_inline_and_ref(tmp_path):
+    store = RegistryStore(str(tmp_path))
+    make_generation(store, "42", pmml_text="<PMML>inline</PMML>")
+    broker = bus.get_broker("inproc://registry-store-test")
+    broker.create_topic("OryxUpdate", 1)
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    with broker.producer("OryxUpdate") as producer:
+        assert publish_generation(store, "42", producer, max_message_size=1024) == "MODEL"
+        assert publish_generation(store, "42", producer, max_message_size=4) == "MODEL-REF"
+        with pytest.raises(FileNotFoundError):
+            publish_generation(store, "404", producer, max_message_size=1024)
+    msgs = tail.poll(timeout=1.0)
+    assert [m.key for m in msgs] == ["MODEL", "MODEL-REF"]
+    assert msgs[0].message == "<PMML>inline</PMML>"
+    # the ref is the registry-resolvable generation dir, not a file path
+    assert msgs[1].message == store.generation_dir("42")

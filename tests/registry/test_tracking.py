@@ -1,0 +1,90 @@
+"""GenerationTracker: live-generation bookkeeping + duplicate-MODEL
+suppression on the serving update stream."""
+
+import pytest
+
+from oryx_tpu.app import pmml as app_pmml
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.common.records import RecordBlock
+from oryx_tpu.registry.tracking import GenerationTracker, generation_of_model_message
+from oryx_tpu.serving.layer import ServingHealth
+
+pytestmark = pytest.mark.registry
+
+
+def model_message(generation_id: str | None) -> str:
+    root = pmml_io.build_skeleton_pmml()
+    if generation_id is not None:
+        app_pmml.add_extension(root, "generation", generation_id)
+    return pmml_io.to_string(root)
+
+
+def block(*records: KeyMessage) -> RecordBlock:
+    return RecordBlock.from_key_messages(list(records))
+
+
+def test_generation_of_model_message():
+    assert generation_of_model_message("MODEL", model_message("123")) == "123"
+    assert generation_of_model_message("MODEL", model_message(None)) is None
+    assert generation_of_model_message("MODEL", "not xml at all") is None
+    assert generation_of_model_message("MODEL-REF", "/data/model/456") == "456"
+    assert generation_of_model_message("MODEL-REF", "/data/model/nope") is None
+    assert generation_of_model_message("UP", '["u1","i1",5]') is None
+
+
+def test_tracker_sets_live_and_dedupes_only_current():
+    health = ServingHealth()
+    tracker = GenerationTracker(health)
+    first = tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    assert first is not None and len(first) == 1
+    assert tracker.live_generation == "100"
+    assert health.live_generation == "100"
+
+    # redelivery of the live generation is swallowed entirely
+    assert tracker.filter_block(block(KeyMessage("MODEL", model_message("100")))) is None
+
+    # a newer generation passes and becomes live
+    newer = tracker.filter_block(block(KeyMessage("MODEL-REF", "/m/200")))
+    assert newer is not None and len(newer) == 1
+    assert tracker.live_generation == "200"
+
+    # rollback: an OLDER generation id also passes (only the current live
+    # id is deduped), which is what lets a rollback republish take effect
+    rolled = tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    assert rolled is not None and len(rolled) == 1
+    assert tracker.live_generation == "100"
+
+
+def test_tracker_mixed_block_keeps_up_records():
+    tracker = GenerationTracker()
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("7"))))
+    mixed = block(
+        KeyMessage("UP", "delta-1"),
+        KeyMessage("MODEL", model_message("7")),  # duplicate -> dropped
+        KeyMessage("UP", "delta-2"),
+    )
+    out = tracker.filter_block(mixed)
+    assert out is not None
+    assert [km.key for km in out.iter_key_messages()] == ["UP", "UP"]
+    assert [km.message for km in out.iter_key_messages()] == ["delta-1", "delta-2"]
+
+
+def test_tracker_legacy_model_without_generation_passes():
+    tracker = GenerationTracker()
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("9"))))
+    # a registry-less producer's MODEL has no generation: never dropped,
+    # and tracking resets to unknown
+    out = tracker.filter_block(block(KeyMessage("MODEL", model_message(None))))
+    assert out is not None and len(out) == 1
+    assert tracker.live_generation is None
+    # ...and a second no-generation MODEL still passes (None != None dedupe)
+    again = tracker.filter_block(block(KeyMessage("MODEL", model_message(None))))
+    assert again is not None and len(again) == 1
+
+
+def test_tracker_fast_paths():
+    tracker = GenerationTracker()
+    assert tracker.filter_block(None) is None
+    no_models = block(KeyMessage("UP", "x"), KeyMessage(None, "y"))
+    assert tracker.filter_block(no_models) is no_models

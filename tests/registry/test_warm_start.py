@@ -1,0 +1,132 @@
+"""Warm-start acceptance: a second generation seeded from the previous
+champion's factors converges in measurably fewer ALS iterations than a
+cold start, and k-means Lloyd runs seeded from previous centers stay at
+their fixed point."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.update import ALSUpdate, _save_features
+from oryx_tpu.ops.als import train_als
+from oryx_tpu.ops.kmeans import train_kmeans
+
+pytestmark = pytest.mark.registry
+
+
+def make_ratings(seed=0):
+    """Observed entries of an exactly rank-4 matrix (explicit feedback)."""
+    gen = np.random.default_rng(seed)
+    num_users, num_items, features = 30, 24, 4
+    x0 = gen.standard_normal((num_users, features))
+    y0 = gen.standard_normal((num_items, features))
+    dense = x0 @ y0.T
+    mask = gen.random((num_users, num_items)) < 0.6
+    u, i = np.nonzero(mask)
+    return (
+        u.astype(np.int32),
+        i.astype(np.int32),
+        dense[u, i].astype(np.float32),
+        num_users,
+        num_items,
+        features,
+    )
+
+
+def rmse(model, u, i, vals) -> float:
+    pred = np.sum(model.x[u] * model.y[i], axis=1)
+    return float(np.sqrt(np.mean((pred - vals) ** 2)))
+
+
+def test_als_warm_start_converges_in_fewer_iterations():
+    u, i, vals, num_users, num_items, features = make_ratings()
+
+    def train(iterations, init_y=None):
+        return train_als(
+            u, i, vals, num_users, num_items, features,
+            lam=0.01, implicit=False, iterations=iterations, seed=7, init_y=init_y,
+        )
+
+    # "generation 1": train to convergence; its Y is what the registry
+    # would surface through MLUpdate.load_previous_model
+    previous = train(iterations=10)
+    threshold = rmse(previous, u, i, vals) * 1.05
+
+    def iterations_to_reach(init_y):
+        for k in range(1, 11):
+            if rmse(train(k, init_y=init_y), u, i, vals) <= threshold:
+                return k
+        return 99
+
+    cold_iters = iterations_to_reach(None)
+    warm_iters = iterations_to_reach(previous.y)
+    assert warm_iters < cold_iters, (
+        f"warm start took {warm_iters} iterations vs cold {cold_iters}"
+    )
+
+
+def test_als_init_y_shape_mismatch_cold_starts():
+    u, i, vals, num_users, num_items, features = make_ratings()
+    wrong = np.zeros((num_items + 3, features), dtype=np.float32)
+    model = train_als(
+        u, i, vals, num_users, num_items, features,
+        lam=0.01, implicit=False, iterations=2, seed=7, init_y=wrong,
+    )
+    assert model.y.shape == (num_items, features)
+    assert np.isfinite(model.y).all() and np.abs(model.y).sum() > 0
+
+
+def test_als_update_warm_start_maps_surviving_items(tmp_path):
+    """ALSUpdate._warm_start_init_y carries the previous generation's
+    factor for every item that survives, and random-inits the rest."""
+    prev_ids = ["apple", "banana", "cherry"]
+    prev_y = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], dtype=np.float32)
+    _save_features(tmp_path / "Y", prev_ids, prev_y)
+
+    fake = SimpleNamespace(previous_model_dir=str(tmp_path), previous_generation_id="1")
+    # the new generation dropped "apple", kept the others, added "durian"
+    rm = SimpleNamespace(item_ids=["banana", "durian", "cherry"])
+    init = ALSUpdate._warm_start_init_y(fake, rm, features=2)
+    assert init.shape == (3, 2)
+    np.testing.assert_array_equal(init[0], prev_y[1])  # banana carried over
+    np.testing.assert_array_equal(init[2], prev_y[2])  # cherry carried over
+    assert not np.array_equal(init[1], prev_y[0])  # durian freshly seeded
+    assert np.abs(init[1]).max() < 1.0  # ...with the small random init
+
+    # feature-dim change -> cold start
+    assert ALSUpdate._warm_start_init_y(fake, rm, features=3) is None
+    # no previous model -> cold start
+    cold = SimpleNamespace(previous_model_dir=None, previous_generation_id=None)
+    assert ALSUpdate._warm_start_init_y(cold, rm, features=2) is None
+    # zero overlap -> cold start
+    alien = SimpleNamespace(item_ids=["x", "y"])
+    assert ALSUpdate._warm_start_init_y(fake, alien, features=2) is None
+
+
+def test_kmeans_initial_centers_are_a_fixed_point():
+    gen = np.random.default_rng(11)
+    true_centers = np.array([[0.0, 0.0], [10.0, 0.0], [0.0, 10.0]], dtype=np.float32)
+    points = np.concatenate(
+        [c + 0.1 * gen.standard_normal((40, 2)).astype(np.float32) for c in true_centers]
+    )
+    # the warm start a previous generation would provide: the blobs' means
+    warm = np.stack([points[i * 40 : (i + 1) * 40].mean(axis=0) for i in range(3)])
+    centers, counts, cost = train_kmeans(points, k=3, iterations=3, initial_centers=warm)
+    # Lloyd seeded at the optimum stays there
+    order = np.argsort(centers[:, 0] + centers[:, 1])
+    np.testing.assert_allclose(
+        centers[order], warm[np.argsort(warm[:, 0] + warm[:, 1])], atol=1e-3
+    )
+    assert counts.sum() == len(points)
+
+
+def test_kmeans_shape_mismatch_falls_back_to_cold_init():
+    gen = np.random.default_rng(12)
+    points = gen.standard_normal((60, 3)).astype(np.float32)
+    wrong_k = np.zeros((5, 3), dtype=np.float32)  # previous model had k=5
+    centers, counts, cost = train_kmeans(
+        points, k=2, iterations=2, seed=4, initial_centers=wrong_k
+    )
+    assert centers.shape == (2, 3)
+    assert np.isfinite(cost)
