@@ -65,7 +65,11 @@ class ALSServingModel(ServingModel):
         self.shard_items = shard_items
         # item-matrix dtype for device scoring: bfloat16 halves HBM traffic
         # (the serving bottleneck at millions of items) at ~1e-2 relative
-        # score precision — near-tie ranks may swap, like LSH's trade-off
+        # score precision — near-tie ranks may swap, like LSH's trade-off.
+        # int8 halves the SCANNED bytes again (row-quantized primary plane,
+        # total memory ~bf16 counting the residual plane) and rescoring the
+        # oversampled candidates against the residual keeps top-10 recall
+        # >= 0.99 of float32 — see docs/serving-scan.md
         self.score_dtype = score_dtype
         # LSH candidate pruning is opt-in (sample-rate < 1): the exact
         # device matvec is the TPU fast path, LSH the CPU-parity fallback
@@ -301,7 +305,10 @@ class ALSServingModel(ServingModel):
                     if len(ids):
                         import jax.numpy as jnp
 
-                        dtype = jnp.bfloat16 if self.score_dtype == "bfloat16" else jnp.float32
+                        dtype = {
+                            "bfloat16": jnp.bfloat16,
+                            "int8": jnp.int8,
+                        }.get(self.score_dtype, jnp.float32)
                         if self.shard_items:
                             from oryx_tpu.parallel.mesh import get_mesh
 
@@ -623,10 +630,10 @@ class ALSServingModelManager(AbstractServingModelManager):
         self.device_user_matrix = config.get_bool(
             "oryx.als.serving.device-user-matrix"
         )
-        if self.score_dtype not in ("float32", "bfloat16"):
+        if self.score_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
-                f"oryx.als.serving.score-dtype must be float32 or bfloat16, "
-                f"got {self.score_dtype!r}"
+                f"oryx.als.serving.score-dtype must be float32, bfloat16, or "
+                f"int8, got {self.score_dtype!r}"
             )
         self.rescorer_provider = _load_rescorer_providers(config)
         self.model: ALSServingModel | None = None

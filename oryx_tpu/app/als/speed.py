@@ -12,6 +12,7 @@ the ALSUtils fold-in, publishing ["X",user,vec[,knownItems]] /
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 from collections import deque
@@ -158,13 +159,22 @@ class ALSSpeedModelManager(SpeedModelManager):
             # another producer's message, a rotation in between — applies
             # normally; a missed match merely re-applies an absolute
             # vector, which is idempotent.
-            rest: list[bytes] = []
-            for ln in lines:
-                if pending and ln == pending[0]:
+            # fast path: the block is exactly the next run of our own
+            # deltas (single UP partition, publish order) — one C-level
+            # list compare instead of a deque pop + compare per record
+            m = min(len(lines), len(pending))
+            if lines[:m] == list(itertools.islice(pending, m)):
+                for _ in range(m):
                     pending.popleft()
-                else:
-                    rest.append(ln)
-            lines = rest
+                lines = lines[m:]
+            else:
+                rest: list[bytes] = []
+                for ln in lines:
+                    if pending and ln == pending[0]:
+                        pending.popleft()
+                    else:
+                        rest.append(ln)
+                lines = rest
             if not lines:
                 return
         model = self.model
@@ -255,8 +265,12 @@ class ALSSpeedModelManager(SpeedModelManager):
         from oryx_tpu.ops import als as als_ops
 
         n = len(rm.values)
-        users = [rm.user_ids[j] for j in rm.user_idx]
-        items = [rm.item_ids[j] for j in rm.item_idx]
+        # object-array gather: one C pass per side instead of a Python
+        # list-index loop per event
+        user_ids_arr = np.asarray(rm.user_ids, dtype=object)
+        item_ids_arr = np.asarray(rm.item_ids, dtype=object)
+        users = user_ids_arr[rm.user_idx].tolist()
+        items = item_ids_arr[rm.item_idx].tolist()
         xu, xu_valid = model.x.get_batch(users, dim=model.features)
         yi, yi_valid = model.y.get_batch(items, dim=model.features)
         values = rm.values
@@ -289,8 +303,6 @@ class ALSSpeedModelManager(SpeedModelManager):
         last_y[iy] = y_rows
         keep_items = np.nonzero(last_y >= 0)[0]
         rows_y = last_y[keep_items]
-        user_ids_arr = np.asarray(rm.user_ids, dtype=object)
-        item_ids_arr = np.asarray(rm.item_ids, dtype=object)
         x_ids = user_ids_arr[keep_users].tolist()
         y_ids = item_ids_arr[keep_items].tolist()
         def group_other_ids(own_idx, other_names):

@@ -430,8 +430,15 @@ inline char* float_append(char* w, float f) {
     *w++ = '0';  // JSON has no NaN/Infinity literals
     return w;
   }
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
   auto res = std::to_chars(w, w + 32, f);
   return res.ptr;
+#else
+  // libstdc++ < 11 has no floating-point to_chars; %.9g round-trips any
+  // float32 in one snprintf (a shortest-digits search costs 4x here, and
+  // this runs once per component on the update-serialization hot path)
+  return w + snprintf(w, 32, "%.9g", static_cast<double>(f));
+#endif
 }
 
 }  // namespace
@@ -587,10 +594,27 @@ int64_t parse_float_csv(const char* buf, int64_t len, float* out, int64_t cap) {
     // tolerate them so json.dumps-style "a, b" fallback formatting stays
     // on the fast path
     while (p < end && *p == ' ') ++p;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
     auto [next, ec] = std::from_chars(p, end, out[n]);
     if (ec != std::errc() || next == p) return -1;  // malformed token
     ++n;
     p = next;
+#else
+    // libstdc++ < 11: bounded strtof on a stack copy (the buffer from
+    // Python is NUL-terminated, but don't rely on it)
+    char tok[64];
+    const char* stop = static_cast<const char*>(memchr(p, ',', end - p));
+    if (stop == nullptr) stop = end;
+    size_t tlen = static_cast<size_t>(stop - p);
+    if (tlen == 0 || tlen >= sizeof(tok)) return -1;
+    memcpy(tok, p, tlen);
+    tok[tlen] = '\0';
+    char* tend = nullptr;
+    out[n] = strtof(tok, &tend);
+    if (tend != tok + tlen) return -1;  // malformed token
+    ++n;
+    p = stop;
+#endif
     if (p < end) {
       if (*p != ',') return -1;
       ++p;

@@ -6,28 +6,34 @@ partitions on a thread pool, VectorMath.dot per item). On TPU the exact
 scan is one matmul — but the naive XLA program (``scores = Q @ Y.T`` then
 ``lax.top_k``) writes the full [b, n_items] score matrix to HBM and reads
 it back for the top-k, which at 1M+ items costs more bandwidth than
-reading the item matrix itself. This kernel fuses the two:
+reading the item matrix itself. This module fuses the two:
 
 - the item matrix is laid out feature-major ``[k_feat, n_items]`` so each
   grid step streams a contiguous ``[k_feat, BLOCK_N]`` block of items
   through VMEM (Mosaic double-buffers blocks across the grid);
 - each step computes ``[b, BLOCK_N]`` scores on the MXU with float32
-  accumulation (items may be stored bfloat16, halving HBM traffic);
-- a statically-unrolled iterative max reduces the block to its local
-  top-k (k is small: 10..a few hundred) entirely in VMEM;
-- only ``[num_blocks, b, k]`` candidates ever reach HBM; a final tiny
-  ``lax.top_k`` over ``num_blocks * k`` merges them.
+  accumulation (items may be stored bfloat16 or row-quantized int8,
+  halving / quartering HBM traffic);
+- each block reduces to its own top-k candidates on-chip — either into a
+  running VMEM scratch (small scan batches, with a threshold gate that
+  skips selection for blocks that cannot enter the top-k) or as
+  block-local ``[b, k]`` candidate tiles (large scan batches, merged by
+  one tiny ``lax.top_k`` over ``[b, num_blocks * k]`` afterwards);
+- only candidates ever reach HBM — the full score matrix never does.
 
 HBM traffic per batch drops from ``n*k_feat*4 + 2*b*n*4`` bytes to
-``n*k_feat*{2|4}`` — a 2-6x win for the bandwidth-bound scan.
+``n*k_feat*{1|2|4}`` — a 2-12x win for the bandwidth-bound scan.
 
-Cosine scoring divides by cached item norms in-kernel (an extra
-``[1, BLOCK_N]`` f32 stream, ~2% overhead) so ranking happens on the
-normalized scores, matching CosineAverageFunction.java semantics.
+int8 handles store one f32 dequantization scale per item row
+(``absmax/127``); scores dequantize by a single post-dot multiply, and
+cosine scoring folds the cached item norms into that same multiplier so
+the kernel never rescales twice.
 
-On non-TPU backends the public entry points fall back to plain XLA ops;
-``interpret=True`` runs the kernel under the Pallas interpreter (used by
-the CPU test suite).
+On non-TPU backends the public entry points run an XLA twin of the same
+blocked scan (``lax.scan`` over feature-major item blocks, block-local
+``lax.top_k``, final candidate merge) instead of materializing [b, n]
+scores; ``interpret=True`` forces the Pallas kernel under the interpreter
+(used by the CPU parity tests).
 """
 
 from __future__ import annotations
@@ -62,32 +68,163 @@ SCORE_TILE = int(_os.environ.get("ORYX_TOPN_BLOCK", 4096))
 SUBTILES = int(_os.environ.get("ORYX_TOPN_SUBTILES", 4))
 BLOCK_N = SCORE_TILE * SUBTILES  # items consumed per grid step
 
+# Scan batches past this row count switch the compiled kernel to the
+# block-local candidates form: the running-scratch kernel needs the full
+# [b, SCORE_TILE] score tile resident, which stops fitting scoped VMEM
+# past ~256 rows, while the candidates kernel shrinks its tile instead.
+LOCAL_TOPK_BATCH = int(_os.environ.get("ORYX_TOPN_LOCAL_TOPK_BATCH", 256))
+
+# Items per lax.scan step of the XLA (non-TPU) blocked scan. Rounded down
+# to a BLOCK_N multiple that divides the padded item count. 16K keeps the
+# [b, block] score tile inside L2/L3 so the block-local top-k reads cache,
+# not DRAM (measured best of 4K..128K on the 1-core cpu bench host).
+XLA_SCAN_BLOCK = int(_os.environ.get("ORYX_XLA_SCAN_BLOCK", 16384))
+
+# Oversampling factor for quantized scans: the int8 plane ranks the scan,
+# then the top (RESCORE_OVERSAMPLE * k) candidates are re-scored against
+# the residual plane (int8 codes of what the first plane dropped) before
+# the final top-k. 0 disables rescoring (raw int8 ranks).
+RESCORE_OVERSAMPLE = int(_os.environ.get("ORYX_TOPN_RESCORE", 4))
+
+# Chunk width of the quantized XLA scan's candidate selection: the scan
+# reduces scores to per-chunk maxes (a reduce that fuses into the GEMM's
+# epilogue — wide lax.top_k inside the scan body does not), the top-m
+# chunks by max provably contain the top-m items, and only those chunks'
+# columns are gathered and scored exactly afterwards.
+_CHUNK = int(_os.environ.get("ORYX_TOPN_CHUNK", 32))
+
+# How many chunks that selection keeps: the top-k chunks by primary-plane
+# max already provably contain the primary top-k items, and every kept
+# chunk drags in its _CHUNK-1 neighbors, so a modest factor over k yields
+# a ~30x item-level oversample for the exact two-plane rescore. The tail
+# (gather + rescore) is linear in this count — keep it lean.
+CHUNK_OVERSAMPLE = float(_os.environ.get("ORYX_TOPN_CHUNK_OVERSAMPLE", 1.25))
+
+
+def _chunk_k(k: int, chunks: int) -> int:
+    return min(max(int(round(CHUNK_OVERSAMPLE * k)), k + 2), chunks)
+
+
+def configure_scan(
+    *,
+    oversample: int | None = None,
+    chunk: int | None = None,
+    block: int | None = None,
+) -> None:
+    """Apply ``oryx.serving.scan.*`` tuning (serving-layer startup). Must
+    run before the first dispatch: jitted scan programs bake these in at
+    trace time and are cached by shape, not by knob value."""
+    global RESCORE_OVERSAMPLE, _CHUNK, XLA_SCAN_BLOCK
+    if oversample is not None:
+        RESCORE_OVERSAMPLE = int(oversample)
+    if chunk is not None:
+        _CHUNK = int(chunk)
+    if block is not None:
+        XLA_SCAN_BLOCK = int(block)
+
+# int8 operand tiles are (32 sublanes, 128 lanes): the feature dim of a
+# quantized matrix pads to a 32 multiple (zero-filled; queries pad alike)
+_INT8_FEAT_MULTIPLE = 32
+
 
 def _ceil_to(x: int, m: int) -> int:
     return (x + m - 1) // m * m
+
+
+def _is_int8(dtype) -> bool:
+    if dtype is None:
+        return False
+    try:
+        return np.dtype(dtype) == np.dtype(np.int8)
+    except TypeError:  # pragma: no cover - exotic dtype objects
+        return False
 
 
 @dataclass(frozen=True)
 class StreamingItemMatrix:
     """Device-resident item factors in the kernel's feature-major layout."""
 
-    mat_t: jax.Array  # [k_feat, n_padded], f32 or bf16
-    norms: jax.Array  # [1, n_padded] f32 (row L2 norms, 0-padded)
+    mat_t: jax.Array  # [k_feat(_pad), n_padded]; f32, bf16, or row-quantized int8
+    norms: jax.Array  # [1, n_padded] f32 (L2 norms of the ORIGINAL f32 rows)
     n_items: int
+    # int8 handles only: per-item dequantization scale (absmax/127, f32,
+    # 1.0 for all-zero rows so dequantizing is always a plain multiply)
+    scales: jax.Array | None = None
+    # true feature count before int8 sublane padding (None = no padding)
+    features: int | None = None
+    # int8 handles only: residual plane — int8 codes of (row - codes * s),
+    # with its own per-row scale. Never scanned: only the top-(~4k)
+    # candidates per query gather it for a ~14-bit-effective rescore, so
+    # scan traffic stays 1 B/feature while recall matches f32.
+    resid: jax.Array | None = None
+    resid_scales: jax.Array | None = None
 
     @property
     def num_features(self) -> int:
-        return self.mat_t.shape[0]
+        return self.features if self.features is not None else self.mat_t.shape[0]
+
+    @property
+    def quantized(self) -> bool:
+        return self.scales is not None
+
+
+def _quantize_rows(mat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Row-wise symmetric int8: q = rint(row / s), s = absmax/127 (1.0 for
+    all-zero rows). Same rule as the device-side requantize in
+    ``topn.update_rows`` so a scatter round-trips bit-exactly."""
+    absmax = np.max(np.abs(mat), axis=1)
+    s = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(mat / s[:, None]), -127, 127).astype(np.int8)
+    return q, s
+
+
+def _quantize_residual(
+    mat: np.ndarray, q: np.ndarray, s: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Second int8 plane: quantize what the first plane dropped
+    (``row - q * s``, at most s/2 per element) with its own per-row
+    absmax/127 scale — together the planes carry ~14 significant bits,
+    enough for candidate rescoring to match f32 ranking."""
+    r = mat - q.astype(np.float32) * s[:, None]
+    am = np.max(np.abs(r), axis=1)
+    s2 = np.where(am > 0, am / 127.0, 1.0).astype(np.float32)
+    q2 = np.clip(np.rint(r / s2[:, None]), -127, 127).astype(np.int8)
+    return q2, s2
 
 
 def upload_streaming(matrix: np.ndarray, dtype=jnp.float32) -> StreamingItemMatrix:
-    """Pad items up to a BLOCK_N multiple and move [k, n] to device."""
-    n, _k = matrix.shape
+    """Pad items up to a BLOCK_N multiple and move [k, n] to device.
+
+    ``dtype=jnp.int8`` row-quantizes: each item row stores int8 codes plus
+    one f32 scale, cutting the scan's HBM traffic 4x vs f32 while keeping
+    per-row dynamic range (a global scale would clip hot rows)."""
+    n, k_feat = matrix.shape
     n_pad = max(BLOCK_N, _ceil_to(n, BLOCK_N))
     mat = np.asarray(matrix, dtype=np.float32)
     norms = np.zeros((1, n_pad), dtype=np.float32)
     norms[0, :n] = np.linalg.norm(mat, axis=1)
-    mat_t = np.zeros((matrix.shape[1], n_pad), dtype=np.float32)
+    if _is_int8(dtype):
+        q, s = _quantize_rows(mat)
+        q2, s2 = _quantize_residual(mat, q, s)
+        kf_pad = _ceil_to(k_feat, _INT8_FEAT_MULTIPLE)
+        mat_t = np.zeros((kf_pad, n_pad), dtype=np.int8)
+        mat_t[:k_feat, :n] = q.T
+        resid = np.zeros((kf_pad, n_pad), dtype=np.int8)
+        resid[:k_feat, :n] = q2.T
+        scales = np.ones((1, n_pad), dtype=np.float32)
+        scales[0, :n] = s
+        rscales = np.ones((1, n_pad), dtype=np.float32)
+        rscales[0, :n] = s2
+        return StreamingItemMatrix(
+            mat_t=jnp.asarray(mat_t),
+            norms=jnp.asarray(norms),
+            n_items=n,
+            scales=jnp.asarray(scales),
+            features=k_feat if kf_pad != k_feat else None,
+            resid=jnp.asarray(resid),
+            resid_scales=jnp.asarray(rscales),
+        )
+    mat_t = np.zeros((k_feat, n_pad), dtype=np.float32)
     mat_t[:, :n] = mat.T
     return StreamingItemMatrix(
         mat_t=jnp.asarray(mat_t, dtype=dtype),
@@ -96,9 +233,73 @@ def upload_streaming(matrix: np.ndarray, dtype=jnp.float32) -> StreamingItemMatr
     )
 
 
+def _dot_precision_for(q, quantized: bool):
+    # f32 items get true f32 accumulation (TPU default would silently drop
+    # to bf16 passes); bf16 items are the intentional fast path. int8
+    # items upcast in-register and take bf16 MXU passes: the quantization
+    # step (~0.4% of row absmax) dominates the accumulation error, and
+    # DEFAULT runs the MXU at 6x the f32-HIGHEST rate.
+    if quantized or q.dtype != jnp.float32:
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
+def _score_tile(q, mat_s, aux_s, qn, *, cosine, quantized):
+    """[b, tile] scores for one item sub-tile. ``aux_s`` is the item-norm
+    tile (unquantized) or the folded dequant multiplier (quantized; cosine
+    norms already divided in outside the kernel)."""
+    if quantized:
+        mat_s = mat_s.astype(jnp.float32)
+    scores = jnp.dot(
+        q,
+        mat_s,
+        preferred_element_type=jnp.float32,
+        precision=_dot_precision_for(q, quantized),
+    )
+    if quantized:
+        scores = scores * aux_s
+        if cosine:
+            scores = scores / jnp.maximum(qn, 1e-12)
+    elif cosine:
+        scores = scores / jnp.maximum(aux_s * qn, 1e-12)
+    return scores
+
+
+def _tile_topk(sc, local_cols, base, k, int_max, neg_inf):
+    """Unrolled iterative max: the tile's top-k as k [b, 1] column lists
+    (ties -> lowest item id, like a stable host scan)."""
+    vals_cols = []
+    idx_cols = []
+    for _ in range(k):
+        m = jnp.max(sc, axis=1, keepdims=True)  # [b, 1]
+        at = jnp.min(jnp.where(sc == m, local_cols, int_max), axis=1, keepdims=True)
+        vals_cols.append(m)
+        idx_cols.append(at + base)
+        sc = jnp.where(local_cols == at, neg_inf, sc)
+    return vals_cols, idx_cols
+
+
+def _merge_topk(cur_v, cur_i, vals_cols, idx_cols, k, int_max, neg_inf):
+    """Merge a tile's top-k column lists into the running [b, k] state:
+    k passes over [b, 2k] (tiny). Ties prefer the smaller item index,
+    which is always the earlier tile — same result as a stable global
+    merge."""
+    cat_v = jnp.concatenate([cur_v] + vals_cols, axis=1)
+    cat_i = jnp.concatenate([cur_i] + idx_cols, axis=1)
+    new_v = []
+    new_i = []
+    for _ in range(k):
+        m = jnp.max(cat_v, axis=1, keepdims=True)
+        sel = jnp.min(jnp.where(cat_v == m, cat_i, int_max), axis=1, keepdims=True)
+        new_v.append(m)
+        new_i.append(sel)
+        cat_v = jnp.where((cat_v == m) & (cat_i == sel), neg_inf, cat_v)
+    return jnp.concatenate(new_v, axis=1), jnp.concatenate(new_i, axis=1)
+
+
 def _topn_kernel(
-    q_ref, mat_ref, norms_ref, vals_ref, idx_ref, vstate, istate, *,
-    k, n_items, cosine, grid, subtiles
+    q_ref, mat_ref, aux_ref, vals_ref, idx_ref, vstate, istate, *,
+    k, n_items, cosine, quantized, grid, subtiles
 ):
     """One grid step: score a [k_feat, BLOCK_N] item block and fold it
     into the running top-k carried in VMEM scratch across grid steps.
@@ -120,11 +321,6 @@ def _topn_kernel(
         istate[...] = jnp.zeros((b, k), jnp.int32)
 
     q = q_ref[:]  # [b, k_feat]
-    # f32 items get true f32 accumulation (TPU default would silently drop
-    # to bf16 passes); bf16 items are the intentional fast path
-    precision = (
-        jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else jax.lax.Precision.DEFAULT
-    )
     qn = None
     if cosine:
         qn = jnp.sqrt(
@@ -136,51 +332,28 @@ def _topn_kernel(
     local_cols = jax.lax.broadcasted_iota(jnp.int32, (b, SCORE_TILE), 1)
     for s in range(subtiles):  # unrolled: static sub-tile slices
         base = block * (SCORE_TILE * subtiles) + s * SCORE_TILE
-        scores = jnp.dot(
+        scores = _score_tile(
             q,
             mat_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE],
-            preferred_element_type=jnp.float32,
-            precision=precision,
-        )  # [b, SCORE_TILE]
-        if cosine:
-            norms_s = norms_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE]
-            scores = scores / jnp.maximum(norms_s * qn, 1e-12)
+            aux_ref[:, s * SCORE_TILE : (s + 1) * SCORE_TILE],
+            qn,
+            cosine=cosine,
+            quantized=quantized,
+        )
         scores = jnp.where(local_cols < n_items - base, scores, neg_inf)
         kth = vstate[...][:, k - 1 : k]  # worst of the running top-k, [b, 1]
         need = jnp.any(jnp.max(scores, axis=1, keepdims=True) > kth)
 
         @pl.when(need)
         def _(scores=scores, base=base):
-            sc = scores
-            vals_cols = []
-            idx_cols = []
-            for _ in range(k):  # k is small and static: unrolled iterative max
-                m = jnp.max(sc, axis=1, keepdims=True)  # [b, 1]
-                # first column index attaining the max (ties -> lowest id,
-                # like a stable host scan)
-                at = jnp.min(
-                    jnp.where(sc == m, local_cols, int_max), axis=1, keepdims=True
-                )
-                vals_cols.append(m)
-                idx_cols.append(at + base)
-                sc = jnp.where(local_cols == at, neg_inf, sc)
-            # merge the tile's top-k into the running state: k passes over
-            # [b, 2k] (tiny). Ties prefer the smaller item index, which is
-            # always the earlier tile — same result as a stable global merge.
-            cat_v = jnp.concatenate([vstate[...]] + vals_cols, axis=1)
-            cat_i = jnp.concatenate([istate[...]] + idx_cols, axis=1)
-            new_v = []
-            new_i = []
-            for _ in range(k):
-                m = jnp.max(cat_v, axis=1, keepdims=True)
-                sel = jnp.min(
-                    jnp.where(cat_v == m, cat_i, int_max), axis=1, keepdims=True
-                )
-                new_v.append(m)
-                new_i.append(sel)
-                cat_v = jnp.where((cat_v == m) & (cat_i == sel), neg_inf, cat_v)
-            vstate[...] = jnp.concatenate(new_v, axis=1)
-            istate[...] = jnp.concatenate(new_i, axis=1)
+            vals_cols, idx_cols = _tile_topk(
+                scores, local_cols, base, k, int_max, neg_inf
+            )
+            v, i = _merge_topk(
+                vstate[...], istate[...], vals_cols, idx_cols, k, int_max, neg_inf
+            )
+            vstate[...] = v
+            istate[...] = i
 
     @pl.when(block == grid - 1)
     def _():
@@ -188,11 +361,93 @@ def _topn_kernel(
         idx_ref[...] = istate[...]
 
 
+def _topn_candidates_kernel(
+    q_ref, mat_ref, aux_ref, vals_ref, idx_ref, *,
+    k, n_items, cosine, quantized, subtiles, tile
+):
+    """Block-local top-k: each grid step reduces its own item block to
+    [b, k] candidates written straight to its output slot — no cross-step
+    scratch and no threshold gate, so the score tile can narrow as the
+    scan batch grows (the running-scratch kernel is pinned to
+    [b, SCORE_TILE] and stops fitting VMEM past ~256 rows). A final
+    [b, grid * k] lax.top_k outside the kernel merges the blocks; the
+    candidate traffic is k/tile of the score matrix, so HBM stays
+    item-bound."""
+    block = pl.program_id(0)
+    b = q_ref.shape[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    int_max = jnp.int32(2**31 - 1)
+    q = q_ref[:]
+    qn = None
+    if cosine:
+        qn = jnp.sqrt(
+            jnp.sum(q.astype(jnp.float32) * q.astype(jnp.float32), axis=1, keepdims=True)
+        )
+    local_cols = jax.lax.broadcasted_iota(jnp.int32, (b, tile), 1)
+    best_v = jnp.full((b, k), neg_inf, jnp.float32)
+    best_i = jnp.zeros((b, k), jnp.int32)
+    for s in range(subtiles):
+        base = block * (tile * subtiles) + s * tile
+        scores = _score_tile(
+            q,
+            mat_ref[:, s * tile : (s + 1) * tile],
+            aux_ref[:, s * tile : (s + 1) * tile],
+            qn,
+            cosine=cosine,
+            quantized=quantized,
+        )
+        scores = jnp.where(local_cols < n_items - base, scores, neg_inf)
+        vals_cols, idx_cols = _tile_topk(scores, local_cols, base, k, int_max, neg_inf)
+        best_v, best_i = _merge_topk(
+            best_v, best_i, vals_cols, idx_cols, k, int_max, neg_inf
+        )
+    vals_ref[...] = best_v[None]
+    idx_ref[...] = best_i[None]
+
+
+def _scan_k(k: int, n_items: int, resid) -> int:
+    """Candidates the scan keeps per query before the residual rescore
+    trims back to k. Capped at MAX_KERNEL_K so the oversampled scan stays
+    on the kernel paths."""
+    if resid is None or RESCORE_OVERSAMPLE <= 1:
+        return k
+    m = min(max(RESCORE_OVERSAMPLE * k, 32), MAX_KERNEL_K, n_items)
+    return max(m, k)
+
+
+def _rescore_topk(vals, idxs, q, qn, resid, resid_scales, norms, *, k, cosine):
+    """Trim oversampled int8 candidates to the final top-k by adding the
+    residual plane's contribution: gather the candidates' residual codes
+    (a few KB — never the whole plane), one tiny batched dot, re-rank.
+    Candidates are re-sorted by item id first so the stable top_k keeps
+    breaking ties toward the lowest index."""
+    order = jnp.argsort(idxs, axis=1)
+    ii = jnp.take_along_axis(idxs, order, axis=1)  # [b, m] ascending ids
+    vv = jnp.take_along_axis(vals, order, axis=1)
+    cand = jnp.take(resid, ii, axis=1).astype(jnp.float32)  # [kf, b, m]
+    corr = jnp.einsum(
+        "bf,fbm->bm", q, cand, precision=jax.lax.Precision.HIGHEST
+    )
+    aux2 = resid_scales[0]
+    if cosine:
+        aux2 = aux2 / jnp.maximum(norms[0], 1e-12)
+    corr = corr * aux2[ii]
+    if cosine:
+        corr = corr / jnp.maximum(qn, 1e-12)
+    # padding candidates carry -inf from the scan; keep them out
+    sc = jnp.where(jnp.isfinite(vv), vv + corr, -jnp.inf)
+    v, pos = jax.lax.top_k(sc, k)
+    return v, jnp.take_along_axis(ii, pos, axis=1)
+
+
+
+
 @functools.partial(
     jax.jit, static_argnames=("k", "n_items", "cosine", "interpret", "download_dtype")
 )
 def _streaming_topk_multi(
-    mat_t, norms, queries_kb, *, k, n_items, cosine, interpret, download_dtype=None
+    mat_t, norms, scales, resid, resid_scales, queries_kb, *,
+    k, n_items, cosine, interpret, download_dtype=None,
 ):
     """K full-matrix scans in ONE dispatch: lax.map runs the pallas scan
     sequentially over [K, b, feat] query groups inside a single jitted
@@ -205,7 +460,8 @@ def _streaming_topk_multi(
 
     def one(q):
         return _streaming_topk_impl(
-            mat_t, norms, q, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+            mat_t, norms, scales, resid, resid_scales, q,
+            k=k, n_items=n_items, cosine=cosine, interpret=interpret,
         )
 
     vals, idxs = jax.lax.map(one, queries_kb)
@@ -218,10 +474,12 @@ def _streaming_topk_multi(
     jax.jit, static_argnames=("k", "n_items", "cosine", "interpret", "download_dtype")
 )
 def _streaming_topk(
-    mat_t, norms, queries, *, k, n_items, cosine, interpret, download_dtype=None
+    mat_t, norms, scales, resid, resid_scales, queries, *,
+    k, n_items, cosine, interpret, download_dtype=None,
 ):
     vals, idxs = _streaming_topk_impl(
-        mat_t, norms, queries, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+        mat_t, norms, scales, resid, resid_scales, queries,
+        k=k, n_items=n_items, cosine=cosine, interpret=interpret,
     )
     if download_dtype is not None:
         vals = vals.astype(download_dtype)
@@ -245,9 +503,101 @@ def _subtiles_for(k_feat: int, b: int, dtype_bytes: int) -> int:
     return s
 
 
-def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret):
+def _candidates_tile_for(k_feat: int, b: int, dtype_bytes: int) -> int:
+    """Score-tile width for the block-local candidates kernel: halve from
+    SCORE_TILE until the [b, tile] score + iota tiles and the item block
+    fit scoped VMEM (same calibration as ``_subtiles_for``). Power-of-two
+    halving keeps tile * SUBTILES a divisor of BLOCK_N, so the grid stays
+    exact for any padded item count."""
+    tile = SCORE_TILE
+    while tile > 256 and (
+        b * tile * 8 + 2 * k_feat * tile * SUBTILES * dtype_bytes + 4 * 2**20
+        > _VMEM_BUDGET - 256 * 1024
+    ):
+        tile //= 2
+    return tile
+
+
+def _fold_aux(norms, scales, cosine: bool):
+    """The kernel's third operand: item norms (unquantized) or the folded
+    dequant multiplier (quantized — cosine divides the cached norms into
+    the per-row scale here, outside the kernel, so scoring is one
+    multiply either way)."""
+    if scales is None:
+        return norms
+    if cosine:
+        return scales / jnp.maximum(norms, 1e-12)
+    return scales
+
+
+def _pad_queries(q, k_feat: int):
+    # int8 sublane padding: the handle's feature dim is a 32-multiple;
+    # zero-pad queries to match (zero features cannot change any score)
+    if q.shape[1] < k_feat:
+        q = jnp.pad(q, ((0, 0), (0, k_feat - q.shape[1])))
+    return q
+
+
+def _streaming_topk_impl(
+    mat_t, norms, scales, resid, resid_scales, queries, *,
+    k, n_items, cosine, interpret,
+):
     k_feat, n_pad = mat_t.shape
     b = queries.shape[0]
+    quantized = scales is not None
+    q = _pad_queries(queries.astype(jnp.float32 if quantized else mat_t.dtype), k_feat)
+    aux = _fold_aux(norms, scales, cosine)
+    m = _scan_k(k, n_items, resid)
+
+    def finish(vals, idxs):
+        if resid is None or RESCORE_OVERSAMPLE <= 1:
+            return vals, idxs
+        qn = (
+            jnp.linalg.norm(q.astype(jnp.float32), axis=1, keepdims=True)
+            if cosine
+            else None
+        )
+        return _rescore_topk(
+            vals, idxs, q.astype(jnp.float32), qn, resid, resid_scales, norms,
+            k=k, cosine=cosine,
+        )
+    common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
+    if pltpu is None:  # pragma: no cover - jax builds without pallas-tpu
+        raise RuntimeError(
+            "streaming top-k needs jax.experimental.pallas.tpu (scratch "
+            "state); use the XLA handle (upload(streaming=False)) instead"
+        )
+    if b > LOCAL_TOPK_BATCH:
+        # block-local candidates: per-block [b, k] tiles + one final merge
+        tile = _candidates_tile_for(k_feat, b, mat_t.dtype.itemsize)
+        step = tile * SUBTILES
+        grid = n_pad // step
+        kernel = functools.partial(
+            _topn_candidates_kernel, k=m, n_items=n_items, cosine=cosine,
+            quantized=quantized, subtiles=SUBTILES, tile=tile,
+        )
+        vals_c, idx_c = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[
+                pl.BlockSpec((b, k_feat), lambda i: (0, 0), **common),
+                pl.BlockSpec((k_feat, step), lambda i: (0, i), **common),
+                pl.BlockSpec((1, step), lambda i: (0, i), **common),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, b, m), lambda i: (i, 0, 0), **common),
+                pl.BlockSpec((1, b, m), lambda i: (i, 0, 0), **common),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((grid, b, m), jnp.float32),
+                jax.ShapeDtypeStruct((grid, b, m), jnp.int32),
+            ],
+            interpret=interpret,
+        )(q, mat_t, aux)
+        allv = jnp.moveaxis(vals_c, 0, 1).reshape(b, grid * m)
+        alli = jnp.moveaxis(idx_c, 0, 1).reshape(b, grid * m)
+        vals, pos = jax.lax.top_k(allv, m)
+        return finish(vals, jnp.take_along_axis(alli, pos, axis=1))
     # adapt sub-tiles to the feature width so wide models (250-feat) still
     # fit scoped VMEM; n_pad is a BLOCK_N multiple, so any power-of-two
     # divisor of SUBTILES keeps the grid exact
@@ -255,15 +605,10 @@ def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret
     step = SCORE_TILE * subtiles
     grid = n_pad // step
     kernel = functools.partial(
-        _topn_kernel, k=k, n_items=n_items, cosine=cosine, grid=grid, subtiles=subtiles
+        _topn_kernel, k=m, n_items=n_items, cosine=cosine, quantized=quantized,
+        grid=grid, subtiles=subtiles,
     )
-    common = dict(memory_space=_VMEM) if (_VMEM is not None and not interpret) else {}
-    if pltpu is None:  # pragma: no cover - jax builds without pallas-tpu
-        raise RuntimeError(
-            "streaming top-k needs jax.experimental.pallas.tpu (scratch "
-            "state); use the XLA handle (upload(streaming=False)) instead"
-        )
-    scratch = [pltpu.VMEM((b, k), jnp.float32), pltpu.VMEM((b, k), jnp.int32)]
+    scratch = [pltpu.VMEM((b, m), jnp.float32), pltpu.VMEM((b, m), jnp.int32)]
     vals, idxs = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -273,16 +618,287 @@ def _streaming_topk_impl(mat_t, norms, queries, *, k, n_items, cosine, interpret
             pl.BlockSpec((1, step), lambda i: (0, i), **common),
         ],
         out_specs=[
-            pl.BlockSpec((b, k), lambda i: (0, 0), **common),
-            pl.BlockSpec((b, k), lambda i: (0, 0), **common),
+            pl.BlockSpec((b, m), lambda i: (0, 0), **common),
+            pl.BlockSpec((b, m), lambda i: (0, 0), **common),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, k), jnp.float32),
-            jax.ShapeDtypeStruct((b, k), jnp.int32),
+            jax.ShapeDtypeStruct((b, m), jnp.float32),
+            jax.ShapeDtypeStruct((b, m), jnp.int32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(queries.astype(mat_t.dtype), mat_t, norms)
+    )(q, mat_t, aux)
+    return finish(vals, idxs)
+
+
+# -- XLA twin of the blocked scan (non-TPU backends) --------------------------
+
+
+def _xla_scan_step(n_pad: int) -> int:
+    """Largest BLOCK_N multiple that divides ``n_pad``, capped at
+    XLA_SCAN_BLOCK — keeps the lax.scan grid exact without re-padding."""
+    m = n_pad // BLOCK_N
+    d = max(1, min(XLA_SCAN_BLOCK // BLOCK_N, m))
+    while m % d:
+        d -= 1
+    return BLOCK_N * d
+
+
+def _xla_streaming_topk_impl(
+    mat_t, norms, scales, resid, resid_scales, queries, *, k, n_items, cosine
+):
+    """Fused XLA blocked scan over the feature-major layout: lax.scan
+    streams [k_feat, block] item slices and reduces each block on the
+    spot, so the [b, n] score matrix never materializes — which is what
+    lets scan batches grow past the memory of the naive matmul+top_k
+    path. f32/bf16 handles top-k each block exactly and merge the
+    [b, grid * k] candidates with one tiny lax.top_k. int8 handles
+    (upcast to f32 before the dot — XLA CPU int8 matmul is ~3x slower
+    than upcast + f32 GEMM, measured) reduce each block to per-_CHUNK
+    maxes instead: the max fuses into the GEMM's epilogue where a wide
+    in-scan lax.top_k does not (measured ~2x the scan time), the top-m
+    chunks by max provably contain the top-m items, and only those
+    chunks' columns gather both int8 planes for an exact ~14-bit rescore
+    after the scan. HIGHEST precision keeps the f32 GEMM on the fast CPU
+    path (the DEFAULT-precision CPU kernel is ~2x slower, measured)."""
+    k_feat, n_pad = mat_t.shape
+    b = queries.shape[0]
+    quantized = scales is not None
+    q = _pad_queries(queries.astype(jnp.float32), k_feat)
+    qn = jnp.linalg.norm(q, axis=1, keepdims=True) if cosine else None
+    mult = _fold_aux(norms, scales, cosine) if quantized else None
+    block = _xla_scan_step(n_pad)
+    grid = n_pad // block
+    m = _scan_k(k, n_items, resid)
+    chunked = (
+        quantized
+        and resid is not None
+        and RESCORE_OVERSAMPLE > 1
+        and block % _CHUNK == 0
+        and block // _CHUNK >= _chunk_k(k, block // _CHUNK)
+    )
+    # padding mask as an ADDITIVE bias, not a per-element where: the
+    # iota-compare-select breaks the GEMM epilogue fusion and costs ~3x
+    # the GEMM itself (measured: +1.1 s/dispatch at 1M x 50); a broadcast
+    # add of a constant-folded [-inf over padded cols] row fuses like the
+    # scale multiply does. Padded columns are all-zero so their dot is
+    # finite (0) and 0 + -inf = -inf, never NaN.
+    bias = jnp.where(
+        jnp.arange(n_pad, dtype=jnp.int32) < n_items, 0.0, -jnp.inf
+    )[None, :].astype(jnp.float32)
+
+    def scores_for(i):
+        base = i * block
+        blk = jax.lax.dynamic_slice(mat_t, (0, base), (k_feat, block))
+        scores = jnp.dot(
+            q,
+            blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if quantized:
+            scores = scores * jax.lax.dynamic_slice(mult, (0, base), (1, block))
+            if cosine:
+                scores = scores / jnp.maximum(qn, 1e-12)
+        elif cosine:
+            nrm = jax.lax.dynamic_slice(norms, (0, base), (1, block))
+            scores = scores / jnp.maximum(nrm * qn, 1e-12)
+        return scores + jax.lax.dynamic_slice(bias, (0, base), (1, block))
+
+    if not chunked:
+        kk = min(k, block)
+
+        def step(carry, i):
+            v, p = jax.lax.top_k(scores_for(i), kk)
+            return carry, (v, p + i * block)
+
+        _, (vs, idxs) = jax.lax.scan(step, 0, jnp.arange(grid, dtype=jnp.int32))
+        # candidates are ordered (block, rank): for equal scores the
+        # earlier position is the earlier block / lower item id, and
+        # lax.top_k is stable — so ties break by lowest index, same as
+        # the kernel
+        allv = jnp.moveaxis(vs, 0, 1).reshape(b, grid * kk)
+        alli = jnp.moveaxis(idxs, 0, 1).reshape(b, grid * kk)
+        vals, pos = jax.lax.top_k(allv, min(k, allv.shape[1]))
+        return vals, jnp.take_along_axis(alli, pos, axis=1)
+
+    chunks = block // _CHUNK
+    kc = _chunk_k(k, chunks)
+
+    def step(carry, i):
+        cm = jnp.max(scores_for(i).reshape(b, chunks, _CHUNK), axis=2)
+        v, p = jax.lax.top_k(cm, kc)
+        return carry, (v, p + i * chunks)
+
+    _, (vs, cps) = jax.lax.scan(step, 0, jnp.arange(grid, dtype=jnp.int32))
+    poolv = jnp.moveaxis(vs, 0, 1).reshape(b, grid * kc)
+    pooli = jnp.moveaxis(cps, 0, 1).reshape(b, grid * kc)
+    return _chunk_tail(
+        mat_t, resid, scales, resid_scales, norms, q, qn, poolv, pooli,
+        k=k, kc=kc, n_items=n_items, cosine=cosine,
+    )
+
+
+def _chunk_tail(
+    mat_t, resid, scales, resid_scales, norms, q, qn, poolv, pooli, *,
+    k, kc, n_items, cosine,
+):
+    """Candidate stage of the chunked scan: keep the globally best chunks
+    from the pooled per-block chunk maxes, gather BOTH int8 planes for
+    just their columns, and pick the final top-k from exact ~14-bit
+    two-plane scores."""
+    b = q.shape[0]
+    mc = min(kc, poolv.shape[1])
+    _, sel = jax.lax.top_k(poolv, mc)
+    # ascending chunk ids -> ascending item ids, so the stable final
+    # top_k keeps breaking ties toward the lowest item id
+    cid = jnp.sort(jnp.take_along_axis(pooli, sel, axis=1), axis=1)
+    iid = (
+        cid[:, :, None] * _CHUNK + jnp.arange(_CHUNK, dtype=jnp.int32)[None, None, :]
+    ).reshape(b, mc * _CHUNK)
+    c1 = jnp.take(mat_t, iid, axis=1).astype(jnp.float32)  # [kf, b, mc*_CHUNK]
+    c2 = jnp.take(resid, iid, axis=1).astype(jnp.float32)
+    d1 = jnp.einsum("bf,fbm->bm", q, c1, precision=jax.lax.Precision.HIGHEST)
+    d2 = jnp.einsum("bf,fbm->bm", q, c2, precision=jax.lax.Precision.HIGHEST)
+    sc = d1 * scales[0][iid] + d2 * resid_scales[0][iid]
+    if cosine:
+        sc = sc / jnp.maximum(norms[0][iid] * qn, 1e-12)
+    sc = jnp.where(iid < n_items, sc, -jnp.inf)
+    v, pos = jax.lax.top_k(sc, k)
+    return v, jnp.take_along_axis(iid, pos, axis=1)
+
+
+def _xla_streaming_topk_multi_impl(
+    mat_t, norms, scales, resid, resid_scales, q_kbf, *, k, n_items, cosine
+):
+    """K fused scans sharing ONE pass of int8->f32 block conversion. The
+    naive multi path (lax.map of the single impl) re-converts every item
+    block once per query group, and at wide features that conversion is
+    ~50% on top of the pure f32 GEMM (measured per-block 15.5 ms mixed
+    vs 10.4 ms f32 x f32 at 256x16384) — so the loops invert here: the
+    lax.scan over blocks is OUTSIDE and the K group GEMMs unroll INSIDE
+    the step, all reading the same materialized f32 block. Per-group
+    score tiles stay [b, block] (the merged [K*b, block] tile blows the
+    LLC — measured 3x slowdown at 512 rows), and the candidate tails
+    stay per-group after the scan. Non-chunked handles (f32/bf16, tiny
+    matrices) keep the exact lax.map path."""
+    kg, b, _ = q_kbf.shape
+    k_feat, n_pad = mat_t.shape
+    block = _xla_scan_step(n_pad)
+    grid = n_pad // block
+    chunks = block // _CHUNK
+    chunked = (
+        scales is not None
+        and resid is not None
+        and RESCORE_OVERSAMPLE > 1
+        and block % _CHUNK == 0
+        and chunks >= _chunk_k(k, chunks)
+    )
+    if not chunked:
+        def one(q):
+            return _xla_streaming_topk_impl(
+                mat_t, norms, scales, resid, resid_scales, q,
+                k=k, n_items=n_items, cosine=cosine,
+            )
+
+        return jax.lax.map(one, q_kbf)
+
+    kc = _chunk_k(k, chunks)
+    q_k = _pad_queries(
+        q_kbf.astype(jnp.float32).reshape(kg * b, -1), k_feat
+    ).reshape(kg, b, k_feat)
+    qn_k = (
+        jnp.linalg.norm(q_k, axis=2, keepdims=True) if cosine else [None] * kg
+    )
+    mult = _fold_aux(norms, scales, cosine)
+    bias = jnp.where(
+        jnp.arange(n_pad, dtype=jnp.int32) < n_items, 0.0, -jnp.inf
+    )[None, :].astype(jnp.float32)
+
+    def step(carry, i):
+        base = i * block
+        blk = jax.lax.dynamic_slice(
+            mat_t, (0, base), (k_feat, block)
+        ).astype(jnp.float32)
+        m_b = jax.lax.dynamic_slice(mult, (0, base), (1, block))
+        bia = jax.lax.dynamic_slice(bias, (0, base), (1, block))
+        vs, ps = [], []
+        for g in range(kg):  # static unroll: kg GEMMs share blk
+            sc = (
+                jnp.dot(
+                    q_k[g], blk,
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                * m_b
+            )
+            if cosine:
+                sc = sc / jnp.maximum(qn_k[g], 1e-12)
+            cm = jnp.max((sc + bia).reshape(b, chunks, _CHUNK), axis=2)
+            v, p = jax.lax.top_k(cm, kc)
+            vs.append(v)
+            ps.append(p + i * chunks)
+        return carry, (jnp.stack(vs), jnp.stack(ps))
+
+    _, (vs, cps) = jax.lax.scan(step, 0, jnp.arange(grid, dtype=jnp.int32))
+    poolv = jnp.transpose(vs, (1, 2, 0, 3)).reshape(kg, b, grid * kc)
+    pooli = jnp.transpose(cps, (1, 2, 0, 3)).reshape(kg, b, grid * kc)
+    outs = [
+        _chunk_tail(
+            mat_t, resid, scales, resid_scales, norms, q_k[g], qn_k[g],
+            poolv[g], pooli[g], k=k, kc=kc, n_items=n_items, cosine=cosine,
+        )
+        for g in range(kg)
+    ]
+    return jnp.stack([v for v, _ in outs]), jnp.stack([i for _, i in outs])
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_items", "cosine", "download_dtype")
+)
+def _xla_streaming_topk(
+    mat_t, norms, scales, resid, resid_scales, queries, *,
+    k, n_items, cosine, download_dtype=None,
+):
+    vals, idxs = _xla_streaming_topk_impl(
+        mat_t, norms, scales, resid, resid_scales, queries,
+        k=k, n_items=n_items, cosine=cosine,
+    )
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
+    return vals, idxs
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_items", "cosine", "download_dtype")
+)
+def _xla_streaming_topk_multi(
+    mat_t, norms, scales, resid, resid_scales, queries_kb, *,
+    k, n_items, cosine, download_dtype=None,
+):
+    vals, idxs = _xla_streaming_topk_multi_impl(
+        mat_t, norms, scales, resid, resid_scales, queries_kb,
+        k=k, n_items=n_items, cosine=cosine,
+    )
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
+    return vals, idxs
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_items", "cosine", "download_dtype")
+)
+def _xla_streaming_topk_multi_indexed(
+    mat_t, norms, scales, resid, resid_scales, x_dev, idx_kb, *,
+    k, n_items, cosine, download_dtype=None,
+):
+    vals, idxs = _xla_streaming_topk_multi_impl(
+        mat_t, norms, scales, resid, resid_scales,
+        x_dev[idx_kb].astype(jnp.float32),
+        k=k, n_items=n_items, cosine=cosine,
+    )
+    if download_dtype is not None:
+        vals = vals.astype(download_dtype)
     return vals, idxs
 
 
@@ -292,20 +908,43 @@ MAX_KERNEL_K = 128
 
 
 @functools.partial(jax.jit, static_argnames=("k", "n_items", "cosine"))
-def _materialized_topk(mat_t, norms, queries, *, k, n_items, cosine):
+def _materialized_topk(
+    mat_t, norms, scales, resid, resid_scales, queries, *, k, n_items, cosine
+):
     """Large-k fallback over the same feature-major layout: materialize
-    [b, n] scores once and let XLA's top_k handle the wide selection."""
-    q = queries.astype(mat_t.dtype)
-    precision = (
-        jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else jax.lax.Precision.DEFAULT
+    [b, n] scores once and let XLA's top_k handle the wide selection.
+    Quantized handles sum both planes in full here — at k > MAX_KERNEL_K
+    the oversample-then-rescore shape stops paying for itself."""
+    quantized = scales is not None
+    q = _pad_queries(
+        queries.astype(jnp.float32 if quantized else mat_t.dtype), mat_t.shape[0]
     )
-    scores = jnp.dot(q, mat_t, preferred_element_type=jnp.float32, precision=precision)
+    mat = mat_t.astype(jnp.float32) if quantized else mat_t
+    scores = jnp.dot(
+        q, mat, preferred_element_type=jnp.float32,
+        precision=_dot_precision_for(q, quantized),
+    )
+    if quantized:
+        scores = scores * scales
+        if resid is not None:
+            scores = scores + jnp.dot(
+                q, resid.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+                precision=_dot_precision_for(q, quantized),
+            ) * resid_scales
     if cosine:
         qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
         scores = scores / jnp.maximum(norms * qn, 1e-12)
     cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
     scores = jnp.where(cols < n_items, scores, -jnp.inf)
     return jax.lax.top_k(scores, k)
+
+
+def _use_xla_scan(interpret) -> bool:
+    """Non-TPU backends with no explicit interpret request run the XLA
+    twin of the blocked scan; ``interpret=True`` always forces the Pallas
+    interpreter (the parity test suite), and TPU compiles the kernel."""
+    return interpret is None and jax.default_backend() != "tpu"
 
 
 def top_k_streaming_device(
@@ -317,25 +956,33 @@ def top_k_streaming_device(
     download_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """(scores [b, k], indices [b, k]) as device arrays — the async
-    building block. ``interpret`` defaults to the Pallas interpreter on
-    non-TPU backends so the same handle works everywhere."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    building block. ``interpret=None`` picks per backend: the compiled
+    kernel on TPU, the fused XLA blocked scan elsewhere."""
     q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
     k = max(1, min(int(k), up.n_items))
     if k > MAX_KERNEL_K:
         vals, idxs = _materialized_topk(
-            up.mat_t, up.norms, jnp.asarray(q), k=k, n_items=up.n_items, cosine=cosine
+            up.mat_t, up.norms, up.scales, up.resid, up.resid_scales,
+            jnp.asarray(q), k=k, n_items=up.n_items, cosine=cosine,
         )
         return (vals.astype(download_dtype) if download_dtype is not None else vals), idxs
+    if _use_xla_scan(interpret):
+        return _xla_streaming_topk(
+            up.mat_t, up.norms, up.scales, up.resid, up.resid_scales,
+            jnp.asarray(q),
+            k=k, n_items=up.n_items, cosine=cosine, download_dtype=download_dtype,
+        )
     return _streaming_topk(
         up.mat_t,
         up.norms,
+        up.scales,
+        up.resid,
+        up.resid_scales,
         jnp.asarray(q),
         k=k,
         n_items=up.n_items,
         cosine=cosine,
-        interpret=interpret,
+        interpret=bool(interpret),
         download_dtype=download_dtype,
     )
 
@@ -350,17 +997,23 @@ def top_k_streaming_device_multi(
 ) -> tuple[jax.Array, jax.Array]:
     """(scores [K, b, k], indices [K, b, k]) for [K, b, feat] query
     groups — K full-matrix scans fused into one dispatch."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     k = max(1, min(int(k), up.n_items))
+    if _use_xla_scan(interpret):
+        return _xla_streaming_topk_multi(
+            up.mat_t, up.norms, up.scales, up.resid, up.resid_scales, queries_kb,
+            k=k, n_items=up.n_items, cosine=cosine, download_dtype=download_dtype,
+        )
     return _streaming_topk_multi(
         up.mat_t,
         up.norms,
+        up.scales,
+        up.resid,
+        up.resid_scales,
         queries_kb,
         k=k,
         n_items=up.n_items,
         cosine=cosine,
-        interpret=interpret,
+        interpret=bool(interpret),
         download_dtype=download_dtype,
     )
 
@@ -370,7 +1023,8 @@ def top_k_streaming_device_multi(
     static_argnames=("k", "n_items", "cosine", "interpret", "download_dtype"),
 )
 def _streaming_topk_multi_indexed(
-    mat_t, norms, x_dev, idx_kb, *, k, n_items, cosine, interpret, download_dtype=None
+    mat_t, norms, scales, resid, resid_scales, x_dev, idx_kb, *,
+    k, n_items, cosine, interpret, download_dtype=None,
 ):
     """Index-submitted fused multi-scan: gather the [K, b, feat] query
     group from the device-resident ``x_dev`` inside the dispatch, then
@@ -379,7 +1033,8 @@ def _streaming_topk_multi_indexed(
     def one(idx_b):
         q = x_dev[idx_b].astype(jnp.float32)
         return _streaming_topk_impl(
-            mat_t, norms, q, k=k, n_items=n_items, cosine=cosine, interpret=interpret
+            mat_t, norms, scales, resid, resid_scales, q,
+            k=k, n_items=n_items, cosine=cosine, interpret=interpret,
         )
 
     vals, idxs = jax.lax.map(one, idx_kb)
@@ -400,18 +1055,24 @@ def top_k_streaming_device_multi_indexed(
     """(scores [K, b, k], indices [K, b, k]) for [K, b] int32 row indices
     into the device-resident query matrix ``x_dev`` — the uplink carries
     4 B/query instead of a full vector."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     k = max(1, min(int(k), up.n_items))
+    if _use_xla_scan(interpret):
+        return _xla_streaming_topk_multi_indexed(
+            up.mat_t, up.norms, up.scales, up.resid, up.resid_scales, x_dev, idx_kb,
+            k=k, n_items=up.n_items, cosine=cosine, download_dtype=download_dtype,
+        )
     return _streaming_topk_multi_indexed(
         up.mat_t,
         up.norms,
+        up.scales,
+        up.resid,
+        up.resid_scales,
         x_dev,
         idx_kb,
         k=k,
         n_items=up.n_items,
         cosine=cosine,
-        interpret=interpret,
+        interpret=bool(interpret),
         download_dtype=download_dtype,
     )
 

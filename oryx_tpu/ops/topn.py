@@ -35,6 +35,9 @@ import numpy as np
 
 from oryx_tpu.ops.pallas_topn import (
     StreamingItemMatrix,
+    _is_int8,
+    _quantize_residual,
+    _quantize_rows,
     top_k_streaming,
     top_k_streaming_device,
     top_k_streaming_device_multi,
@@ -57,7 +60,14 @@ def upload(
     is a :class:`StreamingItemMatrix` (feature-major layout for the
     Pallas kernel, optionally bfloat16); elsewhere it is the plain
     ``(matrix, norms)`` device pair for the XLA path.
+
+    ``dtype=int8`` returns the streaming (feature-major, row-quantized)
+    handle on EVERY backend: the quantized scan engine owns that layout,
+    and non-TPU backends scan it with the fused XLA twin of the kernel
+    rather than materializing [b, n] scores.
     """
+    if _is_int8(dtype):
+        return upload_streaming(matrix, dtype=jnp.int8)
     if streaming is None:
         streaming = _default_streaming()
     if streaming:
@@ -143,15 +153,20 @@ class ShardedItemMatrix:
     (SURVEY §2.12 request parallelism; the reference shards the same way
     across LSH thread partitions on one host)."""
 
-    mat: jax.Array  # [n_pad, k], rows sharded over 'data'
+    mat: jax.Array  # [n_pad, k], rows sharded over 'data'; f32/bf16/int8
     norms: jax.Array  # [n_pad], sharded alike
     n_items: int
     mesh: object
+    scales: jax.Array | None = None  # [n_pad] per-row int8 dequant scale
+    resid: jax.Array | None = None  # [n_pad, k] int8 residual plane
+    resid_scales: jax.Array | None = None  # [n_pad] residual dequant scale
 
 
 def upload_sharded(matrix: np.ndarray, mesh, dtype=None) -> ShardedItemMatrix:
     """Shard a packed [n, k] item matrix row-wise over `mesh`'s devices
-    (padded so every device gets an equal slice)."""
+    (padded so every device gets an equal slice). ``dtype=int8``
+    row-quantizes exactly like the streaming handle: int8 codes sharded
+    with the rows, one f32 scale per row riding next to the norms."""
     from oryx_tpu.parallel.mesh import data_sharding, pad_to_multiple, shard_rows
 
     n, k = matrix.shape
@@ -160,6 +175,18 @@ def upload_sharded(matrix: np.ndarray, mesh, dtype=None) -> ShardedItemMatrix:
     mat = np.zeros((n_pad, k), dtype=np.float32)
     mat[:n] = matrix
     norms = np.linalg.norm(mat, axis=1)
+    if _is_int8(dtype):
+        q, s = _quantize_rows(mat)  # pad rows are all-zero -> scale 1.0
+        q2, s2 = _quantize_residual(mat, q, s)
+        return ShardedItemMatrix(
+            mat=jax.device_put(jnp.asarray(q), data_sharding(mesh, 2)),
+            norms=jax.device_put(jnp.asarray(norms), shard_rows(mesh)),
+            n_items=n,
+            mesh=mesh,
+            scales=jax.device_put(jnp.asarray(s), shard_rows(mesh)),
+            resid=jax.device_put(jnp.asarray(q2), data_sharding(mesh, 2)),
+            resid_scales=jax.device_put(jnp.asarray(s2), shard_rows(mesh)),
+        )
     return ShardedItemMatrix(
         mat=jax.device_put(jnp.asarray(mat, dtype=dtype or jnp.float32), data_sharding(mesh, 2)),
         norms=jax.device_put(jnp.asarray(norms), shard_rows(mesh)),
@@ -168,11 +195,13 @@ def upload_sharded(matrix: np.ndarray, mesh, dtype=None) -> ShardedItemMatrix:
     )
 
 
-def _sharded_topk_fn(mesh, k: int, cosine: bool):
+def _sharded_topk_fn(mesh, k: int, cosine: bool, quantized: bool = False):
     """shard_map'd scan: each device scores and top-k's its row shard,
     then the tiny [b, k]-per-device candidates all-gather and a final
     top-k merges them — the [b, n] score matrix never materializes
-    globally and no full-matrix collective ever runs."""
+    globally and no full-matrix collective ever runs. Quantized shards
+    upcast their int8 slice in-register and dequantize by the sharded
+    per-row scale after the dot."""
     from jax.sharding import PartitionSpec as P
 
     try:
@@ -182,12 +211,25 @@ def _sharded_topk_fn(mesh, k: int, cosine: bool):
 
     from oryx_tpu.parallel.mesh import DATA_AXIS
 
-    def local(mat, norms, queries, qn, shard_base, n_items_arr):
-        # mat: [n_local, k_feat]; shard_base: [1] global row offset
+    def local(mat, norms, scales, resid, resid_scales, queries, qn, shard_base, n_items_arr):
+        # mat: [n_local, k_feat]; shard_base: [1] global row offset;
+        # scales/resid/resid_scales: per-row dequant multipliers and the
+        # int8 residual plane (norms/mat dummies with the same sharding
+        # when not quantized, ignored below). Sharded scans sum both int8
+        # planes in full — per-shard candidate gathers aren't worth the
+        # collective plumbing, and the shards split the extra GEMM anyway.
+        m = mat.astype(jnp.float32) if quantized else mat
         scores = jnp.dot(
-            queries, mat.T, preferred_element_type=jnp.float32,
-            precision=_dot_precision(mat.dtype),
+            queries, m.T, preferred_element_type=jnp.float32,
+            precision=_dot_precision(m.dtype),
         )  # [b, n_local]
+        if quantized:
+            scores = scores * scales[None, :]
+            scores = scores + jnp.dot(
+                queries, resid.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32,
+                precision=_dot_precision(mat.dtype),
+            ) * resid_scales[None, :]
         if cosine:
             scores = scores / jnp.maximum(norms[None, :] * qn, 1e-12)
         # mask padding by global row position — NOT by zero norms, which
@@ -208,6 +250,9 @@ def _sharded_topk_fn(mesh, k: int, cosine: bool):
     in_specs = (
         P(DATA_AXIS, None),
         P(DATA_AXIS),
+        P(DATA_AXIS),  # per-row scales (or the norms dummy)
+        P(DATA_AXIS, None),  # residual plane (or the mat dummy)
+        P(DATA_AXIS),  # residual scales (or the norms dummy)
         P(),  # queries replicated
         P(),
         P(DATA_AXIS),
@@ -238,11 +283,15 @@ def top_k_sharded(
     d = up.mesh.devices.size
     per = up.mat.shape[0] // d
     shard_base = jnp.arange(d, dtype=jnp.int32) * per
-    fn = _sharded_topk_cache(up.mesh, k, bool(cosine))
+    quantized = up.scales is not None
+    fn = _sharded_topk_cache(up.mesh, k, bool(cosine), quantized)
     vals, idxs = fn(
         up.mat,
         up.norms,
-        jnp.asarray(q, dtype=up.mat.dtype),
+        up.scales if quantized else up.norms,
+        up.resid if quantized else up.mat,
+        up.resid_scales if quantized else up.norms,
+        jnp.asarray(q, dtype=jnp.float32 if quantized else up.mat.dtype),
         jnp.asarray(qn),
         shard_base,
         jnp.asarray([up.n_items], dtype=jnp.int32),
@@ -253,11 +302,11 @@ def top_k_sharded(
 _sharded_fns: dict = {}
 
 
-def _sharded_topk_cache(mesh, k: int, cosine: bool):
-    key = (id(mesh), k, cosine)
+def _sharded_topk_cache(mesh, k: int, cosine: bool, quantized: bool = False):
+    key = (id(mesh), k, cosine, quantized)
     fn = _sharded_fns.get(key)
     if fn is None:
-        fn = _sharded_fns[key] = _sharded_topk_fn(mesh, k, cosine)
+        fn = _sharded_fns[key] = _sharded_topk_fn(mesh, k, cosine, quantized)
     return fn
 
 
@@ -281,6 +330,33 @@ def _scatter_rows(mat, norms, rows, vals, new_norms):
     mat = mat.at[rows].set(vals.astype(mat.dtype))
     norms = norms.at[rows].set(new_norms)
     return mat, norms
+
+
+@jax.jit
+def _scatter_rows_t_q(
+    mat_t, norms, scales, resid, resid_scales, rows, q, s, q2, s2, new_norms
+):
+    """int8 feature-major scatter of pre-quantized rows: codes + residual
+    codes + norms + both per-row scales in one call. Quantization happens
+    on the HOST (``_quantize_rows``/``_quantize_residual``, the same
+    functions upload uses) so a speed-layer fold-in that touches a row
+    leaves it bit-identical to a fresh upload of the same values — under
+    jit, XLA fuses the requantize arithmetic into FMAs and drifts a few
+    ulps from the host result."""
+    kf_pad = mat_t.shape[0]
+
+    def pad_t(codes):
+        codes = codes.T
+        if codes.shape[0] < kf_pad:  # int8 sublane padding on the handle
+            codes = jnp.pad(codes, ((0, kf_pad - codes.shape[0]), (0, 0)))
+        return codes
+
+    mat_t = mat_t.at[:, rows].set(pad_t(q))
+    resid = resid.at[:, rows].set(pad_t(q2))
+    norms = norms.at[0, rows].set(new_norms)
+    scales = scales.at[0, rows].set(s)
+    resid_scales = resid_scales.at[0, rows].set(s2)
+    return mat_t, norms, scales, resid, resid_scales
 
 
 def capacity(uploaded) -> int:
@@ -315,14 +391,26 @@ def update_rows(uploaded, rows: np.ndarray, values: np.ndarray, n_items: int | N
         values = np.concatenate([values, np.repeat(values[-1:], pad, axis=0)])
     new_norms = np.linalg.norm(values, axis=1)
     if isinstance(uploaded, StreamingItemMatrix):
+        count = uploaded.n_items if n_items is None else n_items
+        if uploaded.scales is not None:
+            # quantized handle: touched rows requantize in place — the
+            # speed-layer fold-in path never falls back to a full upload
+            qr, sr = _quantize_rows(values)
+            q2r, s2r = _quantize_residual(values, qr, sr)
+            mat_t, norms, scales, resid, resid_scales = _scatter_rows_t_q(
+                uploaded.mat_t, uploaded.norms, uploaded.scales,
+                uploaded.resid, uploaded.resid_scales, rows,
+                qr, sr, q2r, s2r, new_norms,
+            )
+            return StreamingItemMatrix(
+                mat_t=mat_t, norms=norms, n_items=count,
+                scales=scales, features=uploaded.features,
+                resid=resid, resid_scales=resid_scales,
+            )
         mat_t, norms = _scatter_rows_t(
             uploaded.mat_t, uploaded.norms, rows, values, new_norms
         )
-        return StreamingItemMatrix(
-            mat_t=mat_t,
-            norms=norms,
-            n_items=uploaded.n_items if n_items is None else n_items,
-        )
+        return StreamingItemMatrix(mat_t=mat_t, norms=norms, n_items=count)
     mat, norms = uploaded
     return _scatter_rows(mat, norms, rows, values, new_norms)
 
@@ -380,7 +468,9 @@ def _auto_download_dtype(uploaded) -> object | None:
     bound link as bf16 cuts the per-hit payload from 8 B to 6 B without
     changing the on-device ranking. f32 matrices keep f32 results."""
     mat = uploaded.mat_t if isinstance(uploaded, StreamingItemMatrix) else uploaded[0]
-    return jnp.bfloat16 if mat.dtype == jnp.bfloat16 else None
+    # int8 scores carry ~0.4% quantization error already — bf16 wire dtype
+    # loses nothing that selection kept
+    return jnp.bfloat16 if mat.dtype in (jnp.bfloat16, jnp.int8) else None
 
 
 def _group_pad(arr: np.ndarray, scan_batch: int) -> tuple[np.ndarray, int]:
@@ -456,6 +546,25 @@ def upload_random(
         streaming = _default_streaming()
     dtype = dtype or jnp.float32
     key = jax.random.PRNGKey(seed)
+    if _is_int8(dtype):
+        # int8 is always the streaming layout: generate f32 feature-major
+        # on device, then quantize per column (= per item row) in place
+        from oryx_tpu.ops.pallas_topn import _INT8_FEAT_MULTIPLE, BLOCK_N, _ceil_to
+
+        n_pad = max(BLOCK_N, ((n_items + BLOCK_N - 1) // BLOCK_N) * BLOCK_N)
+        mat_t, norms = _gen_streaming_random(
+            key, num_features, n_pad, n_items, jnp.float32
+        )
+        mat_q, scales, mat_r, rscales = _quantize_cols_t(mat_t)
+        kf_pad = _ceil_to(num_features, _INT8_FEAT_MULTIPLE)
+        if kf_pad != num_features:
+            mat_q = jnp.pad(mat_q, ((0, kf_pad - num_features), (0, 0)))
+            mat_r = jnp.pad(mat_r, ((0, kf_pad - num_features), (0, 0)))
+        return StreamingItemMatrix(
+            mat_t=mat_q, norms=norms, n_items=n_items, scales=scales,
+            features=num_features if kf_pad != num_features else None,
+            resid=mat_r, resid_scales=rscales,
+        )
     if streaming:
         from oryx_tpu.ops.pallas_topn import BLOCK_N
 
@@ -497,6 +606,24 @@ def _gen_streaming_random(key, num_features, n_pad, n_items, dtype):
         # which is harmless for benchmark data)
         buf = _fill_normal_block(buf, keys[i], min(start, n_pad - chunk), chunk)
     return _mask_and_norms(buf, jnp.int32(n_items), n_pad)
+
+
+@jax.jit
+def _quantize_cols_t(mat_t):
+    """Column-wise (= per item row in the feature-major layout) symmetric
+    int8 quantization on device — same absmax/127 rule as the host path,
+    so padding columns (all-zero) get scale 1.0 and codes 0. Returns both
+    planes (codes + residual codes) and their per-column scales."""
+
+    def requant(v):
+        absmax = jnp.max(jnp.abs(v), axis=0, keepdims=True)
+        s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(v / s), -127, 127)
+        return q, s
+
+    q, s = requant(mat_t)
+    q2, s2 = requant(mat_t - q * s)
+    return q.astype(jnp.int8), s, q2.astype(jnp.int8), s2
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3))

@@ -26,20 +26,50 @@ serving pattern:
 Under load the queue naturally fills while the device is busy, so batch
 size adapts to concurrency automatically (1 request → batch of 1,
 hundreds of concurrent requests → full batches).
+
+By default the scheduler is ADAPTIVE: instead of fixed ``max_batch`` /
+``max_inflight`` knobs, the completer keeps an EWMA of dispatch latency
+and sizes both from it — inflight depth targets a wall-clock latency
+budget (slow dispatches → shallower pipeline, so a queued request never
+sits behind seconds of device work), and the microbatch ceiling grows
+while dispatches come back faster than the budget. Passing explicit
+``max_batch`` / ``max_inflight`` pins the legacy fixed behavior.
+
+The dispatcher also fixes bucket fragmentation under backpressure: when
+every inflight slot is taken, draining the queue in eager dribbles would
+dispatch many small power-of-two-padded groups (each mostly padding).
+Instead the dispatcher keeps absorbing arrivals in 1 ms waits while it
+is blocked anyway, so one full batch goes out where several fragments
+would have — ``serving.batcher.coalesced`` counts the requests that
+piggybacked this way, and ``serving.batcher.queue_depth`` /
+``inflight`` / ``batch_size`` gauges expose the live scheduler state
+through ``oryx_tpu.common.metrics``.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from oryx_tpu.common.metrics import registry as _metrics
 from oryx_tpu.ops import topn as topn_ops
 
 log = logging.getLogger(__name__)
+
+# Adaptive-scheduler tuning (oryx.serving.scan.* in reference.conf maps
+# onto these env knobs via the serving layer).
+LATENCY_BUDGET_MS = float(os.environ.get("ORYX_BATCHER_LATENCY_BUDGET_MS", 50.0))
+EWMA_ALPHA = 0.25  # completer's dispatch-latency smoothing
+MIN_ADAPTIVE_BATCH = 256  # one full fused-scan group
+MAX_ADAPTIVE_BATCH = 4096
+MIN_INFLIGHT = 2  # always enough to overlap host prep with device work
+MAX_INFLIGHT = 32
 
 
 class BatcherClosedError(RuntimeError):
@@ -83,11 +113,25 @@ class TopNBatcher:
     # paying per-dispatch cost once instead of per 256-row scan
     MULTI_THRESHOLD = 256
 
-    def __init__(self, max_batch: int = 2048, max_inflight: int = 32) -> None:
-        self.max_batch = max_batch
+    def __init__(
+        self, max_batch: int | None = None, max_inflight: int | None = None
+    ) -> None:
+        # None => adaptive: the completer resizes the knob from its EWMA
+        # of dispatch latency; an explicit value pins it (legacy behavior,
+        # and what most unit tests use to force specific shapes)
+        self._adaptive_batch = max_batch is None
+        self._adaptive_inflight = max_inflight is None
+        self.max_batch = MIN_ADAPTIVE_BATCH if max_batch is None else int(max_batch)
+        self._inflight_cap = (
+            MIN_INFLIGHT + 2 if max_inflight is None else int(max_inflight)
+        )
+        self._ewma_ms: float | None = None
         self._queue: queue.Queue[_Entry | None] = queue.Queue()
         self._pending: queue.Queue = queue.Queue()
-        self._inflight = threading.Semaphore(max_inflight)
+        # inflight tracked under a Condition (not a Semaphore) so the
+        # adaptive cap can move while dispatches are blocked on it
+        self._flight_cv = threading.Condition()
+        self._inflight_count = 0
         self._state_lock = threading.Lock()  # serializes score-enqueue vs close
         self._closed = False
         self._dispatcher = threading.Thread(
@@ -135,20 +179,39 @@ class TopNBatcher:
 
     # -- dispatcher ----------------------------------------------------------
 
+    def _device_busy(self) -> bool:
+        with self._flight_cv:
+            return self._inflight_count >= self._inflight_cap
+
     def _take_batch(self) -> list[_Entry] | None:
         first = self._queue.get()
         if first is None:
             return None
         batch = [first]
+        coalesced = 0
         while len(batch) < self.max_batch:
             try:
                 e = self._queue.get_nowait()
             except queue.Empty:
-                break
+                # bucket-fragmentation fix: with every inflight slot taken
+                # this thread is about to block anyway, so absorb arrivals
+                # in bounded waits instead of dispatching a dribble now
+                # and more power-of-two-padded fragments right after it
+                if not self._device_busy():
+                    break
+                try:
+                    e = self._queue.get(timeout=0.001)
+                except queue.Empty:
+                    continue
+                coalesced += 1
             if e is None:
                 self._queue.put(None)  # keep the shutdown signal visible
                 break
             batch.append(e)
+        if coalesced:
+            _metrics.counter("serving.batcher.coalesced").inc(coalesced)
+        _metrics.gauge("serving.batcher.queue_depth").set(self._queue.qsize())
+        _metrics.gauge("serving.batcher.batch_size").set(len(batch))
         return batch
 
     def _dispatch_loop(self) -> None:
@@ -167,8 +230,48 @@ class TopNBatcher:
             for (_, cosine, _xk), entries in groups.items():
                 self._submit_group(entries, cosine)
 
+    def _acquire_slot(self) -> None:
+        with self._flight_cv:
+            while self._inflight_count >= self._inflight_cap:
+                self._flight_cv.wait(timeout=1.0)
+            self._inflight_count += 1
+            _metrics.gauge("serving.batcher.inflight").set(self._inflight_count)
+
+    def _release_slot(self, latency_s: float | None = None) -> None:
+        with self._flight_cv:
+            self._inflight_count -= 1
+            _metrics.gauge("serving.batcher.inflight").set(self._inflight_count)
+            if latency_s is not None:
+                self._observe_latency(latency_s * 1000.0)
+            self._flight_cv.notify()
+
+    def _observe_latency(self, ms: float) -> None:
+        """EWMA the dispatch latency and resize the adaptive knobs from it
+        (caller holds ``_flight_cv``). Inflight depth targets the latency
+        budget — a queued request waits at most ``depth`` dispatches, so
+        depth ~ budget / per-dispatch cost (+2 keeps the host/device
+        overlap even when one dispatch blows the whole budget). The batch
+        ceiling widens while dispatches stay comfortably inside the
+        budget and narrows when they blow past it."""
+        self._ewma_ms = (
+            ms
+            if self._ewma_ms is None
+            else EWMA_ALPHA * ms + (1.0 - EWMA_ALPHA) * self._ewma_ms
+        )
+        _metrics.gauge("serving.batcher.dispatch_ewma_ms").set(self._ewma_ms)
+        if self._adaptive_inflight:
+            self._inflight_cap = int(
+                min(max(LATENCY_BUDGET_MS / max(self._ewma_ms, 1e-3) + 2, MIN_INFLIGHT), MAX_INFLIGHT)
+            )
+        if self._adaptive_batch:
+            if self._ewma_ms > LATENCY_BUDGET_MS and self.max_batch > MIN_ADAPTIVE_BATCH:
+                self.max_batch //= 2
+            elif self._ewma_ms < LATENCY_BUDGET_MS / 2 and self.max_batch < MAX_ADAPTIVE_BATCH:
+                self.max_batch *= 2
+            self.max_batch = max(MIN_ADAPTIVE_BATCH, min(self.max_batch, MAX_ADAPTIVE_BATCH))
+
     def _submit_group(self, entries: list[_Entry], cosine: bool) -> None:
-        self._inflight.acquire()
+        self._acquire_slot()
         try:
             if entries[0].row is not None:
                 self._submit_indexed(entries, cosine)
@@ -194,9 +297,9 @@ class TopNBatcher:
                 handle = topn_ops.submit_top_k(
                     entries[0].uploaded, queries, kk, cosine=cosine
                 )
-            self._pending.put((handle, entries))
+            self._pending.put((handle, entries, time.perf_counter()))
         except BaseException as exc:  # deliver the failure to the waiters
-            self._inflight.release()
+            self._release_slot()
             for e in entries:
                 e.error = exc
                 e.done.set()
@@ -219,9 +322,9 @@ class TopNBatcher:
                 cosine=cosine,
                 scan_batch=self.MULTI_THRESHOLD,
             )
-            self._pending.put((handle, entries))
+            self._pending.put((handle, entries, time.perf_counter()))
         except BaseException as exc:  # deliver the failure to the waiters
-            self._inflight.release()
+            self._release_slot()
             for e in entries:
                 e.error = exc
                 e.done.set()
@@ -233,9 +336,11 @@ class TopNBatcher:
             item = self._pending.get()
             if item is None:
                 return
-            handle, entries = item
+            handle, entries, t_submit = item
+            latency = None
             try:
                 idx, vals = handle.result()
+                latency = time.perf_counter() - t_submit
                 for row, e in enumerate(entries):
                     e.idx = idx[row, : e.k]
                     e.vals = vals[row, : e.k]
@@ -243,7 +348,7 @@ class TopNBatcher:
                 for e in entries:
                     e.error = exc
             finally:
-                self._inflight.release()
+                self._release_slot(latency)
                 for e in entries:
                     e.done.set()
 
@@ -261,9 +366,26 @@ class TopNBatcher:
 
 _default_lock = threading.Lock()
 _default: TopNBatcher | None = None
+_default_init: dict = {}
 
 
 _atexit_registered = False
+
+
+def configure_scheduler(
+    max_batch: int | None = None,
+    max_inflight: int | None = None,
+    latency_budget_ms: float | None = None,
+) -> None:
+    """Pin the process-wide batcher's scheduler knobs (the serving layer
+    maps ``oryx.serving.scan.*`` here at startup, before the default
+    batcher spins up). ``None`` leaves a knob adaptive."""
+    global LATENCY_BUDGET_MS
+    with _default_lock:
+        _default_init["max_batch"] = max_batch
+        _default_init["max_inflight"] = max_inflight
+        if latency_budget_ms is not None:
+            LATENCY_BUDGET_MS = float(latency_budget_ms)
 
 
 def get_default_batcher() -> TopNBatcher:
@@ -275,7 +397,7 @@ def get_default_batcher() -> TopNBatcher:
     global _default, _atexit_registered
     with _default_lock:
         if _default is None or _default._closed:
-            _default = TopNBatcher()
+            _default = TopNBatcher(**_default_init)
             if not _atexit_registered:
                 import atexit
 

@@ -361,6 +361,26 @@ class ServingLayer:
         self.model_manager_class = config.get_optional_string("oryx.serving.model-manager-class")
         self.app_resources = config.get_optional_strings("oryx.serving.application-resources")
 
+        # quantized pipelined scan engine: push oryx.serving.scan.* into
+        # the micro-batcher scheduler and the scan kernels before either
+        # compiles/spins up (jitted programs bake the knobs in at trace
+        # time; the default batcher is created on first use)
+        from oryx_tpu.ops.pallas_topn import configure_scan
+        from oryx_tpu.serving.batcher import configure_scheduler
+
+        configure_scheduler(
+            max_batch=config.get_optional_int("oryx.serving.scan.max-batch"),
+            max_inflight=config.get_optional_int("oryx.serving.scan.max-inflight"),
+            latency_budget_ms=config.get_optional_float(
+                "oryx.serving.scan.latency-budget-ms"
+            ),
+        )
+        configure_scan(
+            oversample=config.get_optional_int("oryx.serving.scan.oversample"),
+            chunk=config.get_optional_int("oryx.serving.scan.chunk"),
+            block=config.get_optional_int("oryx.serving.scan.block"),
+        )
+
         self.model_manager = None
         self.input_producer = None
         self._update_consumer = None

@@ -66,3 +66,9 @@ def pytest_configure(config):
         "registry: model-registry subsystem tests (manifests, gating, "
         "rollback, retention GC); fast and tier-1-safe, select with -m registry",
     )
+    config.addinivalue_line(
+        "markers",
+        "scan: quantized serving-scan parity suite (int8 two-plane recall, "
+        "requantize round-trips, sharded equivalence); fast and tier-1-safe, "
+        "select with -m scan",
+    )
