@@ -360,6 +360,28 @@ def bench_serving_large() -> None:
         bench_serving_shape(items, features, order=order, seconds=6.0)
 
 
+def _emit_phases(name: str, r: dict, order: int) -> None:
+    """Per-phase wall row next to a trainer's headline: value = iterate
+    (the sweep itself), vs_baseline = iterate's share of the phased wall;
+    init/eval ride along as extra fields. Makes dispatch overhead vs real
+    iteration visible without a profiler."""
+    ph = r.get("phase_sec") or {}
+    if not ph:
+        return
+    total = sum(ph.values())
+    _emit(
+        f"{name} per-phase wall, iterate sec (share of init+iterate+eval)",
+        ph.get("iterate", 0.0),
+        "sec",
+        ph.get("iterate", 0.0) / total if total > 0 else 0.0,
+        order=order,
+        detail=json.dumps(ph),
+        init_sec=ph.get("init"),
+        iterate_sec=ph.get("iterate"),
+        eval_sec=ph.get("eval"),
+    )
+
+
 def bench_kmeans() -> None:
     from tools import train_benchmark as tb
 
@@ -379,6 +401,7 @@ def bench_kmeans() -> None:
         detail=f"sse/pt {r['sse_per_point']}, silhouette {r['silhouette_2k_sample']}",
         mfu=mfu,
     )
+    _emit_phases("k-means", r, order=30)
 
 
 def bench_als() -> None:
@@ -395,6 +418,7 @@ def bench_als() -> None:
         order=12,
         detail=f"{r['config']}; held-out RMSE {r['held_out_rmse']}",
     )
+    _emit_phases("ALS", r, order=32)
 
 
 def _als_scale_mfu(r: dict) -> float | None:
@@ -496,6 +520,7 @@ def bench_rdf() -> None:
         order=11,
         detail=f"{r['config']}; held-out accuracy {r['held_out_accuracy']}",
     )
+    _emit_phases("RDF", r, order=31)
 
 
 def bench_speed() -> None:

@@ -217,6 +217,12 @@ class ALSSpeedModelManager(SpeedModelManager):
                 else:
                     # same config: rotate, keeping recent writes + new model IDs
                     self.model.retain_recent_and_ids(x_ids, y_ids)
+                # queued self-delta bytes predate this MODEL: their vectors
+                # were applied to (or rotated out of) the pre-model state,
+                # so skipping their round-trips now would drop legitimate
+                # re-applications onto the fresh/rotated stores — and any
+                # stale head blocks exact-match skips of post-model deltas
+                self._self_pending.clear()
             else:
                 raise ValueError(f"bad key {key}")
 

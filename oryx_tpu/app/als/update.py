@@ -122,6 +122,15 @@ class ALSUpdate(MLUpdate):
             matmul_dtype=self._config.get("oryx.batch.compute.matmul-dtype", None),
             init_y=self._warm_start_init_y(rm, features),
         )
+        # dispatch hygiene: a warm generation whose degree buckets land on
+        # the same pow2 shape signature reuses the compiled sweep (hits
+        # grow, misses stay flat). A steadily climbing miss count means
+        # bucket shapes are drifting every generation — worth a look.
+        cache = als_ops.compiled_run_cache_info()
+        log.info(
+            "als compiled-run cache: %d hits, %d misses, %d programs resident",
+            cache.hits, cache.misses, cache.currsize,
+        )
         _save_features(candidate_path / "X", rm.user_ids, model.x)
         _save_features(candidate_path / "Y", rm.item_ids, model.y)
         return self._model_to_pmml(features, lam, alpha, rm)

@@ -39,10 +39,13 @@ class KMeansUpdate(MLUpdate):
         self.init_strategy = config.get_string("oryx.kmeans.initialization-strategy")
         self.runs = config.get_int("oryx.kmeans.runs")
         self.eval_strategy = config.get_string("oryx.kmeans.evaluation-strategy").upper()
+        self.minibatch_size = config.get_optional_int("oryx.ml.kmeans.minibatch-size")
         if self.eval_strategy not in EVAL_STRATEGIES:
             raise ValueError(f"unknown evaluation-strategy {self.eval_strategy}")
         if self.init_strategy not in ("k-means||", "random"):
             raise ValueError(f"unknown initialization-strategy {self.init_strategy}")
+        if self.minibatch_size is not None and self.minibatch_size <= 0:
+            raise ValueError("oryx.ml.kmeans.minibatch-size must be positive")
         self.schema = InputSchema(config)
         km.check_numeric_only(self.schema)
         self._config = config
@@ -87,6 +90,7 @@ class KMeansUpdate(MLUpdate):
                 init=self.init_strategy,
                 mesh=mesh,
                 initial_centers=warm_centers if run == 0 else None,
+                minibatch_size=self.minibatch_size,
             )
             log.info("k-means run %d: cost=%.4f", run, cost)
             if best is None or cost < best[2]:

@@ -202,18 +202,51 @@ def _read_node(el, schema, encodings, classification, num_classes):
             node_id, T.NumericPrediction(float(el.get("score", "0")), int(rc)), int(rc)
         )
     assert len(children) == 2, "binary trees expected"
-    # the positive child is the one carrying a real predicate; the
-    # negative child carries True. The reference writes positive first
-    # (document order = evaluation order) but identify by predicate, like
-    # RDFPMMLUtils.translateFromPMML:206-224, to accept either layout.
-    first_true = pmml_io.find(children[0], "True") is not None
-    pos_el, neg_el = (children[1], children[0]) if first_true else (children[0], children[1])
+    # identify the positive child by its predicate OPERATOR (greaterThan/
+    # greaterOrEqual/isIn positive; lessThan/lessOrEqual/isNotIn/True
+    # negative), like RDFPMMLUtils.translateFromPMML:206-224 — element
+    # order alone inverts branches on persisted documents whose writer
+    # put the negative predicate first (only a True-vs-predicate check
+    # can't tell, since both children may carry real predicates).
+    p0, p1 = _child_polarity(children[0]), _child_polarity(children[1])
+    if p1 > p0:
+        pos_el, neg_el = children[1], children[0]
+    else:
+        # includes the indeterminate tie: the reference writes the
+        # positive (predicate-evaluated-first) child in document order
+        pos_el, neg_el = children[0], children[1]
     decision = _read_predicate(pos_el, schema, encodings)
     negative = _read_node(neg_el, schema, encodings, classification, num_classes)
     positive = _read_node(pos_el, schema, encodings, classification, num_classes)
     return T.DecisionNode(
         node_id, decision, negative, positive, int(float(el.get("recordCount", "0")))
     )
+
+
+def _child_polarity(el) -> int:
+    """+1 if this child's predicate marks it the positive branch, -1 the
+    negative, 0 indeterminate. greaterThan/greaterOrEqual and isIn are
+    positive by the writer's convention; lessThan/lessOrEqual, isNotIn
+    and a bare True (producers that predicate only one child) negative."""
+    sp = pmml_io.find(el, "SimplePredicate")
+    if sp is not None:
+        op = sp.get("operator")
+        if op in ("greaterThan", "greaterOrEqual"):
+            return 1
+        if op in ("lessThan", "lessOrEqual"):
+            return -1
+        return 0
+    ssp = pmml_io.find(el, "SimpleSetPredicate")
+    if ssp is not None:
+        op = ssp.get("booleanOperator")
+        if op == "isIn":
+            return 1
+        if op == "isNotIn":
+            return -1
+        return 0
+    if pmml_io.find(el, "True") is not None:
+        return -1
+    return 0
 
 
 def _read_predicate(el, schema, encodings):

@@ -39,6 +39,9 @@ class RDFUpdate(MLUpdate):
         self.num_trees = config.get_int("oryx.rdf.num-trees")
         self.min_node_size = config.get_int("oryx.rdf.hyperparams.min-node-size")
         self.min_info_gain = config.get_float("oryx.rdf.hyperparams.min-info-gain-nats")
+        self.hist_mode = config.get_string("oryx.ml.rdf.hist-mode")
+        if self.hist_mode not in ("auto", "matmul", "scalar", "reference"):
+            raise ValueError(f"unknown oryx.ml.rdf.hist-mode {self.hist_mode!r}")
         self.schema = InputSchema(config)
         if not self.schema.has_target():
             raise ValueError("rdf requires a target feature")
@@ -94,6 +97,7 @@ class RDFUpdate(MLUpdate):
             impurity=impurity,
             exclude_features={target_pred},
             mesh=mesh_from_config(self._config),
+            hist_mode=self.hist_mode,
         )
         importances = forest_ops.feature_importances(arrays, features.shape[1])
         forest = arrays_to_forest(arrays, binning, importances)

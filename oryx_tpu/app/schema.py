@@ -37,15 +37,24 @@ class InputSchema:
         if (numeric is None) == (categorical is None):
             raise ConfigError("set exactly one of numeric-features / categorical-features")
         active = [n for n in names if n not in id_f and n not in ignored]
-        # type declarations apply to ACTIVE features only (the reference
-        # REJECTS declared sets that aren't subsets of the actives,
-        # InputSchema.java:89-101; we normalize instead of erroring so an
-        # id/ignored feature is never numeric nor categorical either way)
+        # type declarations must name ACTIVE features only: the reference
+        # rejects declared sets that aren't subsets of the actives
+        # (InputSchema.java:89-101). Silently intersecting instead would
+        # hide typos — a misspelled feature name drops the declaration and
+        # flips the feature to the complementary type without a word.
+        declared = set(numeric) if numeric is not None else set(categorical)
+        extra = declared - set(active)
+        if extra:
+            which = "numeric" if numeric is not None else "categorical"
+            raise ConfigError(
+                f"{which}-features {sorted(extra)} are not active features "
+                f"(active: {sorted(active)})"
+            )
         if numeric is not None:
-            self._numeric = set(numeric) & set(active)
+            self._numeric = declared
             self._categorical = {n for n in active if n not in self._numeric}
         else:
-            self._categorical = set(categorical) & set(active)
+            self._categorical = declared
             self._numeric = {n for n in active if n not in self._categorical}
 
         self.target_feature = config.get_optional_string("oryx.input-schema.target-feature")

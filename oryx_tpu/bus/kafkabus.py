@@ -18,6 +18,10 @@ Semantics parity notes:
 
 Integration tests live behind the ``kafka`` pytest marker and need a
 reachable broker (ORYX_KAFKA_BOOTSTRAP env var).
+
+Requires kafka-python >= 1.4 (KafkaAdminClient); offset commit/read
+adapts at runtime to both the pre-2.1 2-arg OffsetAndMetadata / raw-int
+``committed()`` API and the 2.1+ 3-arg / struct-returning one.
 """
 
 from __future__ import annotations
@@ -230,7 +234,9 @@ class KafkaBroker(Broker):
             for p in sorted(parts):
                 committed = c.committed(TopicPartition(topic, p))
                 if committed is not None:
-                    out[p] = int(committed)
+                    # kafka-python < 2.0 returns the raw offset int; newer
+                    # versions return an OffsetAndMetadata struct
+                    out[p] = int(getattr(committed, "offset", committed))
             return out
         finally:
             c.close()
@@ -238,11 +244,20 @@ class KafkaBroker(Broker):
     def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None:
         from kafka.structs import OffsetAndMetadata, TopicPartition
 
+        def _oam(offset: int):
+            # OffsetAndMetadata grew a leader_epoch field (3 args) in
+            # kafka-python 2.1; build 3-arg first, fall back to the 2-arg
+            # (offset, metadata) form of 1.4-2.0
+            try:
+                return OffsetAndMetadata(offset, None, -1)
+            except TypeError:
+                return OffsetAndMetadata(offset, None)
+
         c = self._offset_consumer(group)
         try:
             c.commit(
                 {
-                    TopicPartition(topic, int(p)): OffsetAndMetadata(int(o), None, -1)
+                    TopicPartition(topic, int(p)): _oam(int(o))
                     for p, o in offsets.items()
                 }
             )

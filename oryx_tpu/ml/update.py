@@ -98,6 +98,10 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
         self.previous_model: Element | None = None
         self.previous_model_dir: str | None = None
         self.previous_generation_id: str | None = None
+        # per-phase wall of the winning candidate ({"build": s, "eval": s}),
+        # refreshed each run — read by operators/benchmarks to see where a
+        # generation's wall went without a profiler
+        self.last_phase_seconds: dict[str, float] = {}
 
     # -- abstract app hooks --------------------------------------------------
 
@@ -355,13 +359,16 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 if groups
                 else contextlib.nullcontext()
             )
+            t_build = time.monotonic()
             try:
                 with scope:
                     model = self.build_model(all_train, hyper_parameters, candidate_path)
             except Exception:
                 log.exception("failed to build candidate %d (%s)", i, hyper_parameters)
                 return None
+            build_sec = time.monotonic() - t_build
             pmml_io.write_pmml(model, candidate_path / MODEL_FILE_NAME)
+            t_eval = time.monotonic()
             if not test_data and len(combos) == 1:
                 eval_score = math.nan  # nothing to evaluate against; only candidate wins
             else:
@@ -372,13 +379,17 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 except Exception:
                     log.exception("failed to evaluate candidate %d", i)
                     return None
-            log.info("candidate %d params=%s eval=%s", i, hyper_parameters, eval_score)
-            return eval_score, candidate_path, model, hyper_parameters
+            eval_sec = time.monotonic() - t_eval
+            log.info(
+                "candidate %d params=%s eval=%s (build %.2fs, eval %.2fs)",
+                i, hyper_parameters, eval_score, build_sec, eval_sec,
+            )
+            return eval_score, candidate_path, model, hyper_parameters, build_sec, eval_sec
 
         results = collect_in_parallel(
             len(combos), build_and_eval, parallelism=self.eval_parallelism
         )
-        best: tuple[float, Path, Element, Sequence] | None = None
+        best = None
         for r in results:
             if r is None:
                 continue
@@ -392,5 +403,9 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 best = r
         if best is None:
             return None
-        log.info("best candidate eval=%s", best[0])
-        return best
+        score, path, model, params, build_sec, eval_sec = best
+        self.last_phase_seconds = {"build": build_sec, "eval": eval_sec}
+        log.info(
+            "best candidate eval=%s (build %.2fs, eval %.2fs)", score, build_sec, eval_sec
+        )
+        return score, path, model, params

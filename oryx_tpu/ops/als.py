@@ -127,6 +127,24 @@ def _pow2_at_least(n: int) -> int:
     return 1 << max(0, int(n - 1).bit_length())
 
 
+# wall seconds of the most recent train_als call (replicated path), split
+# by phase ({"init": s, "iterate": s}: bucket packing + factor init vs
+# the compiled sweep run); read by tools/train_benchmark.py for bench.py's
+# per-phase rows. Overwritten per call, never merged.
+last_phase_seconds: dict[str, float] = {}
+
+
+def _pcast_varying(x):
+    """Mark an array device-varying inside shard_map where the running
+    jax has varying types (>= 0.6 ``jax.lax.pcast``); identity on older
+    versions, whose shard_map has no varying-type system and needs no
+    annotation for the scan carries to line up."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (DATA_AXIS,), to="varying")
+    return x
+
+
 def _mask_from_deg(shape, deg):
     """[C, D] f32 validity mask from per-slot degrees: bucket entries
     occupy positions 0..deg-1, so the mask is a comparison against an
@@ -145,6 +163,7 @@ def build_neighbor_buckets(
     min_width: int = 8,
     workspace_elems: int = 1 << 27,
     features: int = 50,
+    stable_shapes: bool = True,
 ) -> list[NeighborBucket]:
     """Group COO entries by row into power-of-two degree buckets.
 
@@ -154,6 +173,17 @@ def build_neighbor_buckets(
     gather workspace stays under ``workspace_elems`` elements, and its
     slot count is padded (rows = -1) to a multiple of chunk*num_shards so
     every device runs the same number of full-width lax.map steps.
+
+    ``stable_shapes`` (default) additionally rounds each bucket's slot
+    count up to a power of two, so the (num_slots, width, chunk) shape
+    signature takes log-many values as the dataset grows: consecutive
+    generations of a growing factorization land on the same signature and
+    reuse the compiled sweep instead of retracing. Pad slots are
+    zero-degree and solve to the zero vector into the sacrificial row, so
+    the padding is numerically free; the pow2 round-up also never more
+    than doubles a bucket, same bound as the granule heuristic it
+    replaces. Falls back to exact-granule padding when num_shards is not
+    a power of two.
     """
     row_idx = np.asarray(row_idx)
     col_idx = np.asarray(col_idx)
@@ -210,14 +240,21 @@ def build_neighbor_buckets(
         chunk = max(1, workspace_elems // (w * max(features, 1)))
         chunk = 1 << (chunk.bit_length() - 1)  # floor to power of two
         chunk = min(chunk, 1 << 16)
-        granule = chunk * num_shards
-        n = pad_to_multiple(len(rows_w), granule)
-        # shrink chunk when padding to the granule would more than double
-        # the bucket (tiny buckets shouldn't pay a 65536-row pad)
-        while chunk > 1 and n >= 2 * max(1, len(rows_w)):
-            chunk //= 2
+        if stable_shapes and num_shards & (num_shards - 1) == 0:
+            # pow2 slot count: a multiple of chunk*num_shards for free
+            # (all three are powers of two and n >= num_shards*chunk')
+            n = _pow2_at_least(max(len(rows_w), num_shards))
+            chunk = min(chunk, n // num_shards)
+        else:
             granule = chunk * num_shards
             n = pad_to_multiple(len(rows_w), granule)
+            # shrink chunk when padding to the granule would more than
+            # double the bucket (tiny buckets shouldn't pay a 65536-row
+            # pad)
+            while chunk > 1 and n >= 2 * max(1, len(rows_w)):
+                chunk //= 2
+                granule = chunk * num_shards
+                n = pad_to_multiple(len(rows_w), granule)
         rows = np.full(n, -1, dtype=np.int32)
         rows[: len(rows_w)] = rows_w
         deg = np.zeros(n, dtype=np.int32)
@@ -326,6 +363,61 @@ class ALSModel:
     y: np.ndarray  # [num_items, k]
 
 
+@functools.lru_cache(maxsize=64)
+def _compiled_run(
+    u_sig: tuple,  # per user-bucket (num_slots, width, chunk)
+    i_sig: tuple,  # per item-bucket (num_slots, width, chunk)
+    users_pad: int,  # factor rows incl. sacrificial/pow2 pad
+    items_pad: int,
+    features: int,
+    iterations: int,
+    implicit: bool,
+    matmul_dtype: Optional[str],
+    mesh: Optional[Mesh],
+):
+    """Persistent compiled ALS run, keyed on the static shape signature.
+
+    Everything shape-like is in the cache key; everything value-like
+    (bucket contents, init factors, lam, alpha) is a traced argument. A
+    warm-started generation whose buckets land on the same pow2 shape
+    signature (the common case under ``stable_shapes``) re-enters the
+    exact jit wrapper and pays zero tracing and zero XLA compilation —
+    previously every ``train_als`` call jitted a fresh closure, so every
+    generation recompiled the whole sweep. ``y_init`` is donated: the
+    warm-start factors' buffer is reused for the fori_loop carry instead
+    of being held live next to it for the whole run.
+    """
+    md = jnp.bfloat16 if matmul_dtype == "bfloat16" else None
+    u_chunks = [c for _, _, c in u_sig]
+    i_chunks = [c for _, _, c in i_sig]
+
+    def run(u_arrs, i_arrs, y_init, lam, alpha):
+        # chunk sizes are static (from the cache key); arrays + the two
+        # hyperparameters are traced, so a lam/alpha sweep is free too
+        u_args = [(*a, c) for a, c in zip(u_arrs, u_chunks)]
+        i_args = [(*a, c) for a, c in zip(i_arrs, i_chunks)]
+        x = jnp.zeros((users_pad, features), dtype=jnp.float32)
+
+        def body(_, carry):
+            x_, y_ = carry
+            x_ = _sweep_buckets(y_, users_pad, u_args, lam, alpha, implicit, md)
+            y_ = _sweep_buckets(x_, items_pad, i_args, lam, alpha, implicit, md)
+            return x_, y_
+
+        return jax.lax.fori_loop(0, iterations, body, (x, y_init))
+
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        return jax.jit(run, out_shardings=(repl, repl), donate_argnums=(2,))
+    return jax.jit(run, donate_argnums=(2,))
+
+
+def compiled_run_cache_info():
+    """(hits, misses, ...) of the persistent ALS run cache — exposed for
+    the recompile-count regression test and ops introspection."""
+    return _compiled_run.cache_info()
+
+
 def train_als(
     user_idx: np.ndarray,
     item_idx: np.ndarray,
@@ -363,7 +455,11 @@ def train_als(
     the Gramian-building matmuls with bf16 operands and f32 accumulation
     — halved HBM traffic and full-rate MXU on TPU; solves stay f32.
     """
+    import time as _time
+
     from oryx_tpu.common import rng as rng_mod
+
+    t_init = _time.perf_counter()
 
     if matmul_dtype not in (None, "float32", "bfloat16"):
         # a typo'd dtype silently training full-f32 would corrupt capacity
@@ -393,9 +489,14 @@ def train_als(
     )
 
     # MLlib-style init: small random normal factors (+1 sacrificial pad
-    # row). Host RNG in natural row order so the sharded-factor mode
-    # (which permutes the same init) is step-identical with this path.
-    y0 = np.zeros((num_items + 1, features), np.float32)
+    # row, then pow2 row padding so the compiled run's shape signature is
+    # stable as the item universe grows; pad rows are zero, enter YtY as
+    # zero, and are sliced off on export — numerically free). Host RNG in
+    # natural row order so the sharded-factor mode (which permutes the
+    # same init) is step-identical with this path.
+    users_pad = _pow2_at_least(num_users + 1)
+    items_pad = _pow2_at_least(num_items + 1)
+    y0 = np.zeros((items_pad, features), np.float32)
     if init_y is not None and np.shape(init_y) == (num_items, features):
         y0[:num_items] = np.asarray(init_y, dtype=np.float32)
     else:
@@ -412,23 +513,12 @@ def train_als(
             (num_items, features)
         ).astype(np.float32)
 
-    u_chunks = [b.chunk for b in u_buckets]
-    i_chunks = [b.chunk for b in i_buckets]
-
-    def run(u_arrs, i_arrs, y_init):
-        # chunk sizes are static (from the closure); only arrays are traced
-        u_args = [(*a, c) for a, c in zip(u_arrs, u_chunks)]
-        i_args = [(*a, c) for a, c in zip(i_arrs, i_chunks)]
-        x = jnp.zeros((num_users + 1, features), dtype=jnp.float32)
-        y = y_init
-
-        def body(_, carry):
-            x_, y_ = carry
-            x_ = _sweep_buckets(y_, num_users + 1, u_args, lam, alpha, implicit, md)
-            y_ = _sweep_buckets(x_, num_items + 1, i_args, lam, alpha, implicit, md)
-            return x_, y_
-
-        return jax.lax.fori_loop(0, iterations, body, (x, y))
+    u_sig = tuple((b.num_slots, b.width, b.chunk) for b in u_buckets)
+    i_sig = tuple((b.num_slots, b.width, b.chunk) for b in i_buckets)
+    run_c = _compiled_run(
+        u_sig, i_sig, users_pad, items_pad, features, iterations, implicit,
+        matmul_dtype, mesh,
+    )
 
     def to_arrs(buckets, row_sh=None, row_sh2=None):
         out = []
@@ -446,6 +536,9 @@ def train_als(
                 )
         return out
 
+    lam_t = jnp.float32(lam)
+    alpha_t = jnp.float32(alpha)
+    t_iter = _time.perf_counter()
     if mesh is not None:
         row_sharded = NamedSharding(mesh, P(DATA_AXIS))
         row_sharded2 = NamedSharding(mesh, P(DATA_AXIS, None))
@@ -453,13 +546,18 @@ def train_als(
         u_arrs = to_arrs(u_buckets, row_sharded, row_sharded2)
         i_arrs = to_arrs(i_buckets, row_sharded, row_sharded2)
         y0 = jax.device_put(np.asarray(y0), repl)
-        run_c = jax.jit(run, out_shardings=(repl, repl))
-        x, y = run_c(u_arrs, i_arrs, y0)
+        x, y = run_c(u_arrs, i_arrs, y0, lam_t, alpha_t)
     else:
-        x, y = jax.jit(run)(to_arrs(u_buckets), to_arrs(i_buckets), y0)
+        x, y = run_c(
+            to_arrs(u_buckets), to_arrs(i_buckets), jnp.asarray(y0), lam_t, alpha_t
+        )
 
     x = np.asarray(x)[:num_users]
     y = np.asarray(y)[:num_items]
+    last_phase_seconds.clear()
+    last_phase_seconds.update(
+        init=t_iter - t_init, iterate=_time.perf_counter() - t_iter
+    )
     return ALSModel(x=x, y=y)
 
 
@@ -563,7 +661,7 @@ def _train_als_sharded(
         v0 = jnp.zeros(ish_c.shape + (other_loc.shape[1],), jnp.float32)
         # the accumulator varies per device (ppermute output feeds it):
         # mark it device-varying so the scan carry types line up
-        v0 = jax.lax.pcast(v0, (DATA_AXIS,), to="varying")
+        v0 = _pcast_varying(v0)
 
         def step(carry, t):
             cur, v = carry
@@ -624,9 +722,7 @@ def _train_als_sharded(
             y_loc = half_sweep(x_loc, i_in, i_chunks)
             return x_loc, y_loc
 
-        x_loc = jax.lax.pcast(
-            jnp.zeros((u_loc, features), jnp.float32), (DATA_AXIS,), to="varying"
-        )
+        x_loc = _pcast_varying(jnp.zeros((u_loc, features), jnp.float32))
         return jax.lax.fori_loop(0, iterations, body, (x_loc, y_loc0))
 
     spec2 = P(DATA_AXIS, None)

@@ -158,6 +158,88 @@ def _sweep_impl(points, centers, *, n_items, k_real, interpret):
     return sums, counts[0], cost[0, 0]
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("iterations", "batch", "n_items", "k_real", "interpret"),
+)
+def _minibatch_fused(
+    points, centers0, key, *, iterations, batch, n_items, k_real, interpret
+):
+    """Mini-batch k-means (Sculley 2010) with every pass through the fused
+    sweep kernel: each iteration gathers a random `batch`-point sample,
+    runs ONE sweep over it (assignment + per-center sums/counts in a
+    single kernel), and moves each touched center toward the batch mean
+    with learning rate 1/v_c (v_c = cumulative assigned count). The
+    whole schedule is one dispatch; a final full-data sweep yields the
+    reported counts/cost."""
+    bpad = max(BLOCK_N, _ceil_to(batch, BLOCK_N))
+    kp = centers0.shape[0]
+
+    def body(_, carry):
+        ctr, v, key = carry
+        key, ks = jax.random.split(key)
+        # gather bpad rows, of which the sweep counts only the first
+        # `batch` (rows past n_items-bounded indices never occur; rows
+        # past `batch` are masked off by the kernel's n_items guard)
+        idx = jax.random.randint(ks, (bpad,), 0, n_items)
+        xb = points[idx]
+        sums, counts, _ = _sweep_impl(
+            xb, ctr, n_items=batch, k_real=k_real, interpret=interpret
+        )
+        v = v + counts
+        ctr = ctr + (sums - counts[:, None] * ctr) / jnp.maximum(v, 1.0)[:, None]
+        return ctr, v, key
+
+    ctr, _, _ = jax.lax.fori_loop(
+        0, iterations, body, (centers0, jnp.zeros(kp, jnp.float32), key)
+    )
+    sums, counts, cost = _sweep_impl(
+        points, ctr, n_items=n_items, k_real=k_real, interpret=interpret
+    )
+    return ctr, counts, cost
+
+
+def minibatch_lloyd_pallas(
+    points,
+    centers0: np.ndarray,
+    iterations: int,
+    batch: int,
+    key,
+    interpret: bool | None = None,
+    n_items: int | None = None,
+) -> tuple[np.ndarray, np.ndarray, float]:
+    """Mini-batch counterpart of lloyd_pallas: same (centers, counts, cost)
+    contract, but iterations touch `batch` sampled points each instead of
+    all n — steady-state cost scales with the batch size. `key` is a JAX
+    PRNG key driving the per-iteration samples."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = centers0.shape[0]
+    kp = max(8, _ceil_to(k, 8))
+    if isinstance(points, jax.Array):
+        if n_items is None:
+            raise ValueError("n_items is required for pre-uploaded points")
+        pts_dev = points
+        n, d = n_items, points.shape[1]
+    else:
+        n = np.asarray(points).shape[0]
+        pts_dev = jnp.asarray(pad_to_block(np.asarray(points, dtype=np.float32)))
+        d = pts_dev.shape[1]
+    ctr = np.zeros((kp, d), np.float32)
+    ctr[:k] = np.asarray(centers0, np.float32)
+    ctr_dev, counts, cost = _minibatch_fused(
+        pts_dev,
+        jnp.asarray(ctr),
+        key,
+        iterations=iterations,
+        batch=min(batch, n),
+        n_items=n,
+        k_real=k,
+        interpret=interpret,
+    )
+    return np.asarray(ctr_dev[:k]), np.asarray(counts[:k]), float(cost)
+
+
 def pad_to_block(points: np.ndarray) -> np.ndarray:
     """Points padded with zero rows to a BLOCK_N multiple (the kernel's
     grid granule)."""

@@ -160,13 +160,15 @@ def test_rdf_regression_update(tmp_path):
         base = 8.0 if color == "red" else 2.0
         size = round(base + float(gen.standard_normal() * 0.3), 3)
         data.append(KeyMessage(None, f"{size},{color},ignored"))
-    # 'label' feature inactive? make it ignored via schema: here it's numeric noise
+    # 'label' is ignored via schema (numeric noise here), so it must NOT
+    # appear in categorical-features: declared type sets name active
+    # features only (InputSchema rejects the rest as likely typos)
     cfg2 = C.get_default().with_overlay(
         """
         oryx {
           input-schema {
             feature-names = ["size", "color", "label"]
-            categorical-features = ["color", "label"]
+            categorical-features = ["color"]
             target-feature = "size"
             ignored-features = ["label"]
           }

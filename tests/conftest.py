@@ -72,3 +72,10 @@ def pytest_configure(config):
         "requantize round-trips, sharded equivalence); fast and tier-1-safe, "
         "select with -m scan",
     )
+    config.addinivalue_line(
+        "markers",
+        "trainers: batch-trainer equivalence suite (RDF histogram modes, "
+        "k-means device init / mini-batch, ALS compiled-run cache + "
+        "zero-recompile regression); fast and tier-1-safe, select with "
+        "-m trainers",
+    )

@@ -17,7 +17,13 @@ Usage:
 
 Env knobs: ORYX_TB_SCALE_NNZ (als-scale ratings, default 2e6),
 ORYX_TB_SCALE_RANK (default 32), ORYX_TB_SCALE_SHARDED (0/1),
-ORYX_TB_RDF_ROWS (default 100000), ORYX_TB_KMEANS_N (default 200000).
+ORYX_TB_RDF_ROWS (default 100000), ORYX_TB_KMEANS_N (default 200000),
+ORYX_TB_KMEANS_MINIBATCH (points per mini-batch Lloyd step; unset =
+full-batch).
+
+Each result carries "phase_sec" {init, iterate, eval}: trainer setup/
+initialization wall vs sweep wall (from the ops module's
+last_phase_seconds) vs the held-out metric wall.
 
 Each benchmark prints one JSON line; `all` prints one per app.
 """
@@ -36,6 +42,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _emit(d: dict) -> None:
     print(json.dumps(d), flush=True)
+
+
+def _phase_sec(ops_mod, eval_sec: float) -> dict:
+    """{"init": s, "iterate": s, "eval": s} for the trainer that just ran:
+    init/iterate come from the ops module's last_phase_seconds, eval is
+    the harness's own held-out metric wall. Feeds bench.py's per-phase
+    rows."""
+    ph = dict(getattr(ops_mod, "last_phase_seconds", {}) or {})
+    ph["eval"] = eval_sec
+    return {p: round(float(s), 3) for p, s in ph.items()}
 
 
 # -- ALS: MovieLens-100K shape ----------------------------------------------
@@ -76,11 +92,13 @@ def bench_als() -> dict:
     )
     wall = time.perf_counter() - t0
     test_rmse = als_ops.rmse(model.x, model.y, u[test], i[test], v[test])
+    eval_sec = time.perf_counter() - t0 - wall
     return {
         "bench": "als-ml100k-shape",
         "config": "943x1682, 100k explicit 1-5, rank 25, lam 0.1, 10 sweeps",
         "wall_sec": round(wall, 2),
         "held_out_rmse": round(test_rmse, 4),
+        "phase_sec": _phase_sec(als_ops, eval_sec),
         "backend": _backend(),
     }
 
@@ -115,7 +133,9 @@ def bench_als_scale() -> dict:
         matmul_dtype=os.environ.get("ORYX_TB_MATMUL_DTYPE"),
     )
     wall = time.perf_counter() - t0
+    t1 = time.perf_counter()
     assert np.isfinite(model.x).all()
+    eval_sec = time.perf_counter() - t1
     max_deg_u = int(np.bincount(u).max())
     return {
         "bench": "als-powerlaw-scale",
@@ -127,6 +147,7 @@ def bench_als_scale() -> dict:
         ),
         "wall_sec": round(wall, 2),
         "ratings_per_sec": int(nnz * 3 / wall),
+        "phase_sec": _phase_sec(als_ops, eval_sec),
         "backend": _backend(),
     }
 
@@ -143,17 +164,28 @@ def bench_kmeans() -> dict:
     centers_true = 6.0 * gen.standard_normal((k, d))
     labels = gen.integers(0, k, n)
     pts = centers_true[labels] + gen.standard_normal((n, d))
+    minibatch = os.environ.get("ORYX_TB_KMEANS_MINIBATCH")
     t0 = time.perf_counter()
-    centers, counts, cost = km.train_kmeans(pts.astype(np.float32), k, iterations=20, seed=3)
+    centers, counts, cost = km.train_kmeans(
+        pts.astype(np.float32), k, iterations=20, seed=3,
+        minibatch_size=int(minibatch) if minibatch else None,
+    )
     wall = time.perf_counter() - t0
+    t1 = time.perf_counter()
     sse = km.sum_squared_error(pts.astype(np.float32), centers)
     sil = km.silhouette_coefficient(pts[:2000].astype(np.float32), centers)
+    eval_sec = time.perf_counter() - t1
     return {
         "bench": "kmeans-gaussians",
-        "config": f"{n}x{d}, k={k}, 20 Lloyd iters, k-means|| init",
+        "config": (
+            f"{n}x{d}, k={k}, 20 "
+            + (f"mini-batch({minibatch}) iters" if minibatch else "Lloyd iters")
+            + ", k-means|| init"
+        ),
         "wall_sec": round(wall, 2),
         "sse_per_point": round(sse / n, 3),
         "silhouette_2k_sample": round(float(sil), 3),
+        "phase_sec": _phase_sec(km, eval_sec),
         "backend": _backend(),
     }
 
@@ -204,13 +236,16 @@ def bench_rdf() -> dict:
         num_trees=20, max_depth=10, impurity="entropy", seed=77,
     )
     wall = time.perf_counter() - t0
+    t1 = time.perf_counter()
     votes = forest_ops.predict_forest_binned(forest, binize(xte))  # [n, 7]
     acc = float((votes.argmax(axis=1) == yte).mean())
+    eval_sec = time.perf_counter() - t1
     return {
         "bench": "rdf-covtype-shape",
         "config": f"{n}x54 (10 numeric + 44 binary), 7 classes, 20 trees depth 10",
         "wall_sec": round(wall, 2),
         "held_out_accuracy": round(acc, 4),
+        "phase_sec": _phase_sec(forest_ops, eval_sec),
         "backend": _backend(),
     }
 
