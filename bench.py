@@ -23,6 +23,17 @@ Metrics (vs_baseline frames):
    Gramians).
 4. speed — sustained events/s through the REAL SpeedLayer over the file
    bus vs the BASELINE.json 100K events/s target.
+5. serving closed-loop — 1..3 concurrent SYNCHRONOUS clients through the
+   real HTTP serving path (ServingLayer + endpoints + micro-batcher):
+   true per-request p50/p99 next to the pipelined-throughput rows, the
+   apples-to-apples view against the reference's 437 qps / 7 ms table.
+
+Noise protocol: every metric is measured over >= 3 trials (cheap
+trainers 5) after the discarded compile pass; rows record the MEDIAN as
+`value` plus `trials` and `spread` ([min, max] in the row's own units).
+A row whose median misses its floor while its best trial clears it is
+flagged `noise-suspect` — the regression call would flip on re-run luck,
+so treat it as noise until a clean round says otherwise.
 
 Resilience: the benchmark body runs in a child process; the parent
 retries transient TPU-backend failures with a fresh process (JAX caches
@@ -36,12 +47,15 @@ stdout tail (the round-4 failure mode).
 Env knobs: ORYX_BENCH_ITEMS/FEATURES/USERS/SECONDS/BATCH/DEPTH/DTYPE
 (serving); ORYX_BENCH_SHAPES=headline|all (serving table coverage);
 ORYX_BENCH_ONLY (comma list of metric names); ORYX_BENCH_ATTEMPTS,
-ORYX_BENCH_INIT_TIMEOUT; ORYX_TB_* (training shapes, see
+ORYX_BENCH_INIT_TIMEOUT; ORYX_BENCH_TRIALS / ORYX_BENCH_TRIALS_CHEAP
+(noise protocol, default 3/5); ORYX_BENCH_CL_USERS/CL_SECONDS
+(closed-loop serving); ORYX_TB_* (training shapes, see
 tools/train_benchmark.py).
 """
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -64,14 +78,18 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # below FATAL — bench prints its own diagnostics.
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
-# r05 CPU-container floors (docs/performance.md, identical configs,
-# re-measured 2026-07-30 under the SAME two-run steady-state protocol as
-# the TPU side — the r02 floors mixed compile-inclusive single runs into
-# the denominators)
-CPU_FLOOR_ALS_WALL = 4.5
-CPU_FLOOR_ALS_SCALE_RPS = 240_000.0
-CPU_FLOOR_KMEANS_WALL = 0.3
-CPU_FLOOR_RDF_WALL = 18.7
+# r06 CPU-container floors (docs/performance.md, identical configs,
+# re-measured 2026-08-06 under the trials/median protocol: one discarded
+# compile pass, then 5 trials (k-means, ALS) / 3 trials (RDF, ALS-scale),
+# median recorded; spreads were within 5% of the median for every floor.
+# Much tighter than the 2026-07-30 r05 constants because the trainers
+# themselves got faster in between (single-dispatch RDF level histograms,
+# ALS solve caching, mini-batch k-means) — against the old floors every
+# row would have read as a spurious speedup.
+CPU_FLOOR_ALS_WALL = 0.42
+CPU_FLOOR_ALS_SCALE_RPS = 575_000.0
+CPU_FLOOR_KMEANS_WALL = 0.39
+CPU_FLOOR_RDF_WALL = 7.2
 SPEED_TARGET_EPS = 100_000.0
 
 # Published /recommend qps at LSH sample-rate 0.3 on a 32-core Xeon
@@ -116,6 +134,48 @@ def _device_info():
     return backend, kind, peaks
 
 
+# Noise protocol: trials per metric. The cheap trainers (k-means, ALS
+# ML-100K) get 5, everything else 3; medians go in `value`.
+_TRIALS = max(1, int(os.environ.get("ORYX_BENCH_TRIALS", 3)))
+_TRIALS_CHEAP = max(1, int(os.environ.get("ORYX_BENCH_TRIALS_CHEAP", 5)))
+
+
+def _trial_fields(vals, ratios) -> dict:
+    """`trials`/`spread` extras (plus the `noise-suspect` flag) for a set
+    of per-trial measurements: spread is [min, max] in the row's own
+    units; the row is noise-suspect when the MEDIAN misses the floor but
+    the best trial clears it — the regression call would flip on re-run
+    luck."""
+    extra = {
+        "trials": len(vals),
+        "spread": [round(float(min(vals)), 3), round(float(max(vals)), 3)],
+    }
+    if statistics.median(ratios) < 1.0 <= max(ratios):
+        extra["noise_suspect"] = True
+    return extra
+
+
+def _wall_row(walls, floor) -> tuple[float, float, dict]:
+    """(median, vs_baseline, extras) for lower-is-better wall rows."""
+    med = statistics.median(walls)
+    return med, floor / max(med, 1e-9), _trial_fields(
+        walls, [floor / max(w, 1e-9) for w in walls]
+    )
+
+
+def _rate_row(rates, floor) -> tuple[float, float, dict]:
+    """(median, vs_baseline, extras) for higher-is-better rate rows."""
+    med = statistics.median(rates)
+    return med, med / floor, _trial_fields(rates, [v / floor for v in rates])
+
+
+def _median_run(runs: list, key: str) -> dict:
+    """The run dict whose `key` is the median trial's — its config,
+    quality, and phase fields then describe a trial that was actually
+    recorded rather than a synthetic average."""
+    return sorted(runs, key=lambda r: r[key])[len(runs) // 2]
+
+
 def _emit(
     metric: str,
     value: float,
@@ -131,6 +191,8 @@ def _emit(
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 2),
     }
+    if extra.pop("noise_suspect", False):
+        row["noise-suspect"] = True
     if "backend" in extra:
         row["backend"] = extra.pop("backend")
     else:
@@ -227,48 +289,63 @@ def bench_serving_shape(
         file=sys.stderr,
     )
 
-    served = 0
-    inflight: deque = deque()
-    latencies: list[float] = []
     # real row spans: the last (or only) group may be short of `group`
     bounds = [
         (lo, min(lo + group, users)) for lo in range(0, max(users, 1), group)
     ]
-    start = time.perf_counter()
-    deadline = start + seconds
-    i = 0
-    while True:
-        now = time.perf_counter()
-        if now < deadline and len(inflight) < depth:
-            lo, hi = bounds[i % len(bounds)]
-            inflight.append((submit(lo, hi), hi - lo, time.perf_counter()))
-            i += 1
-        elif inflight:
-            handle, rows, t_submit = inflight.popleft()
-            handle.result()
-            latencies.append(time.perf_counter() - t_submit)
-            served += rows
-        else:
-            break
-    elapsed = time.perf_counter() - start
-    qps = served / elapsed
+
+    def run_trial() -> tuple[float, float, list[float]]:
+        """(qps, dispatches_per_sec, per-dispatch latencies) for one
+        `seconds`-long pipelined pass."""
+        served = 0
+        inflight: deque = deque()
+        lats: list[float] = []
+        start = time.perf_counter()
+        deadline = start + seconds
+        i = 0
+        while True:
+            now = time.perf_counter()
+            if now < deadline and len(inflight) < depth:
+                lo, hi = bounds[i % len(bounds)]
+                inflight.append((submit(lo, hi), hi - lo, time.perf_counter()))
+                i += 1
+            elif inflight:
+                handle, rows, t_submit = inflight.popleft()
+                handle.result()
+                lats.append(time.perf_counter() - t_submit)
+                served += rows
+            else:
+                break
+        elapsed = time.perf_counter() - start
+        return served / elapsed, i / elapsed, lats
+
+    qps_trials: list[float] = []
+    dispatch_rates: list[float] = []
+    latencies: list[float] = []
+    for _ in range(_TRIALS):
+        q, dr, lats = run_trial()
+        qps_trials.append(q)
+        dispatch_rates.append(dr)
+        latencies.extend(lats)
     lat = np.percentile(np.array(latencies) * 1000, [50, 99]) if latencies else [0, 0]
     # scanned bytes per full-matrix pass: int8 streams the 1 B/feat
     # primary plane (the residual plane is only gathered for the few
     # hundred rescore candidates), bf16 2 B/feat, f32 4 B/feat
     bytes_per_scan = items * features * {"bfloat16": 2, "int8": 1}.get(dtype_name, 4)
-    gbps = i * scans_per_dispatch * bytes_per_scan / elapsed / 1e9
+    gbps = statistics.median(dispatch_rates) * scans_per_dispatch * bytes_per_scan / 1e9
     hbm_util = gbps * 1e9 / peaks[1] if peaks else None
+    published = (features, items) in SERVING_BASELINE_QPS
+    base = SERVING_BASELINE_QPS.get((features, items), 437.0)
+    qps, vs, tf = _rate_row(qps_trials, base)
     detail = (
         f"p50 {lat[0]:.0f} ms / p99 {lat[1]:.0f} ms queued-behind-pipeline at "
-        f"depth {depth}; {i} dispatches x {scans_per_dispatch} fused scans x "
-        f"{scan_batch} queries, {submit_mode}-submit; ~{gbps:.1f} GB/s "
+        f"depth {depth}; {tf['trials']} x {seconds:.0f}s trials, "
+        f"{scans_per_dispatch} fused scans x {scan_batch} queries per dispatch, "
+        f"{submit_mode}-submit; ~{gbps:.1f} GB/s "
         f"effective item-matrix read bandwidth"
         + (f" = {100 * hbm_util:.0f}% of {kind} peak {peaks[1] / 1e9:.0f} GB/s" if peaks else "")
     )
     print(f"bench[serving {features}f x {items}]: {detail}", file=sys.stderr)
-    published = (features, items) in SERVING_BASELINE_QPS
-    base = SERVING_BASELINE_QPS.get((features, items), 437.0)
     frame = (
         f"vs {base:.0f} qps published (LSH 0.3, 32-core Xeon)"
         if published
@@ -280,7 +357,7 @@ def bench_serving_shape(
         f"{dtype_name}, {frame}",
         qps,
         "queries/sec",
-        qps / base,
+        vs,
         order=order,
         detail=detail,
         hbm_util=hbm_util,
@@ -288,6 +365,7 @@ def bench_serving_shape(
         p99_ms=float(lat[1]),
         effective_gbps=float(gbps),
         dispatch_depth=depth,
+        **tf,
     )
     if dtype_name == "int8":
         _bench_serving_recall(items, features, how_many, order)
@@ -311,24 +389,32 @@ def _bench_serving_recall(
     probes = int(os.environ.get("ORYX_BENCH_RECALL_PROBES", 32))
     gen = np.random.default_rng(4321)
     mat = gen.standard_normal((n, features), dtype=np.float32)
-    queries = gen.standard_normal((probes, features), dtype=np.float32)
     up8 = topn_ops.upload(mat, dtype=jnp.int8)
-    hits = 0
-    for r in range(probes):
-        idx, _vals = topn_ops.top_k_scores(up8, queries[r], how_many)
-        truth = mat @ queries[r]
-        kth = np.partition(truth, -how_many)[-how_many]
-        hits += int(np.sum(truth[np.asarray(idx)] >= kth - 1e-5))
-    recall = hits / (probes * how_many)
+    recalls: list[float] = []
+    for t in range(_TRIALS):
+        # fresh probe set per trial: the spread measures probe-sampling
+        # noise on the one quantized matrix actually served
+        qgen = np.random.default_rng(9876 + t)
+        queries = qgen.standard_normal((probes, features), dtype=np.float32)
+        hits = 0
+        for r in range(probes):
+            idx, _vals = topn_ops.top_k_scores(up8, queries[r], how_many)
+            truth = mat @ queries[r]
+            kth = np.partition(truth, -how_many)[-how_many]
+            hits += int(np.sum(truth[np.asarray(idx)] >= kth - 1e-5))
+        recalls.append(hits / (probes * how_many))
+    recall, vs, tf = _rate_row(recalls, 0.99)
     label_m = f"{n // 1_000_000}M" if n >= 1_000_000 else f"{n // 1000}K"
     _emit(
         f"ALS /recommend top-{how_many} int8 recall vs exact float32, "
         f"{features}f x {label_m} items, vs 0.99 floor",
         recall,
         "recall@10",
-        recall / 0.99,
+        vs,
         order=order + 1,
-        detail=f"{probes} probe queries, tie-tolerant at 1e-5",
+        detail=f"{probes} probe queries x {tf['trials']} probe sets, "
+        "tie-tolerant at 1e-5",
+        **tf,
     )
 
 
@@ -360,22 +446,30 @@ def bench_serving_large() -> None:
         bench_serving_shape(items, features, order=order, seconds=6.0)
 
 
-def _emit_phases(name: str, r: dict, order: int) -> None:
+def _emit_phases(name: str, runs: list, order: int) -> None:
     """Per-phase wall row next to a trainer's headline: value = iterate
-    (the sweep itself), vs_baseline = iterate's share of the phased wall;
-    init/eval ride along as extra fields. Makes dispatch overhead vs real
-    iteration visible without a profiler."""
-    ph = r.get("phase_sec") or {}
-    if not ph:
+    (the sweep itself) from the median-iterate trial, vs_baseline =
+    iterate's share of that trial's phased wall; pack/init/eval ride
+    along as extra fields. Makes host packing and dispatch overhead vs
+    real iteration visible without a profiler."""
+    phs = [r.get("phase_sec") or {} for r in runs]
+    phs = [p for p in phs if p]
+    if not phs:
         return
+    phs.sort(key=lambda p: p.get("iterate", 0.0))
+    ph = phs[len(phs) // 2]
     total = sum(ph.values())
+    iters = [p.get("iterate", 0.0) for p in phs]
     _emit(
-        f"{name} per-phase wall, iterate sec (share of init+iterate+eval)",
+        f"{name} per-phase wall, iterate sec (share of pack+init+iterate+eval)",
         ph.get("iterate", 0.0),
         "sec",
         ph.get("iterate", 0.0) / total if total > 0 else 0.0,
         order=order,
         detail=json.dumps(ph),
+        trials=len(phs),
+        spread=[round(min(iters), 3), round(max(iters), 3)],
+        pack_sec=ph.get("pack"),
         init_sec=ph.get("init"),
         iterate_sec=ph.get("iterate"),
         eval_sec=ph.get("eval"),
@@ -386,39 +480,45 @@ def bench_kmeans() -> None:
     from tools import train_benchmark as tb
 
     tb.bench_kmeans()  # compile pass — generations reuse compiled programs
-    r = tb.bench_kmeans()
+    runs = [tb.bench_kmeans() for _ in range(_TRIALS_CHEAP)]
+    r = _median_run(runs, "wall_sec")
+    wall, vs, tf = _wall_row([t["wall_sec"] for t in runs], CPU_FLOOR_KMEANS_WALL)
     _, _, peaks = _device_info()
     n, d, k, iters = int(os.environ.get("ORYX_TB_KMEANS_N", 200_000)), 20, 10, 20
     flops = 3.0 * n * d * k * iters  # dist matmul 2ndk + argmin/update ~ndk
-    mfu = flops / max(r["wall_sec"], 1e-9) / peaks[0] if peaks else None
+    mfu = flops / max(wall, 1e-9) / peaks[0] if peaks else None
     _emit(
-        f"k-means train wall, steady-state, {r['config']}, "
-        f"vs {CPU_FLOOR_KMEANS_WALL}s CPU floor",
-        r["wall_sec"],
+        f"k-means train wall, median of {tf['trials']} steady-state trials, "
+        f"{r['config']}, vs {CPU_FLOOR_KMEANS_WALL}s CPU floor",
+        wall,
         "sec",
-        CPU_FLOOR_KMEANS_WALL / max(r["wall_sec"], 1e-9),
+        vs,
         order=10,
         detail=f"sse/pt {r['sse_per_point']}, silhouette {r['silhouette_2k_sample']}",
         mfu=mfu,
+        **tf,
     )
-    _emit_phases("k-means", r, order=30)
+    _emit_phases("k-means", runs, order=30)
 
 
 def bench_als() -> None:
     from tools import train_benchmark as tb
 
     tb.bench_als()  # compile pass
-    r = tb.bench_als()
+    runs = [tb.bench_als() for _ in range(_TRIALS_CHEAP)]
+    r = _median_run(runs, "wall_sec")
+    wall, vs, tf = _wall_row([t["wall_sec"] for t in runs], CPU_FLOOR_ALS_WALL)
     _emit(
-        f"ALS train wall, steady-state, ML-100K shape rank 25, "
-        f"vs {CPU_FLOOR_ALS_WALL}s CPU floor",
-        r["wall_sec"],
+        f"ALS train wall, median of {tf['trials']} steady-state trials, "
+        f"ML-100K shape rank 25, vs {CPU_FLOOR_ALS_WALL}s CPU floor",
+        wall,
         "sec",
-        CPU_FLOOR_ALS_WALL / max(r["wall_sec"], 1e-9),
+        vs,
         order=12,
         detail=f"{r['config']}; held-out RMSE {r['held_out_rmse']}",
+        **tf,
     )
-    _emit_phases("ALS", r, order=32)
+    _emit_phases("ALS", runs, order=32)
 
 
 def _als_scale_mfu(r: dict) -> float | None:
@@ -439,36 +539,50 @@ def bench_als_scale() -> None:
 
     # the baseline row must be f32 even if the experiment knob is exported
     prev = os.environ.pop("ORYX_TB_MATMUL_DTYPE", None)
-    r = tb.bench_als_scale()
+    runs = [tb.bench_als_scale() for _ in range(_TRIALS)]
+    r = _median_run(runs, "ratings_per_sec")
+    rate, vs, tf = _rate_row(
+        [t["ratings_per_sec"] for t in runs], CPU_FLOOR_ALS_SCALE_RPS
+    )
     _emit(
-        "ALS implicit training throughput, f32 Gramians, "
+        f"ALS implicit training throughput, f32 Gramians, median of "
+        f"{tf['trials']} trials, "
         f"vs {CPU_FLOOR_ALS_SCALE_RPS / 1000:.0f}k ratings/s CPU floor",
-        r["ratings_per_sec"],
+        rate,
         "ratings/sec",
-        r["ratings_per_sec"] / CPU_FLOOR_ALS_SCALE_RPS,
+        vs,
         order=20,
         detail=r["config"],
         mfu=_als_scale_mfu(r),
+        **tf,
     )
+    # the pack phase dominates host-side cost at this shape — surface it
+    _emit_phases("ALS implicit scale f32", runs, order=33)
     # the bf16-Gramian variant (oryx.batch.compute.matmul-dtype=bfloat16):
     # half the HBM traffic, full-rate MXU; same CPU-floor denominator
     os.environ["ORYX_TB_MATMUL_DTYPE"] = "bfloat16"
     try:
-        rb = tb.bench_als_scale()
+        runs_b = [tb.bench_als_scale() for _ in range(_TRIALS)]
     finally:
         if prev is None:
             os.environ.pop("ORYX_TB_MATMUL_DTYPE", None)
         else:
             os.environ["ORYX_TB_MATMUL_DTYPE"] = prev
+    rb = _median_run(runs_b, "ratings_per_sec")
+    rate_b, vs_b, tf_b = _rate_row(
+        [t["ratings_per_sec"] for t in runs_b], CPU_FLOOR_ALS_SCALE_RPS
+    )
     _emit(
-        "ALS implicit training throughput, bf16 Gramians, "
+        f"ALS implicit training throughput, bf16 Gramians, median of "
+        f"{tf_b['trials']} trials, "
         f"vs {CPU_FLOOR_ALS_SCALE_RPS / 1000:.0f}k ratings/s CPU floor",
-        rb["ratings_per_sec"],
+        rate_b,
         "ratings/sec",
-        rb["ratings_per_sec"] / CPU_FLOOR_ALS_SCALE_RPS,
+        vs_b,
         order=21,
         detail=rb["config"],
         mfu=_als_scale_mfu(rb),
+        **tf_b,
     )
     backend, _, peaks = _device_info()
     if backend == "tpu":
@@ -485,24 +599,29 @@ def bench_als_scale() -> None:
             ORYX_TB_MATMUL_DTYPE="bfloat16",
         )
         try:
-            rt = tb.bench_als_scale()
+            runs_t = [tb.bench_als_scale() for _ in range(_TRIALS)]
         finally:
             for k, v in saved.items():
                 if v is None:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+        rt = _median_run(runs_t, "ratings_per_sec")
+        rate_t, vs_t, tf_t = _rate_row(
+            [t["ratings_per_sec"] for t in runs_t], 106_000.0
+        )
         flops = 4.0 * 20e6 * 64 * 64 * 3
         _emit(
             "ALS implicit training throughput, 20M ratings rank 64 bf16, "
-            "vs 106k ratings/s (this build's 8-virtual-CPU sharded run of "
-            "the same shape)",
-            rt["ratings_per_sec"],
+            f"median of {tf_t['trials']} trials, vs 106k ratings/s (this "
+            "build's 8-virtual-CPU sharded run of the same shape)",
+            rate_t,
             "ratings/sec",
-            rt["ratings_per_sec"] / 106_000.0,
+            vs_t,
             order=22,
             detail=rt["config"],
             mfu=flops / max(rt["wall_sec"], 1e-9) / peaks[0] if peaks else None,
+            **tf_t,
         )
 
 
@@ -510,58 +629,190 @@ def bench_rdf() -> None:
     from tools import train_benchmark as tb
 
     tb.bench_rdf()  # compile pass — generations reuse compiled programs
-    r = tb.bench_rdf()
+    runs = [tb.bench_rdf() for _ in range(_TRIALS)]
+    r = _median_run(runs, "wall_sec")
+    wall, vs, tf = _wall_row([t["wall_sec"] for t in runs], CPU_FLOOR_RDF_WALL)
     _emit(
-        f"RDF train wall, steady-state, covtype shape 20 trees depth 10, "
-        f"vs {CPU_FLOOR_RDF_WALL}s CPU floor",
-        r["wall_sec"],
+        f"RDF train wall, median of {tf['trials']} steady-state trials, "
+        f"covtype shape 20 trees depth 10, vs {CPU_FLOOR_RDF_WALL}s CPU floor",
+        wall,
         "sec",
-        CPU_FLOOR_RDF_WALL / max(r["wall_sec"], 1e-9),
+        vs,
         order=11,
         detail=f"{r['config']}; held-out accuracy {r['held_out_accuracy']}",
+        **tf,
     )
-    _emit_phases("RDF", r, order=31)
+    _emit_phases("RDF", runs, order=31)
 
 
 def bench_speed() -> None:
     """Run the real-SpeedLayer bench as a subprocess (own process: it
-    spins threads and a file bus) and relay its metric."""
-    proc = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
-            "--seconds",
-            "30",
-            "--prefill",
-            "1600000",
-            "--batch-events",
-            "400000",
-        ],
-        capture_output=True,
-        text=True,
-        timeout=400,
-        env=dict(os.environ),
-    )
-    sys.stderr.write(proc.stderr[-1500:])
-    line = None
-    for ln in proc.stdout.splitlines():
-        if ln.startswith("{") and '"metric"' in ln:
-            line = ln
-    if proc.returncode != 0 or line is None:
-        raise RuntimeError(f"speed bench failed rc={proc.returncode}")
-    d = json.loads(line)
+    spins threads and a file bus) and relay the median of its metric
+    over the trial protocol."""
+
+    def one_trial() -> dict:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
+                "--seconds",
+                "30",
+                "--prefill",
+                "1600000",
+                "--batch-events",
+                "400000",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=400,
+            env=dict(os.environ),
+        )
+        sys.stderr.write(proc.stderr[-1500:])
+        line = None
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("{") and '"metric"' in ln:
+                line = ln
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(f"speed bench failed rc={proc.returncode}")
+        return json.loads(line)
+
+    runs = [one_trial() for _ in range(_TRIALS)]
+    d = _median_run(runs, "value")
+    rate, vs, tf = _rate_row([t["value"] for t in runs], SPEED_TARGET_EPS)
     _emit(
-        "speed layer sustained fold-in over file bus, "
-        f"vs 100K events/s BASELINE target ({os.cpu_count()}-core host)",
-        d["value"],
+        f"speed layer sustained fold-in over file bus, median of "
+        f"{tf['trials']} runs, vs 100K events/s BASELINE target "
+        f"({os.cpu_count()}-core host)",
+        rate,
         "events/sec",
-        d["value"] / SPEED_TARGET_EPS,
+        vs,
         order=30,
         detail=d["metric"],
         # the speed layer is a host pipeline (bus I/O + parse + fold-in);
         # label it as such rather than stamping this process's jax backend
         backend=d.get("backend", f"host/{os.cpu_count()}-core"),
+        **tf,
     )
+
+
+def bench_serving_closed_loop() -> None:
+    """Closed-loop /recommend latency through the REAL serving stack:
+    ServingLayer HTTP server + ALS endpoints + request micro-batcher +
+    device scan, driven by 1..3 SYNCHRONOUS clients (each waits for its
+    response before sending the next request). Unlike the pipelined rows
+    above — which measure device throughput with a deep submit queue —
+    these are true per-request p50/p99 latencies, the number a single
+    caller experiences, directly comparable to the reference's published
+    437 qps / ~7 ms table (LSH 0.3, 32-core Xeon)."""
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    items = int(os.environ.get("ORYX_BENCH_ITEMS", 1_000_000))
+    features = int(os.environ.get("ORYX_BENCH_FEATURES", 50))
+    users = int(os.environ.get("ORYX_BENCH_CL_USERS", 10_000))
+    seconds = float(os.environ.get("ORYX_BENCH_CL_SECONDS", 6.0))
+    backend, _, _ = _device_info()
+    if backend != "tpu":
+        # each request exact-scans the whole item matrix; on a CPU
+        # container keep the model small enough that a trial finishes
+        items = min(items, int(os.environ.get("ORYX_BENCH_CL_CPU_ITEMS", 200_000)))
+        seconds = min(seconds, 4.0)
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "BenchClosedLoop"
+          input-topic.broker = "inproc://benchcl"
+          update-topic.broker = "inproc://benchcl"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+    t0 = time.perf_counter()
+    model = build_model(users, items, features)
+    layer = ServingLayer(cfg)
+    layer.start()
+    layer.model_manager.model = model
+    base = f"http://127.0.0.1:{layer.port}"
+    label_m = f"{items // 1_000_000}M" if items >= 1_000_000 else f"{items // 1000}K"
+    try:
+        # warm request uploads Y to device and compiles the scan kernel
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+        print(
+            f"bench[serving-closed]: model+layer+warm in "
+            f"{time.perf_counter() - t0:.1f}s ({users}u x {items}i x {features}f)",
+            file=sys.stderr,
+        )
+        for clients, order in ((1, 94), (3, 95)):
+            qps_trials: list[float] = []
+            lats: list[float] = []
+            errors: list[float] = []
+            for _ in range(_TRIALS):
+                trial_lats: list[float] = []
+                stop = threading.Event()
+                deadline = time.perf_counter() + seconds
+                threads = [
+                    threading.Thread(
+                        target=worker,
+                        args=(base, "/recommend/u%d", users, deadline,
+                              trial_lats, errors, stop),
+                        daemon=True,
+                    )
+                    for _ in range(clients)
+                ]
+                t1 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                elapsed = time.perf_counter() - t1
+                qps_trials.append(len(trial_lats) / max(elapsed, 1e-9))
+                lats.extend(trial_lats)
+            if not lats:
+                raise RuntimeError(
+                    f"closed-loop serving: no successful requests "
+                    f"({len(errors)} errors)"
+                )
+            p50, p99 = np.percentile(np.array(lats) * 1000, [50, 99])
+            qps, vs, tf = _rate_row(qps_trials, 437.0)
+            detail = (
+                f"true per-request HTTP latency: p50 {p50:.1f} ms / "
+                f"p99 {p99:.1f} ms over {len(lats)} requests "
+                f"({len(errors)} errors), {tf['trials']} x {seconds:.0f}s "
+                f"trials; reference table: 437 qps / ~7 ms at LSH 0.3"
+            )
+            print(
+                f"bench[serving-closed {clients} client(s)]: {detail}",
+                file=sys.stderr,
+            )
+            _emit(
+                f"ALS /recommend closed-loop, {clients} sync client(s), "
+                f"{features}f x {label_m} items, vs 437 qps / 7 ms p50 "
+                f"published (LSH 0.3, 32-core Xeon)",
+                qps,
+                "queries/sec",
+                vs,
+                order=order,
+                detail=detail,
+                p50_ms=float(p50),
+                p99_ms=float(p99),
+                clients=clients,
+                **tf,
+            )
+    finally:
+        layer.close()
 
 
 BENCHES = [
@@ -571,6 +822,7 @@ BENCHES = [
     ("speed", bench_speed),
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
+    ("serving-closed", bench_serving_closed_loop),
     ("serving-250", bench_serving_250),
     ("serving", bench_serving),
 ]
@@ -670,8 +922,14 @@ def _print_summary(json_lines: list[str]) -> None:
     print("=== BENCH SUMMARY ===", flush=True)
     for r in final:
         # keep summary rows compact — the driver records a bounded tail;
-        # the full rows (latencies, detail) live in tools/bench_evidence.txt
-        for k in ("order", "p50_ms", "p99_ms"):
+        # the full rows (latencies, detail) live in tools/bench_evidence.txt.
+        # Closed-loop rows keep p50/p99: true latency is their whole point.
+        drop = (
+            ("order",)
+            if "closed-loop" in r.get("metric", "")
+            else ("order", "p50_ms", "p99_ms")
+        )
+        for k in drop:
             r.pop(k, None)
         print(json.dumps(r), flush=True)
     sys.stdout.flush()
@@ -753,9 +1011,11 @@ def main() -> None:
     attempts = int(os.environ.get("ORYX_BENCH_ATTEMPTS", 3))
     init_timeout = float(os.environ.get("ORYX_BENCH_INIT_TIMEOUT", 150))
     # generous: metrics stream as they complete, so a watchdog kill only
-    # costs whatever is still running (r5 adds the 5M/20M serving shapes
-    # and the 20M-rating scale row — first-compile-heavy on a cold cache)
-    child_timeout = init_timeout + 2700
+    # costs whatever is still running (r5 added the 5M/20M serving shapes
+    # and the 20M-rating scale row — first-compile-heavy on a cold cache;
+    # r6's >=3-trials-per-metric noise protocol multiplies steady-state
+    # measurement time, though compiles still happen once)
+    child_timeout = init_timeout + 4500
 
     # attempts=1 is the documented fail-fast-TPU contract: no probe-driven
     # CPU fallback there either

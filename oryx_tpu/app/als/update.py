@@ -57,6 +57,18 @@ class ALSUpdate(MLUpdate):
         self.decay_zero_threshold = config.get_float("oryx.als.decay.zero-threshold")
         if not 0.0 < self.decay_factor <= 1.0:
             raise ValueError("decay factor must be in (0,1]")
+        # Host-side neighbor packing knobs (oryx.ml.als.packing.*): worker
+        # count "auto"|N, streamed-chunk size, and the shared-memory arena
+        # budget for the multi-process path (ops/packing.py). Validated at
+        # startup so a typo'd worker count fails the layer, not generation 40.
+        workers = config.get("oryx.ml.als.packing.workers", "auto")
+        if workers != "auto":
+            workers = int(workers)
+        self.packing = als_ops.PackingOptions(
+            workers=workers,
+            chunk_rows=config.get_int("oryx.ml.als.packing.chunk-rows"),
+            shm_budget_mb=config.get_int("oryx.ml.als.packing.shared-mem-budget-mb"),
+        )
         self._config = config
 
     def get_hyper_parameter_values(self) -> list[hp.HyperParamValues]:
@@ -121,6 +133,7 @@ class ALSUpdate(MLUpdate):
             and bool(self._config.get("oryx.batch.compute.shard-factors", False)),
             matmul_dtype=self._config.get("oryx.batch.compute.matmul-dtype", None),
             init_y=self._warm_start_init_y(rm, features),
+            packing=self.packing,
         )
         # dispatch hygiene: a warm generation whose degree buckets land on
         # the same pow2 shape signature reuses the compiled sweep (hits

@@ -245,9 +245,9 @@ DEFAULT_CONNECT_TIMEOUT = 30.0
 
 
 class _Conn:
-    """One socket. The broker serializes requests (strict request/response
-    protocol) and owns reconnection, so this class is deliberately dumb:
-    callers must hold the broker's I/O lock."""
+    """One socket. The broker serializes requests per channel (strict
+    request/response protocol) and owns reconnection, so this class is
+    deliberately dumb: callers must hold the owning channel's lock."""
 
     def __init__(self, host: str, port: int, connect_timeout: float) -> None:
         self._host, self._port = host, port
@@ -323,16 +323,27 @@ class _NetProducer(TopicProducer):
 
 
 class _NetConsumer(TopicConsumer):
-    """Client-side consumer handle. Remembers how it was opened and its
-    last server-reported positions so the broker can transparently reopen
-    and re-seek it after a reconnect (server-side consumers die with the
-    connection)."""
+    """Client-side consumer handle over its own dedicated connection.
+
+    A server-side poll blocks its connection for up to the poll timeout;
+    on a shared socket that block would also stall every producer and
+    admin call made through the same broker handle. Each consumer
+    therefore owns a private socket and lock — its blocking polls never
+    serialize against the broker's shared channel or other consumers.
+
+    The handle remembers how it was opened and its last server-reported
+    positions so the broker can transparently reopen and re-seek it after
+    a reconnect of its own channel (server-side consumer sessions die
+    with their connection)."""
 
     def __init__(
-        self, broker: "NetBroker", cid: int, topic: str, group: str | None, from_beginning: bool
+        self, broker: "NetBroker", conn: _Conn, topic: str, group: str | None,
+        from_beginning: bool,
     ) -> None:
         self._broker = broker
-        self._cid = cid
+        self._conn = conn
+        self._lock = threading.RLock()
+        self._cid = -1  # assigned by the first (re)open of the channel
         self._topic = topic
         self._group = group
         self._from_beginning = from_beginning
@@ -386,12 +397,14 @@ class _NetConsumer(TopicConsumer):
     def close(self) -> None:
         if not self._closed:
             self._closed = True
-            self._broker._forget_consumer(self)
-            try:
-                # best-effort, no reconnect dance just to close
-                self._broker._conn.call({"op": "consumer_close", "cid": self._cid})
-            except (RuntimeError, ConnectionError, OSError):
-                pass
+            with self._lock:
+                try:
+                    # best-effort, no reconnect dance just to close
+                    if self._conn.connected:
+                        self._conn.call({"op": "consumer_close", "cid": self._cid})
+                except (RuntimeError, ConnectionError, OSError):
+                    pass
+                self._conn.close()
 
     def closed(self) -> bool:
         return self._closed
@@ -399,14 +412,18 @@ class _NetConsumer(TopicConsumer):
 
 class NetBroker(Broker):
     """Broker SPI over a ``tcp://host:port`` bus server, with
-    reconnect-with-backoff.
+    reconnect-with-backoff and a dedicated connection per consumer.
 
-    The connection is opened lazily and re-opened on demand: any call that
-    hits a connection error retries under `retry` (a RetryPolicy), and a
-    successful reconnect first reopens every live consumer server-side and
-    seeks it to its last known positions, so consumption resumes
-    mid-stream across a bus-server restart. Produce retries are
-    at-least-once (a request that died in flight may have landed)."""
+    Producers and admin ops share one channel (socket + lock); every
+    consumer owns its own, so a consumer blocked in a server-side poll
+    (up to the poll timeout) never stalls produces or other consumers on
+    the same broker handle. Connections are opened lazily and re-opened
+    on demand: any call that hits a connection error retries under
+    `retry` (a RetryPolicy), and a successful reconnect of a consumer's
+    channel reopens that consumer server-side and seeks it to its last
+    known positions, so consumption resumes mid-stream across a
+    bus-server restart. Produce retries are at-least-once (a request that
+    died in flight may have landed)."""
 
     def __init__(
         self,
@@ -418,12 +435,12 @@ class NetBroker(Broker):
         from oryx_tpu.common.resilience import RetryPolicy
 
         self._host, self._port = host, port
+        self._connect_timeout = connect_timeout
         self._conn = _Conn(host, port, connect_timeout)
         self._retry = retry or RetryPolicy(
             max_attempts=5, initial_backoff=0.1, max_backoff=5.0
         )
         self._io_lock = threading.RLock()
-        self._consumers: list[_NetConsumer] = []
 
     @staticmethod
     def options_from_query(query: str) -> dict:
@@ -454,44 +471,54 @@ class NetBroker(Broker):
 
     # -- connection management ----------------------------------------------
 
-    def _reconnect(self) -> None:
-        """Caller holds _io_lock. Connect, then restore server-side
-        consumer sessions for every live client handle."""
-        self._conn.connect()
-        for c in self._consumers:
-            resp, _ = self._conn.call(
+    def _open_consumer_session(self, c: _NetConsumer) -> None:
+        """Caller holds the consumer's lock and its connection is up.
+        (Re)open the server-side session on the consumer's own channel
+        and seek it back to its last known positions."""
+        resp, _ = c._conn.call(
+            {
+                "op": "consumer_open",
+                "topic": c._topic,
+                "group": c._group,
+                "from_beginning": c._from_beginning,
+            }
+        )
+        c._cid = int(resp["cid"])
+        if c._last_positions:
+            c._conn.call(
                 {
-                    "op": "consumer_open",
-                    "topic": c._topic,
-                    "group": c._group,
-                    "from_beginning": c._from_beginning,
+                    "op": "seek",
+                    "cid": c._cid,
+                    "positions": {str(k): int(v) for k, v in c._last_positions.items()},
                 }
             )
-            c._cid = int(resp["cid"])
-            if c._last_positions:
-                self._conn.call(
-                    {
-                        "op": "seek",
-                        "cid": c._cid,
-                        "positions": {str(k): int(v) for k, v in c._last_positions.items()},
-                    }
-                )
 
     def _invoke(self, header_fn, payload: bytes = b"", consumer: _NetConsumer | None = None):
         """Run one request, transparently (re)connecting with backoff.
-        `header_fn` is re-evaluated per attempt so consumer ops pick up the
-        cid assigned by a reconnect's reopen."""
+
+        Routed over the consumer's dedicated channel when `consumer` is
+        given (reconnects there also reopen that one server-side
+        session), else over the shared producer/admin channel.
+        `header_fn` is re-evaluated per attempt so consumer ops pick up
+        the cid assigned by a reconnect's reopen; ``header_fn=None`` just
+        ensures the channel is connected (used for the eager first open)."""
+        conn = self._conn if consumer is None else consumer._conn
+        lock = self._io_lock if consumer is None else consumer._lock
         failures = 0
-        with self._io_lock:
+        with lock:
             while True:
                 try:
-                    if not self._conn.connected:
-                        self._reconnect()
+                    if not conn.connected:
+                        conn.connect()
+                        if consumer is not None:
+                            self._open_consumer_session(consumer)
                         if failures:
                             metrics.registry.counter("bus.net.reconnects").inc()
-                    return self._conn.call(header_fn(), payload)
+                    if header_fn is None:
+                        return None, b""
+                    return conn.call(header_fn(), payload)
                 except (ConnectionError, OSError) as e:
-                    self._conn.drop()
+                    conn.drop()
                     if consumer is not None and consumer.closed():
                         raise
                     failures += 1
@@ -507,11 +534,6 @@ class NetBroker(Broker):
                         self._host, self._port, e, failures, delay,
                     )
                     time.sleep(delay)
-
-    def _forget_consumer(self, consumer: _NetConsumer) -> None:
-        with self._io_lock:
-            if consumer in self._consumers:
-                self._consumers.remove(consumer)
 
     # -- Broker SPI ----------------------------------------------------------
 
@@ -533,18 +555,15 @@ class NetBroker(Broker):
     def consumer(
         self, topic: str, group: str | None = None, from_beginning: bool = False
     ) -> TopicConsumer:
-        with self._io_lock:
-            resp, _ = self._invoke(
-                lambda: {
-                    "op": "consumer_open",
-                    "topic": topic,
-                    "group": group,
-                    "from_beginning": from_beginning,
-                }
-            )
-            c = _NetConsumer(self, int(resp["cid"]), topic, group, from_beginning)
-            self._consumers.append(c)
-            return c
+        c = _NetConsumer(
+            self,
+            _Conn(self._host, self._port, self._connect_timeout),
+            topic, group, from_beginning,
+        )
+        # open the dedicated channel + server session eagerly so a bad
+        # topic/server fails here (with retry/backoff), not at first poll
+        self._invoke(None, consumer=c)
+        return c
 
     def get_offsets(self, group: str, topic: str) -> dict[int, int]:
         resp, _ = self._invoke(lambda: {"op": "get_offsets", "group": group, "topic": topic})

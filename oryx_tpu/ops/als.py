@@ -98,39 +98,22 @@ def build_neighbor_block(
     return NeighborBlock(idx, val, mask)
 
 
-@dataclass
-class NeighborBucket:
-    """Rows whose degree rounds up to the same power-of-two width.
-
-    ``rows`` holds global row ids per slot (``-1`` for pad slots added to
-    make the slot count divisible by the sharding/chunking granule)."""
-
-    rows: np.ndarray  # [n] int32 global row ids, -1 = pad slot
-    idx: np.ndarray  # [n, D] int32 col indices into the other side
-    val: np.ndarray  # [n, D] float32 rating values (0 where padded)
-    deg: np.ndarray  # [n] int32 real entries per slot (0 for pad slots);
-    #   entries fill positions 0..deg-1, so the [n, D] validity mask is
-    #   exactly (iota < deg) and never needs to be materialized — a third
-    #   of the bucket bytes on host AND device at scale
-    chunk: int  # rows per lax.map step (n is a multiple of chunk*shards)
-
-    @property
-    def width(self) -> int:
-        return self.idx.shape[1]
-
-    @property
-    def num_slots(self) -> int:
-        return self.idx.shape[0]
-
-
-def _pow2_at_least(n: int) -> int:
-    return 1 << max(0, int(n - 1).bit_length())
-
+# NeighborBucket and both packing implementations live in ops/packing.py
+# (numpy + stdlib only, so forked packing workers never import jax);
+# re-exported here for API compatibility.
+from oryx_tpu.ops.packing import (  # noqa: E402  (re-export)
+    NeighborBucket,
+    PackingOptions,
+    _pow2_at_least,
+    build_neighbor_buckets_reference,
+    pack_neighbor_buckets,
+)
 
 # wall seconds of the most recent train_als call (replicated path), split
-# by phase ({"init": s, "iterate": s}: bucket packing + factor init vs
-# the compiled sweep run); read by tools/train_benchmark.py for bench.py's
-# per-phase rows. Overwritten per call, never merged.
+# by phase ({"pack": s, "init": s, "iterate": s}: neighbor-bucket packing
+# vs the rest of setup (factor init, device_put) vs the compiled sweep
+# run); read by tools/train_benchmark.py for bench.py's per-phase rows.
+# Overwritten per call, never merged.
 last_phase_seconds: dict[str, float] = {}
 
 
@@ -164,6 +147,7 @@ def build_neighbor_buckets(
     workspace_elems: int = 1 << 27,
     features: int = 50,
     stable_shapes: bool = True,
+    options: PackingOptions | None = None,
 ) -> list[NeighborBucket]:
     """Group COO entries by row into power-of-two degree buckets.
 
@@ -184,96 +168,17 @@ def build_neighbor_buckets(
     than doubles a bucket, same bound as the granule heuristic it
     replaces. Falls back to exact-granule padding when num_shards is not
     a power of two.
+
+    Delegates to the sharded engine in :mod:`oryx_tpu.ops.packing`
+    (``options`` selects worker count / chunking / shm budget), whose
+    layout is bit-identical to :func:`build_neighbor_buckets_reference`
+    for every option value — callers and the compile cache never see
+    which path packed a bucket.
     """
-    row_idx = np.asarray(row_idx)
-    col_idx = np.asarray(col_idx)
-    values = np.asarray(values)
-    nnz = len(row_idx)
-    if not num_rows or not nnz:
-        return []
-    counts = np.bincount(row_idx, minlength=num_rows)
-
-    # bucket width per row: next power of two >= degree (min min_width);
-    # log2 of an exact power of two is exact in float64, so ceil is safe
-    safe = np.maximum(counts, 1)
-    widths = np.maximum(
-        min_width, (2 ** np.ceil(np.log2(safe)).astype(np.int64)).astype(np.int64)
+    return pack_neighbor_buckets(
+        row_idx, col_idx, values, num_rows, num_shards, min_width,
+        workspace_elems, features, stable_shapes, options,
     )
-    del safe
-
-    # ONE sort by (bucket width, row): every bucket becomes a contiguous
-    # slice of the sorted arrays and all later temporaries are
-    # bucket-sized, not nnz-sized — this is what bounds packing RSS at
-    # the 1B-rating scale (the old per-bucket path re-materialized
-    # multiple nnz-length masks/gathers for every width). The stable sort
-    # also preserves arrival order within each row, so slot contents are
-    # identical to the per-bucket path's.
-    wcode = np.log2(widths).astype(np.int64)  # [num_rows], values < 40
-    key = (wcode[row_idx] << 40) | row_idx.astype(np.int64)
-    order = np.argsort(key, kind="stable")
-    del key
-    r = row_idx[order]
-    c = col_idx[order]
-    v = values[order]
-    del order
-
-    # row-run boundaries in sorted order -> per-entry position within row
-    bounds = np.flatnonzero(np.r_[True, r[1:] != r[:-1]]).astype(np.int64)
-    row_start = np.zeros(nnz, dtype=np.int64)
-    row_start[bounds] = bounds
-    np.maximum.accumulate(row_start, out=row_start)
-    pos = (np.arange(nnz, dtype=np.int64) - row_start).astype(np.int32)
-    del row_start
-
-    # bucket slice boundaries: wcode is non-decreasing along the sort
-    codes_present = np.unique(wcode[r[bounds]])
-    code_of_bound = wcode[r[bounds]]
-    buckets: list[NeighborBucket] = []
-    for code in codes_present.tolist():
-        w = 1 << int(code)
-        b_lo, b_hi = np.searchsorted(code_of_bound, [code, code + 1])
-        first_bounds = bounds[b_lo:b_hi]  # entry offset of each row's run
-        lo = int(first_bounds[0])
-        hi = int(bounds[b_hi]) if b_hi < len(bounds) else nnz
-        rows_w = r[first_bounds].astype(np.int32)
-        counts_w = np.diff(np.r_[first_bounds, hi]).astype(np.int32)
-        chunk = max(1, workspace_elems // (w * max(features, 1)))
-        chunk = 1 << (chunk.bit_length() - 1)  # floor to power of two
-        chunk = min(chunk, 1 << 16)
-        if stable_shapes and num_shards & (num_shards - 1) == 0:
-            # pow2 slot count: a multiple of chunk*num_shards for free
-            # (all three are powers of two and n >= num_shards*chunk')
-            n = _pow2_at_least(max(len(rows_w), num_shards))
-            chunk = min(chunk, n // num_shards)
-        else:
-            granule = chunk * num_shards
-            n = pad_to_multiple(len(rows_w), granule)
-            # shrink chunk when padding to the granule would more than
-            # double the bucket (tiny buckets shouldn't pay a 65536-row
-            # pad)
-            while chunk > 1 and n >= 2 * max(1, len(rows_w)):
-                chunk //= 2
-                granule = chunk * num_shards
-                n = pad_to_multiple(len(rows_w), granule)
-        rows = np.full(n, -1, dtype=np.int32)
-        rows[: len(rows_w)] = rows_w
-        deg = np.zeros(n, dtype=np.int32)
-        deg[: len(rows_w)] = counts_w
-        # slot index per entry: which row-run of this bucket it belongs to
-        slot = np.repeat(
-            np.arange(len(rows_w), dtype=np.int64), counts_w.astype(np.int64)
-        )
-        flat = slot * w + pos[lo:hi]
-        del slot
-        idx = np.zeros(n * w, dtype=np.int32)
-        idx[flat] = c[lo:hi]
-        val = np.zeros(n * w, dtype=np.float32)
-        val[flat] = v[lo:hi]
-        del flat
-        buckets.append(
-            NeighborBucket(rows, idx.reshape(n, w), val.reshape(n, w), deg, chunk)
-        )
-    return buckets
 
 
 def _normal_equations(v, cval, cmask, yty, lam, alpha, implicit, k, matmul_dtype=None):
@@ -435,6 +340,7 @@ def train_als(
     shard_factors: bool = False,
     matmul_dtype: str | None = None,
     init_y: np.ndarray | None = None,
+    packing: PackingOptions | None = None,
 ) -> ALSModel:
     """Full ALS training run.
 
@@ -475,18 +381,20 @@ def train_als(
         return _train_als_sharded(
             user_idx, item_idx, values, num_users, num_items, features,
             lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
-            md,
+            md, packing,
         )
 
     num_shards = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    t_pack0 = _time.perf_counter()
     u_buckets = build_neighbor_buckets(
         user_idx, item_idx, values, num_users, num_shards,
-        workspace_elems=workspace_elems, features=features,
+        workspace_elems=workspace_elems, features=features, options=packing,
     )
     i_buckets = build_neighbor_buckets(
         item_idx, user_idx, values, num_items, num_shards,
-        workspace_elems=workspace_elems, features=features,
+        workspace_elems=workspace_elems, features=features, options=packing,
     )
+    t_pack = _time.perf_counter() - t_pack0
 
     # MLlib-style init: small random normal factors (+1 sacrificial pad
     # row, then pow2 row padding so the compiled run's shape signature is
@@ -556,7 +464,9 @@ def train_als(
     y = np.asarray(y)[:num_items]
     last_phase_seconds.clear()
     last_phase_seconds.update(
-        init=t_iter - t_init, iterate=_time.perf_counter() - t_iter
+        pack=t_pack,
+        init=t_iter - t_init - t_pack,
+        iterate=_time.perf_counter() - t_iter,
     )
     return ALSModel(x=x, y=y)
 
@@ -605,7 +515,7 @@ def _translate_to_shards(idx: np.ndarray, pos_other: np.ndarray, other_loc: int)
 def _train_als_sharded(
     user_idx, item_idx, values, num_users, num_items, features,
     lam, alpha, implicit, iterations, mesh, seed_val, workspace_elems,
-    matmul_dtype=None,
+    matmul_dtype=None, packing=None,
 ) -> ALSModel:
     """shard_map ALS with factors sharded over the mesh (see module doc)."""
     try:
@@ -616,11 +526,11 @@ def _train_als_sharded(
     s = int(np.prod(mesh.devices.shape))
     u_buckets = build_neighbor_buckets(
         user_idx, item_idx, values, num_users, s,
-        workspace_elems=workspace_elems, features=features,
+        workspace_elems=workspace_elems, features=features, options=packing,
     )
     i_buckets = build_neighbor_buckets(
         item_idx, user_idx, values, num_items, s,
-        workspace_elems=workspace_elems, features=features,
+        workspace_elems=workspace_elems, features=features, options=packing,
     )
     if not u_buckets or not i_buckets:
         return ALSModel(

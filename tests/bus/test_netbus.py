@@ -172,3 +172,62 @@ def test_speed_layer_runs_over_tcp(served, tmp_path):
         assert sum(offs.values()) == 40
     finally:
         layer.close()
+
+
+def test_blocking_poll_does_not_stall_producer(served):
+    """A consumer parked in a long server-side poll must not hold up
+    produces on the same broker handle: consumers run on dedicated
+    connections, so the shared producer/admin channel stays free."""
+    import threading
+    import time
+
+    broker = bus.get_broker(served)
+    broker.create_topic("T", 1)
+    c = broker.consumer("T", group="g")
+    in_poll = threading.Event()
+    polled: list = []
+
+    def poller():
+        in_poll.set()
+        polled.extend(c.poll(timeout=3.0))  # empty topic: blocks server-side
+
+    t = threading.Thread(target=poller, daemon=True)
+    t.start()
+    assert in_poll.wait(5.0)
+    time.sleep(0.2)  # let the poll request actually hit the server
+    with broker.producer("T") as p:
+        t0 = time.monotonic()
+        p.send("k", "v")
+        stalled = time.monotonic() - t0
+    # on the old shared socket this waited out the remaining poll timeout
+    # (~2.8s) for the I/O lock; the dedicated channels make it immediate
+    assert stalled < 1.0, f"produce stalled {stalled:.2f}s behind a blocking poll"
+    t.join(10.0)
+    assert not t.is_alive()
+    c.close()
+
+
+def test_two_consumers_poll_concurrently(served):
+    """Two consumers on one broker handle poll in parallel: total wall
+    time for simultaneous empty polls is ~one timeout, not the serialized
+    sum the single shared socket used to impose."""
+    import threading
+    import time
+
+    broker = bus.get_broker(served)
+    broker.create_topic("T", 1)
+    consumers = [broker.consumer("T", group=f"g{i}") for i in range(2)]
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=lambda c=c: c.poll(timeout=1.5), daemon=True)
+        for c in consumers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive()
+    wall = time.monotonic() - t0
+    assert wall < 2.7, f"two 1.5s polls took {wall:.2f}s — serialized, not concurrent"
+    for c in consumers:
+        c.close()

@@ -14,6 +14,16 @@ Usage:
 
 The micro-batches and model land under --workdir (a temp dir by
 default) and are deleted afterwards unless --keep.
+
+`--pack-bench` runs the neighbor-bucket packing benchmark instead of
+the full generation: the legacy composite-key reference packer vs the
+sharded engine (oryx_tpu/ops/packing.py) at --ratings scale, serial and
+at each --workers-list count, asserting bit-identical bucket layouts
+and recording throughput + the live RSS curve:
+
+    python tools/scale_ingest_benchmark.py --pack-bench \
+        --ratings 50000000 --users 2500000 --items 250000 \
+        --workers-list 1,2,4 --out tools/scale_ingest_evidence.txt
 """
 
 from __future__ import annotations
@@ -74,6 +84,80 @@ class RssSampler:
         )
 
 
+def pack_bench(args) -> None:
+    """Neighbor-bucket packing throughput: legacy composite-key reference
+    vs the sharded engine, bit-identity asserted on every run. Packs the
+    X-solve orientation (user rows) of a power-law synthetic at
+    --ratings scale; numpy-only, no jax import in the timed path."""
+    from oryx_tpu.ops import packing
+
+    nnz, users, items = args.ratings, args.users, args.items
+    gen = np.random.default_rng(7)
+    t0 = time.perf_counter()
+    # mild power-law over users/items via squared uniforms (same shape
+    # generator as the ingest path below)
+    u = (gen.random(nnz) ** 2 * users).astype(np.int32)
+    i = (gen.random(nnz) ** 2 * items).astype(np.int32)
+    v = (1.0 + 4.0 * gen.random(nnz)).astype(np.float32)
+    gen_wall = time.perf_counter() - t0
+    lines = [
+        f"=== pack_bench @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
+        f"{nnz} ratings, {users} users x {items} items, X-solve "
+        f"orientation, host cores: {os.cpu_count()}; synthesis {gen_wall:.0f}s",
+    ]
+
+    sampler = RssSampler(period=2.0)
+    t0 = time.perf_counter()
+    ref = packing.build_neighbor_buckets_reference(u, i, v, users)
+    ref_wall = time.perf_counter() - t0
+    lines.append(
+        f"legacy composite-key packer: {ref_wall:.2f}s "
+        f"({nnz / ref_wall / 1e6:.2f}M entries/s), rss {rss_gb():.1f} GB"
+    )
+    print(lines[-1], flush=True)
+
+    def identical(got) -> bool:
+        return len(got) == len(ref) and all(
+            rb.chunk == gb.chunk
+            and np.array_equal(rb.rows, gb.rows)
+            and np.array_equal(rb.idx, gb.idx)
+            and np.array_equal(rb.val, gb.val)
+            and np.array_equal(rb.deg, gb.deg)
+            for rb, gb in zip(ref, got)
+        )
+
+    workers_list = [int(w) for w in args.workers_list.split(",")]
+    for w in workers_list:
+        opts = packing.PackingOptions(workers=w)
+        t0 = time.perf_counter()
+        got = packing.pack_neighbor_buckets(u, i, v, users, options=opts)
+        wall = time.perf_counter() - t0
+        same = identical(got)
+        st = packing.last_pack_stats
+        phases = " ".join(
+            f"{k}={st[k]:.2f}" for k in
+            ("plan", "alloc", "sort", "position", "scatter", "fill")
+            if k in st
+        )
+        lines.append(
+            f"engine workers={w}: {wall:.2f}s "
+            f"({nnz / wall / 1e6:.2f}M entries/s), "
+            f"{ref_wall / wall:.2f}x legacy, bit-identical: {same}; {phases}; "
+            f"rss {rss_gb():.1f} GB"
+        )
+        print(lines[-1], flush=True)
+        del got
+        if not same:
+            sampler.stop()
+            sys.exit(1)
+    lines.append(sampler.stop())
+    lines.append(f"peak RSS: {rss_gb():.1f} GB")
+    print("\n".join(lines[-2:]), flush=True)
+    if args.out:
+        with open(args.out, "a", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ratings", type=int, default=100_000_000)
@@ -85,7 +169,13 @@ def main() -> None:
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--out", default=None)
     ap.add_argument("--keep", action="store_true")
+    ap.add_argument("--pack-bench", action="store_true")
+    ap.add_argument("--workers-list", default="1,2,4")
     args = ap.parse_args()
+
+    if args.pack_bench:
+        pack_bench(args)
+        return
 
     root = Path(args.workdir or tempfile.mkdtemp(prefix="oryx-scale-"))
     data_dir = root / "data"
