@@ -36,6 +36,7 @@ from oryx_tpu.common.lang import ReadWriteLock
 from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
 from oryx_tpu.native.store import make_feature_vectors
+from oryx_tpu.ops import ivf as ivf_ops
 from oryx_tpu.ops import topn as topn_ops
 from oryx_tpu.serving.batcher import score_default, score_indexed_default
 
@@ -280,9 +281,15 @@ class ALSServingModel(ServingModel):
         rows = np.fromiter(
             (self._y_index[d] for d in dirty), dtype=np.int32, count=len(dirty)
         )
-        self._y_matrix = topn_ops.update_rows(
-            self._y_matrix, rows, vals, n_items=len(self._y_ids)
-        )
+        try:
+            self._y_matrix = topn_ops.update_rows(
+                self._y_matrix, rows, vals, n_items=len(self._y_ids)
+            )
+        except ivf_ops.IVFOverlayFull:
+            # the ANN index's pending overlay is out of slots; fall back
+            # to a full rebuild, which re-clusters and re-buckets every
+            # accumulated fold-in into fresh cells
+            return False
         return True
 
     def _ensure_y_matrix(self, force: bool = False):
@@ -315,6 +322,17 @@ class ALSServingModel(ServingModel):
                             self._y_matrix = topn_ops.upload_sharded(
                                 mat, get_mesh(), dtype=dtype
                             )
+                        elif (
+                            self.score_dtype == "int8"
+                            and self.lsh is None
+                            and ivf_ops.ann_active(len(ids))
+                        ):
+                            # ANN tier: cluster the rebuilt item matrix
+                            # into an IVF routing table. Rebuilds ride the
+                            # same MODEL/UP topic path as the exact scan —
+                            # in-between fold-ins stay visible through the
+                            # index's pending overlay (update_rows above)
+                            self._y_matrix = ivf_ops.build_ivf(mat)
                         else:
                             self._y_matrix = topn_ops.upload(mat, dtype=dtype)
                     else:
@@ -563,6 +581,10 @@ class ALSServingModel(ServingModel):
             idx, scores = score_fn(k)
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
+                if int(i) < 0:
+                    # ANN starved-window padding: fewer finite candidates
+                    # than k (tiny probed cells); nothing real was dropped
+                    continue
                 id_ = ids[int(i)]
                 if id_ in exclude:
                     continue

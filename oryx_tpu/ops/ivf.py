@@ -1,0 +1,1083 @@
+"""IVF approximate-retrieval tier for the serving scan.
+
+The exact quantized scan (docs/serving-scan.md) streams every item row per
+query, which caps single-chip serving near 1M items. This module turns
+that ceiling into a 10-100M-item story with the classic inverted-file
+(Faiss-style) two-stage retrieval, built entirely from machinery already
+in the repo:
+
+1. **Coarse quantizer** — the item matrix is clustered into ~sqrt(n)
+   cells with ``ops/kmeans.py`` (k-means|| init + mini-batch Lloyd); each
+   item is assigned to its nearest centroid.
+2. **Cell-contiguous layout** — items are permuted so every cell occupies
+   a contiguous, tile-aligned run of the same two-plane int8 codes the
+   exact scan uses (``StreamingItemMatrix``'s per-row quantization rules
+   verbatim, so each item's codes are bit-identical to a fresh
+   ``upload``). The primary plane is additionally stored ITEM-major: a
+   probed run is then a contiguous byte range, which is what makes the
+   cell scan a dense GEMM instead of a strided gather (a feature-major
+   gather pulls one cacheline per byte — measured 25x slower).
+3. **Routing** — a query dots against the [feat, n_cells] centroid matrix
+   and keeps the top ``nprobe`` cells.
+4. **Probed scan + exact rescore** — a query group's probed cells union
+   into a tile list; each tile is one contiguous ``dynamic_slice`` +
+   plane-1 GEMM reduced to per-chunk maxes (the same chunk-max ranking
+   the exact scan uses), and the top chunks then rescore through the
+   same ``pallas_topn._gathered_pair_scores`` two-plane epilogue as the
+   exact path's candidate tail. Scanning the group UNION means every
+   query sees a superset of its own probed cells — recall only goes up —
+   while the int8->f32 tile conversion amortizes across the group.
+
+Speed-layer visibility: ``update_rows`` keeps fold-ins visible through
+the ANN path with a **pending-overlay list** — touched rows leave the
+cell structure (their slot id is tombstoned) and land in a small
+device-resident overlay of dequantized rows that every query scans
+exactly and merges before the final top-k. The overlay holds the rows'
+two-plane DEQUANTIZED values, so overlay scores match a fresh upload's
+quantized scores to f32 rounding. A full overlay raises
+:class:`IVFOverlayFull`; callers rebuild the index (the serving model's
+full-rebuild path).
+
+Exactness contract: with ``nprobe >= n_cells`` every cell is probed, the
+candidate set is the whole catalog ordered by ascending item id, and the
+scores come from the shared epilogue on the SAME feature-major planes —
+the result reproduces the exact int8 scan's top-N bit-for-bit (tested in
+tests/ops/test_ivf_scan.py; the item-major plane exists only for stage-1
+ranking, whose rounding never touches the returned scores).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from oryx_tpu.ops import pallas_topn as pt
+
+# -- knobs (oryx.serving.scan.ann.*, pushed by ServingLayer) ------------------
+
+# master switch for the serving tier (ops-level entry points work either way)
+ANN_ENABLED = False
+# coarse cells; 0 = auto round(sqrt(n))
+N_CELLS = 0
+# cells probed per query; 0 = derive from PROBE_FRACTION
+NPROBE = 0
+# fraction of items a query should scan when NPROBE is 0 (nprobe =
+# round(fraction * n_cells)); the knob tools/load_benchmark.py maps the
+# reference harness's LSH sampleRate onto. 1% probes measure recall@10
+# ~0.997 on clustered catalogs at 200k-1M items (see docs/serving-scan.md
+# for the recall/latency trade-off and the data-model caveat)
+PROBE_FRACTION = 0.01
+# catalogs below this stay on the exact scan (clustering overhead isn't
+# worth it when one GEMM streams the whole matrix)
+MIN_ITEMS = 100_000
+# pending-overlay rows (speed-layer updates between index rebuilds)
+OVERLAY_CAPACITY = 4096
+# queries per scan group: the probed-cell UNION of a group shares one
+# pass of tile gather + GEMM, so bigger groups amortize memory traffic
+# but inflate the union (more cells scanned per query); 4-8 measures
+# best on the host stage-1 path, where the take is already memcpy-fast
+QUERY_BLOCK = 8
+# chunks per scan tile: tiles are the dynamic_slice granularity of the
+# probed scan, and cells pad to a tile multiple — bigger tiles mean
+# fewer, beefier GEMM steps but more padding per cell
+TILE_CHUNKS = 8
+# None = auto (on for the CPU backend): keep a host-resident dequantized
+# f32 copy of the item planes and run the probed scan through numpy
+# block-take + BLAS. XLA:CPU gathers byte-at-a-time (~0.4 GB/s measured)
+# and converts int8->f32 at ~0.5 Gelem/s, so the device probed path
+# loses its sublinearity to data movement; numpy block-take runs at
+# memcpy speed and the f32 plane never converts at query time. Costs
+# 4x the primary plane's bytes in HOST memory (10 GB at 10M x 256).
+HOST_STAGE1 = None
+
+# rows assigned to centroids per jitted block during build
+_ASSIGN_BLOCK = 65536
+
+
+def configure_ann(
+    enabled=None,
+    cells=None,
+    nprobe=None,
+    probe_fraction=None,
+    min_items=None,
+    overlay_capacity=None,
+    query_block=None,
+    tile_chunks=None,
+    host_stage1=None,
+):
+    """Set the IVF defaults (config: oryx.serving.scan.ann.*). Like
+    ``configure_scan``, call before the first dispatch — jitted programs
+    bake the derived static shapes in at trace time, and the host stage-1
+    plane only materializes at build time."""
+    global ANN_ENABLED, N_CELLS, NPROBE, PROBE_FRACTION
+    global MIN_ITEMS, OVERLAY_CAPACITY, QUERY_BLOCK, TILE_CHUNKS, HOST_STAGE1
+    if enabled is not None:
+        ANN_ENABLED = bool(enabled)
+    if cells is not None:
+        N_CELLS = int(cells)
+    if nprobe is not None:
+        NPROBE = int(nprobe)
+    if probe_fraction is not None:
+        PROBE_FRACTION = float(probe_fraction)
+    if min_items is not None:
+        MIN_ITEMS = int(min_items)
+    if overlay_capacity is not None:
+        OVERLAY_CAPACITY = int(overlay_capacity)
+    if query_block is not None:
+        QUERY_BLOCK = int(query_block)
+    if tile_chunks is not None:
+        TILE_CHUNKS = int(tile_chunks)
+    if host_stage1 is not None:
+        HOST_STAGE1 = bool(host_stage1)
+
+
+def _host_stage1_active() -> bool:
+    if HOST_STAGE1 is not None:
+        return HOST_STAGE1
+    return jax.default_backend() == "cpu"
+
+
+def ann_active(n_items: int) -> bool:
+    """Should the serving tier route this catalog through IVF?"""
+    return ANN_ENABLED and n_items >= MIN_ITEMS
+
+
+class IVFOverlayFull(RuntimeError):
+    """The pending-overlay list is out of slots: rebuild the index."""
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class IVFIndex:
+    """Cell-contiguous two-plane int8 item matrix + routing table.
+
+    Device arrays are immutable; ``update_rows`` returns a new handle
+    (sharing unchanged planes). The host-side routing tables
+    (``id_to_slot``, ``ov_map``) are bookkeeping for the update path and
+    are mutated in place under the caller's serialization (the serving
+    model updates under its cache lock), never read at query time.
+
+    The slot space ends with one all-padding guard tile (slot ids -1,
+    zero codes): tile/chunk selections that have nothing real to point
+    at aim there, so downstream gathers always hit masked slots instead
+    of a neighbouring cell's items (which would duplicate results).
+    """
+
+    # permuted, per-cell tile-padded planes in the exact scan's
+    # feature-major layout; padding slots carry scale 1 / codes 0
+    mat_t: jax.Array  # [kf_pad, n_slots] int8
+    resid: jax.Array  # [kf_pad, n_slots] int8
+    # item-major copy of the PRIMARY plane for the dense probed scan
+    mat_rows: jax.Array  # [n_slots, kf_pad] int8
+    scales: jax.Array  # [1, n_slots] f32
+    resid_scales: jax.Array  # [1, n_slots] f32
+    norms: jax.Array  # [1, n_slots] f32 (original f32 row norms)
+    # slot -> original item id; -1 = padding or superseded by the overlay
+    slot_ids: jax.Array  # [n_slots] int32
+    # routing table
+    centroids_t: jax.Array  # [kf_pad, n_cells] f32
+    centroid_norms: jax.Array  # [n_cells] f32
+    chunk_start: jax.Array  # [n_cells] int32, in chunk units
+    chunk_count: jax.Array  # [n_cells] int32 (occupied chunks only)
+    # pending overlay: dequantized rows of updated items, scanned exactly
+    ov_rows: jax.Array  # [cap, kf_pad] f32
+    ov_ids: jax.Array  # [cap] int32, -1 = empty
+    ov_norms: jax.Array  # [cap] f32
+    n_items: int
+    features: int  # true feature count before int8 sublane padding
+    chunk: int  # items per candidate chunk (layout constant)
+    tile_chunks: int  # chunks per scan tile (layout constant)
+    # host-side routing/update bookkeeping
+    chunk_count_host: np.ndarray  # [n_cells] int64
+    tile_start_host: np.ndarray  # [n_cells] int64, in tile units
+    tile_count_host: np.ndarray  # [n_cells] int64
+    id_to_slot: np.ndarray  # [n_items at build] int32, -1 = overlay/none
+    ov_map: dict  # item id -> overlay slot
+    ov_used: int
+    # host stage-1 mirrors (None when HOST_STAGE1 resolves off): the
+    # dequantized two-plane f32 item rows (q1*s1 + q2*s2), scanned by
+    # numpy block-take + BLAS on the CPU backend; same quantized values
+    # as the device planes, so recall and scores match to f32 rounding
+    host_plane: np.ndarray | None = None  # [n_slots, kf_pad] f32
+    slot_ids_host: np.ndarray | None = None  # [n_slots] int32
+    norms_host: np.ndarray | None = None  # [n_slots] f32
+    ov_rows_host: np.ndarray | None = None  # [cap, kf_pad] f32
+    ov_ids_host: np.ndarray | None = None  # [cap] int32
+    ov_norms_host: np.ndarray | None = None  # [cap] f32
+
+    @property
+    def n_cells(self) -> int:
+        return self.centroids_t.shape[1]
+
+    @property
+    def n_slots(self) -> int:
+        return self.mat_t.shape[1]
+
+    @property
+    def num_features(self) -> int:
+        return self.features
+
+    @property
+    def quantized(self) -> bool:
+        return True
+
+    def resolve_nprobe(self, nprobe: int | None = None) -> int:
+        """Probed cells per query: explicit arg > NPROBE knob > fraction."""
+        p = nprobe if nprobe is not None else NPROBE
+        if not p:
+            p = int(round(PROBE_FRACTION * self.n_cells))
+        return max(1, min(int(p), self.n_cells))
+
+
+# -- build --------------------------------------------------------------------
+
+
+@jax.jit
+def _assign_block_dev(blk, cent_t, half_c2):
+    # nearest centroid by L2 == argmax(y.c - ||c||^2/2); HIGHEST so
+    # borderline assignments match the kmeans trainer's f32 distances
+    s = (
+        jnp.dot(
+            blk,
+            cent_t,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        - half_c2
+    )
+    return jnp.argmin(-s, axis=1).astype(jnp.int32)
+
+
+def _assign_cells(mat: np.ndarray, centers: np.ndarray) -> np.ndarray:
+    """Nearest-centroid id per item row, in fixed-shape device blocks."""
+    n = len(mat)
+    cent_t = jnp.asarray(centers.T)
+    half = jnp.asarray(0.5 * np.einsum("kd,kd->k", centers, centers)[None, :])
+    out = np.empty(n, np.int32)
+    block = min(_ASSIGN_BLOCK, n)
+    for beg in range(0, n, block):
+        sub = np.asarray(mat[beg : beg + block], dtype=np.float32)
+        real = len(sub)
+        if real < block:  # pad the tail so two shapes compile, not many
+            sub = np.concatenate([sub, np.zeros((block - real, sub.shape[1]), np.float32)])
+        out[beg : beg + real] = np.asarray(
+            _assign_block_dev(jnp.asarray(sub), cent_t, half)
+        )[:real]
+    return out
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, int(x) - 1).bit_length()
+
+
+def build_ivf(
+    matrix: np.ndarray,
+    *,
+    n_cells: int | None = None,
+    seed: int = 0,
+    train_sample: int = 200_000,
+    iterations: int = 8,
+    overlay_capacity: int | None = None,
+) -> IVFIndex:
+    """Cluster, permute cell-contiguous, quantize, and ship to device.
+
+    The coarse quantizer trains on a uniform sample (mini-batch Lloyd
+    over k-means|| seeds); the full catalog then assigns to the trained
+    centroids in device blocks. Rows quantize with the exact scan's
+    per-row rules, streamed in million-row slices so the host transient
+    stays bounded at 10M+ items.
+    """
+    mat = np.asarray(matrix, dtype=np.float32)
+    n, feat = mat.shape
+    if n == 0:
+        raise ValueError("cannot build an IVF index over zero items")
+    chunk = max(8, int(pt._CHUNK))
+    tile_chunks = max(1, TILE_CHUNKS)
+    tile_slots = tile_chunks * chunk
+    cells = int(n_cells if n_cells is not None else (N_CELLS or round(math.sqrt(n))))
+    cells = max(1, min(cells, n))
+
+    from oryx_tpu.ops.kmeans import train_kmeans
+
+    rng = np.random.default_rng(seed)
+    sample = (
+        mat[rng.choice(n, train_sample, replace=False)] if n > train_sample else mat
+    )
+    minibatch = 32_768 if len(sample) > 65_536 else None
+    centers, _counts, _cost = train_kmeans(
+        sample,
+        cells,
+        iterations=iterations,
+        init="k-means||",
+        seed=seed,
+        minibatch_size=minibatch,
+    )
+    centers = np.asarray(centers, dtype=np.float32)
+
+    assign = _assign_cells(mat, centers)
+    order = np.argsort(assign, kind="stable")  # within-cell: ascending id
+    counts = np.bincount(assign, minlength=cells).astype(np.int64)
+    chunk_counts = -(-counts // chunk)  # occupied chunks; empty cells keep 0
+    tile_counts = -(-chunk_counts // tile_chunks)
+    spans = tile_counts * tile_slots  # per-cell slot span, tile-aligned
+    item_starts = np.zeros(cells + 1, np.int64)
+    np.cumsum(counts, out=item_starts[1:])
+    slot_base = np.zeros(cells + 1, np.int64)
+    np.cumsum(spans, out=slot_base[1:])
+    # +1 guard tile at the end: the all-padding landing zone for starved
+    # tile/chunk selections
+    n_slots = int(slot_base[-1]) + tile_slots
+    # slot of the i-th cell-sorted item: its cell's base + rank in cell
+    pos_in_cell = np.arange(n, dtype=np.int64) - np.repeat(item_starts[:-1], counts)
+    slots_sorted = np.repeat(slot_base[:-1], counts) + pos_in_cell
+
+    kf_pad = pt._ceil_to(feat, pt._INT8_FEAT_MULTIPLE)
+    mat_t = np.zeros((kf_pad, n_slots), np.int8)
+    resid = np.zeros((kf_pad, n_slots), np.int8)
+    mat_rows = np.zeros((n_slots, kf_pad), np.int8)
+    scales = np.ones((1, n_slots), np.float32)  # 1.0: padding dequant is a no-op
+    rscales = np.ones((1, n_slots), np.float32)
+    norms = np.zeros((1, n_slots), np.float32)
+    slot_ids = np.full(n_slots, -1, np.int32)
+    slot_ids[slots_sorted] = order
+    id_to_slot = np.empty(n, np.int32)
+    id_to_slot[order] = slots_sorted.astype(np.int32)
+    host1 = _host_stage1_active()
+    host_plane = np.zeros((n_slots, kf_pad), np.float32) if host1 else None
+    slice_rows = 1_000_000  # bounds the quantize transient at 10M+ items
+    for beg in range(0, n, slice_rows):
+        rows = order[beg : beg + slice_rows]
+        sl = slots_sorted[beg : beg + slice_rows]
+        sub = mat[rows]
+        q, s = pt._quantize_rows(sub)
+        q2, s2 = pt._quantize_residual(sub, q, s)
+        mat_t[:feat, sl] = q.T
+        resid[:feat, sl] = q2.T
+        mat_rows[sl, :feat] = q
+        scales[0, sl] = s
+        rscales[0, sl] = s2
+        norms[0, sl] = np.linalg.norm(sub, axis=1)
+        if host_plane is not None:
+            host_plane[sl, :feat] = (
+                q.astype(np.float32) * s[:, None]
+                + q2.astype(np.float32) * s2[:, None]
+            )
+
+    cent_t = np.zeros((kf_pad, cells), np.float32)
+    cent_t[:feat] = centers.T
+    cap = _pow2_ceil(overlay_capacity or OVERLAY_CAPACITY)
+
+    return IVFIndex(
+        mat_t=jnp.asarray(mat_t),
+        resid=jnp.asarray(resid),
+        mat_rows=jnp.asarray(mat_rows),
+        scales=jnp.asarray(scales),
+        resid_scales=jnp.asarray(rscales),
+        norms=jnp.asarray(norms),
+        slot_ids=jnp.asarray(slot_ids),
+        centroids_t=jnp.asarray(cent_t),
+        centroid_norms=jnp.asarray(np.linalg.norm(centers, axis=1)),
+        chunk_start=jnp.asarray((slot_base[:-1] // chunk).astype(np.int32)),
+        chunk_count=jnp.asarray(chunk_counts.astype(np.int32)),
+        ov_rows=jnp.zeros((cap, kf_pad), jnp.float32),
+        ov_ids=jnp.full((cap,), -1, jnp.int32),
+        ov_norms=jnp.zeros((cap,), jnp.float32),
+        n_items=n,
+        features=feat,
+        chunk=chunk,
+        tile_chunks=tile_chunks,
+        chunk_count_host=chunk_counts,
+        tile_start_host=slot_base[:-1] // tile_slots,
+        tile_count_host=tile_counts,
+        id_to_slot=id_to_slot,
+        ov_map={},
+        ov_used=0,
+        host_plane=host_plane,
+        slot_ids_host=slot_ids.copy() if host1 else None,
+        norms_host=norms[0].copy() if host1 else None,
+        ov_rows_host=np.zeros((cap, kf_pad), np.float32) if host1 else None,
+        ov_ids_host=np.full((cap,), -1, np.int32) if host1 else None,
+        ov_norms_host=np.zeros((cap,), np.float32) if host1 else None,
+    )
+
+
+# -- query: routing -----------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("nprobe", "cosine"))
+def _route_cells(cent_t, cnorms, q_bf, *, nprobe, cosine):
+    route = jnp.dot(
+        q_bf,
+        cent_t,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    if cosine:
+        # ||q|| is constant per row: dividing by centroid norms alone
+        # preserves the per-query cosine routing order
+        route = route / jnp.maximum(cnorms[None, :], 1e-12)
+    _, cells = jax.lax.top_k(route, nprobe)
+    return cells  # [b, nprobe]
+
+
+def _group_tile_lists(index: IVFIndex, cells_np: np.ndarray, g: int):
+    """Union each query group's probed cells into ragged tile lists.
+
+    Scanning the union instead of per-query lists keeps the scan dense
+    and uniform — a query only ever sees EXTRA cells, never fewer.
+    """
+    b = cells_np.shape[0]
+    groups = -(-b // g)
+    per_group = []
+    for gi in range(groups):
+        uc = np.unique(cells_np[gi * g : (gi + 1) * g].ravel())
+        cnt = index.tile_count_host[uc]
+        uc = uc[cnt > 0]  # empty cells contribute no tiles
+        cnt = index.tile_count_host[uc]
+        starts = index.tile_start_host[uc]
+        total = int(cnt.sum())
+        if total == 0:
+            per_group.append(np.empty(0, np.int64))
+            continue
+        # ragged [start, start+cnt) ranges flattened in one vector op
+        base = np.repeat(starts, cnt)
+        cum = np.zeros(len(uc) + 1, np.int64)
+        np.cumsum(cnt, out=cum[1:])
+        per_group.append(base + (np.arange(total) - np.repeat(cum[:-1], cnt)))
+    return per_group
+
+
+def _pack_tiles(index: IVFIndex, lists, e: int):
+    """Stack ragged tile lists into a [len(lists), e] device array; short
+    lists pad with the guard tile, whose slots are all masked."""
+    guard = index.n_slots // (index.tile_chunks * index.chunk) - 1
+    tiles = np.full((len(lists), e), guard, np.int64)
+    for gi, t in enumerate(lists):
+        tiles[gi, : len(t)] = t
+    return jnp.asarray(tiles.astype(np.int32))
+
+
+# -- query: host stage-1 path (CPU backend) -----------------------------------
+
+
+def _host_topk(index: IVFIndex, qpad: np.ndarray, cells: np.ndarray, k: int, cosine: bool):
+    """Probed scan over the host-resident dequantized f32 plane.
+
+    One numpy pass per query group: block-take the group's probed tiles
+    (memcpy-speed, unlike XLA:CPU's elementwise gather), one BLAS GEMM
+    against the group's queries, then a per-query partition + (score
+    desc, id asc) ordering — the same tie direction as the exact scan's
+    ascending-id stable top_k. Because the plane holds the two-plane
+    DEQUANTIZED values, the ranking scores ARE final-precision scores:
+    the CPU path collapses the rescore stage instead of re-gathering
+    candidates through XLA. Returns host (vals [n, k] f32, ids [n, k]
+    int32); the overlay merges from its host mirror.
+    """
+    n, kf = qpad.shape
+    kk = max(1, int(k))
+    g = max(1, min(QUERY_BLOCK, n))
+    # probe-locality sort (see top_k_device): shared cells collapse in
+    # the group union
+    order = np.argsort(cells[:, 0], kind="stable")
+    lists = _group_tile_lists(index, cells[order], g)
+    ts = index.tile_chunks * index.chunk
+    n_tiles = index.n_slots // ts
+    plane3 = index.host_plane.reshape(n_tiles, ts, kf)
+    sids3 = index.slot_ids_host.reshape(n_tiles, ts)
+    norms3 = index.norms_host.reshape(n_tiles, ts)
+    used = index.ov_used
+    qn = np.linalg.norm(qpad, axis=1) if cosine else None
+    if used:
+        ov_sc = qpad @ index.ov_rows_host[:used].T  # [n, used] exact
+        if cosine:
+            ov_sc = ov_sc / np.maximum(
+                index.ov_norms_host[None, :used] * qn[:, None], 1e-12
+            )
+        ov_ids = index.ov_ids_host[:used].astype(np.int64)
+    out_v = np.full((n, kk), -np.inf, np.float32)
+    out_i = np.full((n, kk), -1, np.int32)
+    for gi, tl in enumerate(lists):
+        rows = order[gi * g : (gi + 1) * g]
+        qg = qpad[rows]
+        if len(tl):
+            slab = plane3[tl].reshape(-1, kf)  # contiguous block take
+            sc = slab @ qg.T  # [S, group] final-precision scores
+            ssid = sids3[tl].reshape(-1).astype(np.int64)
+            if cosine:
+                nr = norms3[tl].reshape(-1)
+                sc = sc / np.maximum(nr[:, None] * qn[rows][None, :], 1e-12)
+            sc[ssid < 0, :] = -np.inf  # padding + tombstoned slots
+        else:  # every probed cell was empty: overlay-only candidates
+            sc = np.empty((0, len(rows)), np.float32)
+            ssid = np.empty(0, np.int64)
+        kp = min(kk, sc.shape[0])
+        if kp and sc.shape[0] > kp:
+            part = np.argpartition(-sc, kp - 1, axis=0)[:kp]  # [kp, group]
+        else:
+            part = np.broadcast_to(
+                np.arange(sc.shape[0])[:, None], (sc.shape[0], len(rows))
+            )
+        for j, qi in enumerate(rows):
+            pv = sc[part[:, j], j]
+            pi = ssid[part[:, j]]
+            if used:
+                pv = np.concatenate([pv, ov_sc[qi]])
+                pi = np.concatenate([pi, ov_ids])
+            if not len(pv):
+                continue
+            # score desc, item id asc — the exact path's tie direction
+            o = np.lexsort((pi, -pv))[:kk]
+            pv, pi = pv[o], pi[o]
+            fin = np.isfinite(pv)
+            out_v[qi, : len(pv)] = np.where(fin, pv, -np.inf)
+            out_i[qi, : len(pv)] = np.where(fin, pi, -1).astype(np.int32)
+    return out_v, out_i
+
+
+# -- query: probed scan + exact rescore ---------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "kc", "tile", "chunk", "cosine")
+)
+def _probe_topk(
+    mat_rows,
+    mat_t,
+    resid,
+    scales,
+    resid_scales,
+    norms,
+    slot_ids,
+    ov_rows,
+    ov_ids,
+    ov_norms,
+    q_gbf,
+    tiles_ge,
+    *,
+    k,
+    kc,
+    tile,
+    chunk,
+    cosine,
+):
+    """[G, g, kf] query groups x [G, E] probed tiles -> (vals, ids) [G, g, k].
+
+    Stage 1 is the exact scan's chunk-max ranking restricted to the
+    probed tiles: a group's tile list gathers as one contiguous-block
+    slab of the item-major primary plane, so the whole probed region is
+    ONE int8->f32 conversion + GEMM shared by the query group, reduced
+    to per-chunk maxes in the epilogue. (One big step per group, not one
+    small step per tile — XLA:CPU charges ~100us of dispatch per scan
+    step, which at thousands of tiles costs more than the math.)
+    Stage 2 takes each query's top ``kc`` chunks and rescores their items
+    through the same two-plane gather epilogue as the exact path's
+    candidate tail, then merges the pending overlay's exact scores."""
+    n_slots = mat_rows.shape[0]
+    kf = mat_rows.shape[1]
+    guard_chunk = n_slots // chunk - 1  # inside the guard tile: all masked
+    tile_slots = tile * chunk
+    n_tiles = n_slots // tile_slots
+    # tile-blocked views: row-major reshapes, no data movement
+    rows3 = mat_rows.reshape(n_tiles, tile_slots, kf)
+    scales_t = scales.reshape(n_tiles, tile_slots)
+    sids_t = slot_ids.reshape(n_tiles, tile_slots)
+    norms_t = norms.reshape(n_tiles, tile_slots)
+
+    def one(args):
+        q, tl = args  # [g, kf], [E]
+        g = q.shape[0]
+        e = tl.shape[0]
+        qn = jnp.linalg.norm(q, axis=1, keepdims=True) if cosine else None
+        qt = q.T  # [kf, g]
+
+        # contiguous-block gather of the probed tiles (each tile is one
+        # memcpy-able run), then a single dense GEMM over the union
+        slab = jnp.take(rows3, tl, axis=0).reshape(e * tile_slots, kf)
+        s1 = jnp.take(scales_t, tl, axis=0).reshape(e * tile_slots)
+        sid1 = jnp.take(sids_t, tl, axis=0).reshape(e * tile_slots)
+        sc = (
+            jnp.dot(
+                slab.astype(jnp.float32),
+                qt,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            * s1[:, None]
+        )  # [e*tile_slots, g] plane-1 ranking scores
+        if cosine:
+            nr = jnp.take(norms_t, tl, axis=0).reshape(e * tile_slots)
+            sc = sc / jnp.maximum(nr[:, None] * qn[None, :, 0], 1e-12)
+        sc = jnp.where(sid1[:, None] >= 0, sc, -jnp.inf)
+        cms = jnp.max(sc.reshape(e, tile, chunk, g), axis=2)  # [E, tile, g]
+        allc = jnp.moveaxis(cms, 2, 0).reshape(g, -1)  # [g, E*tile]
+        cv, cpos = jax.lax.top_k(allc, min(kc, allc.shape[1]))
+        tchunk = tl[cpos // tile] * tile + cpos % tile  # global chunk ids
+        # starved selections (-inf chunk max) land on the guard chunk so
+        # the gather below cannot touch an unprobed cell's items
+        tchunk = jnp.where(jnp.isfinite(cv), tchunk, guard_chunk)
+        iid = (
+            tchunk[:, :, None] * chunk
+            + jnp.arange(chunk, dtype=jnp.int32)[None, None, :]
+        ).reshape(g, -1)
+        sid = slot_ids[iid]
+        sc = pt._gathered_pair_scores(
+            mat_t, resid, scales, resid_scales, norms, q, qn, iid, cosine=cosine
+        )
+        sc = jnp.where(sid >= 0, sc, -jnp.inf)
+        # pending overlay: exact f32 scan of the updated rows
+        osc = jnp.dot(
+            q,
+            ov_rows.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if cosine:
+            osc = osc / jnp.maximum(ov_norms[None, :] * qn, 1e-12)
+        osc = jnp.where(ov_ids[None, :] >= 0, osc, -jnp.inf)
+        allv = jnp.concatenate([sc, osc], axis=1)
+        alli = jnp.concatenate(
+            [sid, jnp.broadcast_to(ov_ids[None, :], osc.shape)], axis=1
+        )
+        ke = min(k, allv.shape[1])
+        v, p = jax.lax.top_k(allv, ke)
+        out_ids = jnp.take_along_axis(alli, p, axis=1)
+        # starved windows (k > finite candidates) pad with id -1, not a
+        # garbage gather target — callers skip negatives
+        out_ids = jnp.where(jnp.isfinite(v), out_ids, -1)
+        if ke < k:
+            v = jnp.pad(v, ((0, 0), (0, k - ke)), constant_values=-jnp.inf)
+            out_ids = jnp.pad(out_ids, ((0, 0), (0, k - ke)), constant_values=-1)
+        return v, out_ids
+
+    if q_gbf.shape[0] == 1:
+        v, i = one((q_gbf[0], tiles_ge[0]))
+        return v[None], i[None]
+    return jax.lax.map(one, (q_gbf, tiles_ge))
+
+
+# -- query: full-probe exact mode ---------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "n_seg", "seg", "cosine", "chunk")
+)
+def _full_topk(
+    mat_t,
+    resid,
+    scales,
+    resid_scales,
+    norms,
+    slot_ids,
+    chunk_start,
+    chunk_count,
+    ov_rows,
+    ov_ids,
+    ov_norms,
+    queries_gbf,
+    *,
+    k,
+    n_seg,
+    seg,
+    cosine,
+    chunk,
+):
+    """nprobe == n_cells: every occupied chunk is a candidate and every
+    candidate rescores through the shared two-plane epilogue — the
+    ascending-item-id candidate order makes the stable top_k break score
+    ties toward the lowest id, exactly like the exact scan. O(n) gather:
+    this mode exists for the bit-for-bit contract (and tiny catalogs),
+    not for speed — the probed path above is the serving path."""
+    int_max = jnp.iinfo(jnp.int32).max
+    n_cells = chunk_start.shape[0]
+    q_chunks = n_seg * seg
+
+    def one(q):
+        g = q.shape[0]
+        qn = jnp.linalg.norm(q, axis=1, keepdims=True) if cosine else None
+        lens = jnp.broadcast_to(chunk_count[None, :], (g, n_cells))
+        cum = jnp.cumsum(lens, axis=1)
+        j = jnp.broadcast_to(
+            jnp.arange(q_chunks, dtype=jnp.int32)[None, :], (g, q_chunks)
+        )
+        # which cell does global candidate-chunk j fall into
+        pos = jax.vmap(lambda c, jj: jnp.searchsorted(c, jj, side="right"))(cum, j)
+        valid = pos < n_cells
+        posc = jnp.minimum(pos, n_cells - 1)
+        prev = cum - lens
+        within = j - jnp.take_along_axis(prev, posc, axis=1)
+        chk = jnp.where(valid, chunk_start[posc] + within, 0)
+        iid = (
+            chk[:, :, None] * chunk
+            + jnp.arange(chunk, dtype=jnp.int32)[None, None, :]
+        ).reshape(g, q_chunks * chunk)
+        sid = slot_ids[iid]  # [g, m] original ids; -1 = padding/tombstone
+        ok = jnp.repeat(valid, chunk, axis=1) & (sid >= 0)
+        # ascending item id, padding last — the stable per-segment + final
+        # top_k then tie-breaks toward the lowest item id
+        key = jnp.where(ok, sid, int_max)
+        ordr = jnp.argsort(key, axis=1)
+        iid = jnp.take_along_axis(iid, ordr, axis=1)
+        sid = jnp.take_along_axis(sid, ordr, axis=1)
+        ok = jnp.take_along_axis(ok, ordr, axis=1)
+        seg_items = seg * chunk
+        kk = max(1, min(k, seg_items))
+        iid_s = jnp.moveaxis(iid.reshape(g, n_seg, seg_items), 1, 0)
+        sid_s = jnp.moveaxis(sid.reshape(g, n_seg, seg_items), 1, 0)
+        ok_s = jnp.moveaxis(ok.reshape(g, n_seg, seg_items), 1, 0)
+
+        def seg_step(carry, xs):
+            ii, ss, oo = xs
+            sc = pt._gathered_pair_scores(
+                mat_t, resid, scales, resid_scales, norms, q, qn, ii,
+                cosine=cosine,
+            )
+            sc = jnp.where(oo, sc, -jnp.inf)
+            v, p = jax.lax.top_k(sc, kk)
+            return carry, (v, jnp.take_along_axis(ss, p, axis=1))
+
+        if n_seg == 1:
+            _, (vs, ids) = seg_step(0, (iid_s[0], sid_s[0], ok_s[0]))
+            allv, alli = vs, ids
+        else:
+            _, (vs, ids) = jax.lax.scan(seg_step, 0, (iid_s, sid_s, ok_s))
+            allv = jnp.moveaxis(vs, 0, 1).reshape(g, n_seg * kk)
+            alli = jnp.moveaxis(ids, 0, 1).reshape(g, n_seg * kk)
+        # pending overlay: exact f32 scan of the updated rows
+        osc = jnp.dot(
+            q,
+            ov_rows.T,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        if cosine:
+            osc = osc / jnp.maximum(ov_norms[None, :] * qn, 1e-12)
+        osc = jnp.where(ov_ids[None, :] >= 0, osc, -jnp.inf)
+        allv = jnp.concatenate([allv, osc], axis=1)
+        alli = jnp.concatenate(
+            [alli, jnp.broadcast_to(ov_ids[None, :], osc.shape)], axis=1
+        )
+        ke = min(k, allv.shape[1])
+        v, p = jax.lax.top_k(allv, ke)
+        out_ids = jnp.take_along_axis(alli, p, axis=1)
+        out_ids = jnp.where(jnp.isfinite(v), out_ids, -1)
+        if ke < k:
+            v = jnp.pad(v, ((0, 0), (0, k - ke)), constant_values=-jnp.inf)
+            out_ids = jnp.pad(out_ids, ((0, 0), (0, k - ke)), constant_values=-1)
+        return v, out_ids
+
+    if queries_gbf.shape[0] == 1:
+        v, i = one(queries_gbf[0])
+        return v[None], i[None]
+    return jax.lax.map(one, queries_gbf)
+
+
+# -- query: entry points ------------------------------------------------------
+
+# per-segment items for the full-probe gather (bounds the [kf, g, seg]
+# f32 candidate planes to a few MB regardless of catalog size)
+_SEG_ITEMS = 8192
+
+
+def _group_queries(index: IVFIndex, queries: np.ndarray, order=None):
+    """[n, feat] -> ([G, g, kf_pad] device f32, n, g). ``order`` permutes
+    the queries before grouping (probe-locality sort)."""
+    q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+    n = q.shape[0]
+    if order is not None:
+        q = q[order]
+    kf_pad = index.mat_t.shape[0]
+    g = max(1, min(QUERY_BLOCK, n))
+    groups = -(-n // g)
+    padded = np.zeros((groups * g, kf_pad), np.float32)
+    padded[:n, : q.shape[1]] = q
+    return jnp.asarray(padded.reshape(groups, g, kf_pad)), n, g
+
+
+def top_k_device(
+    index: IVFIndex,
+    queries: np.ndarray,
+    k: int,
+    *,
+    nprobe: int | None = None,
+    cosine: bool = False,
+):
+    """(vals [n, k], ids [n, k]) device arrays; ids are ORIGINAL item
+    row indices (-1 pads starved windows)."""
+    np_ = index.resolve_nprobe(nprobe)
+    kk = max(1, int(k))
+    # an empty overlay shrinks to one masked dummy row: the overlay GEMM
+    # against the full capacity (default 4096 rows) would otherwise cost
+    # more than the probed scan itself
+    if index.ov_used == 0:
+        ov_rows, ov_ids, ov_norms = (
+            index.ov_rows[:1],
+            index.ov_ids[:1],
+            index.ov_norms[:1],
+        )
+    else:
+        ov_rows, ov_ids, ov_norms = index.ov_rows, index.ov_ids, index.ov_norms
+    if np_ >= index.n_cells:
+        q_gbf, n, g = _group_queries(index, queries)
+        total_chunks = max(1, int(index.chunk_count_host.sum()))
+        seg = max(1, _SEG_ITEMS // index.chunk)
+        n_seg = -(-total_chunks // seg)
+        if n_seg == 1:
+            seg = total_chunks
+        vals, ids = _full_topk(
+            index.mat_t,
+            index.resid,
+            index.scales,
+            index.resid_scales,
+            index.norms,
+            index.slot_ids,
+            index.chunk_start,
+            index.chunk_count,
+            ov_rows,
+            ov_ids,
+            ov_norms,
+            q_gbf,
+            k=kk,
+            n_seg=n_seg,
+            seg=seg,
+            cosine=cosine,
+            chunk=index.chunk,
+        )
+        out_k = vals.shape[-1]
+        return vals.reshape(-1, out_k)[:n], ids.reshape(-1, out_k)[:n]
+    q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+    n = q.shape[0]
+    kf_pad = index.mat_t.shape[0]
+    qpad = np.zeros((n, kf_pad), np.float32)
+    qpad[:, : q.shape[1]] = q
+    cells = np.asarray(
+        _route_cells(
+            index.centroids_t,
+            index.centroid_norms,
+            jnp.asarray(qpad),
+            nprobe=np_,
+            cosine=cosine,
+        )
+    )
+    if index.host_plane is not None:
+        vals_np, ids_np = _host_topk(index, qpad, cells, kk, cosine)
+        return jnp.asarray(vals_np), jnp.asarray(ids_np)
+    # probe-locality sort: queries sharing a best cell land in the same
+    # scan group, shrinking each group's cell union (the scan covers the
+    # union, so overlap is pure savings); results unsort at the end
+    order = np.argsort(cells[:, 0], kind="stable")
+    g = max(1, min(QUERY_BLOCK, n))
+    groups = -(-n // g)
+    lists = _group_tile_lists(index, cells[order], g)
+    qs = np.zeros((groups * g, kf_pad), np.float32)
+    qs[:n] = qpad[order]
+    qs = qs.reshape(groups, g, kf_pad)
+    # bucket groups by pow2(union size): each bucket pads only to ITS
+    # widest member, so one pathological union doesn't tax every group
+    buckets: dict[int, list[int]] = {}
+    for gi, t in enumerate(lists):
+        buckets.setdefault(_pow2_ceil(max(1, len(t))), []).append(gi)
+    row_src = []  # sorted-query row ranges, in bucket emission order
+    parts_v, parts_i = [], []
+    for e, gis in sorted(buckets.items()):
+        tiles = _pack_tiles(index, [lists[gi] for gi in gis], e)
+        v, i = _probe_topk(
+            index.mat_rows,
+            index.mat_t,
+            index.resid,
+            index.scales,
+            index.resid_scales,
+            index.norms,
+            index.slot_ids,
+            ov_rows,
+            ov_ids,
+            ov_norms,
+            jnp.asarray(qs[gis]),
+            tiles,
+            k=kk,
+            kc=pt._chunk_k(kk, e * index.tile_chunks),
+            tile=index.tile_chunks,
+            chunk=index.chunk,
+            cosine=cosine,
+        )
+        parts_v.append(v.reshape(-1, v.shape[-1]))
+        parts_i.append(i.reshape(-1, i.shape[-1]))
+        for gi in gis:
+            row_src.append(np.arange(gi * g, (gi + 1) * g, dtype=np.int64))
+    stacked_v = parts_v[0] if len(parts_v) == 1 else jnp.concatenate(parts_v)
+    stacked_i = parts_i[0] if len(parts_i) == 1 else jnp.concatenate(parts_i)
+    # stacked row j holds sorted-query row_src[j]; compose with the
+    # locality unsort so one device gather restores caller order
+    where = np.empty(groups * g, np.int64)
+    where[np.concatenate(row_src)] = np.arange(groups * g)
+    inv = np.argsort(order)
+    sel = jnp.asarray(where[inv].astype(np.int32))
+    return stacked_v[sel], stacked_i[sel]
+
+
+def top_k(
+    index: IVFIndex,
+    queries: np.ndarray,
+    k: int,
+    *,
+    nprobe: int | None = None,
+    cosine: bool = False,
+):
+    """Blocking host-side form: (ids [n, k] int32, vals [n, k] f32)."""
+    vals, ids = top_k_device(index, queries, k, nprobe=nprobe, cosine=cosine)
+    return np.asarray(ids), np.asarray(vals)
+
+
+def top_k_device_indexed(
+    index: IVFIndex,
+    x_dev: jax.Array,
+    indices: np.ndarray,
+    k: int,
+    *,
+    nprobe: int | None = None,
+    cosine: bool = False,
+):
+    """Index-submit twin: queries are rows of the device-resident X."""
+    idx = np.atleast_1d(np.asarray(indices, dtype=np.int32))
+    q = np.asarray(x_dev[jnp.asarray(idx)])  # device gather, tiny download
+    return top_k_device(index, q, k, nprobe=nprobe, cosine=cosine)
+
+
+# -- update path (speed-layer fold-ins) ---------------------------------------
+
+
+@jax.jit
+def _apply_overlay(slot_ids, ov_rows, ov_ids, ov_norms, dead, pos, rows, ids, nrm):
+    # dead slots repeat their last entry when bucketed — set(-1) is
+    # idempotent, so duplicates are harmless
+    slot_ids = slot_ids.at[dead].set(-1)
+    ov_rows = ov_rows.at[pos].set(rows)
+    ov_ids = ov_ids.at[pos].set(ids)
+    ov_norms = ov_norms.at[pos].set(nrm)
+    return slot_ids, ov_rows, ov_ids, ov_norms
+
+
+def update_rows(
+    index: IVFIndex,
+    rows: np.ndarray,
+    values: np.ndarray,
+    n_items: int | None = None,
+) -> IVFIndex:
+    """Fold updated item rows into the index via the pending overlay.
+
+    Each touched row's cell slot is tombstoned (slot id -> -1) and its
+    fresh vector lands in the overlay, which queries scan exactly — so a
+    speed-layer fold-in is visible on the very next request regardless of
+    which cells it routes to. Overlay rows store the two-plane
+    DEQUANTIZED values (q1*s1 + q2*s2), so their scores match what a full
+    rebuild would serve to f32 rounding. Raises :class:`IVFOverlayFull`
+    when the overlay is out of slots (callers rebuild)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    values = np.ascontiguousarray(np.atleast_2d(values), dtype=np.float32)
+    if len(rows) == 0:
+        return index
+    count = int(index.n_items if n_items is None else n_items)
+    # last write wins for duplicate ids in one batch
+    last = {}
+    for i, r in enumerate(rows):
+        last[int(r)] = i
+    ids = np.fromiter(last.keys(), dtype=np.int64, count=len(last))
+    vals = values[np.fromiter(last.values(), dtype=np.int64, count=len(last))]
+
+    cap = index.ov_rows.shape[0]
+    ov_map = index.ov_map
+    used = index.ov_used
+    pos = np.empty(len(ids), np.int32)
+    fresh = 0
+    for i, item in enumerate(ids):
+        item = int(item)
+        if item in ov_map:
+            pos[i] = ov_map[item]
+        else:
+            if used + fresh >= cap:
+                raise IVFOverlayFull(
+                    f"pending overlay full ({cap} rows): rebuild the IVF index"
+                )
+            pos[i] = used + fresh
+            fresh += 1
+    dead = np.array(
+        [
+            index.id_to_slot[item]
+            for item in ids
+            if item < len(index.id_to_slot) and index.id_to_slot[item] >= 0
+        ],
+        dtype=np.int32,
+    )
+
+    q, s = pt._quantize_rows(vals)
+    q2, s2 = pt._quantize_residual(vals, q, s)
+    deq = q.astype(np.float32) * s[:, None] + q2.astype(np.float32) * s2[:, None]
+    kf_pad = index.mat_t.shape[0]
+    deq_pad = np.zeros((len(ids), kf_pad), np.float32)
+    deq_pad[:, : vals.shape[1]] = deq
+    nrm = np.linalg.norm(vals, axis=1)
+
+    # bucket the scatter shapes like topn.update_rows (pad repeats the
+    # last entry; rewriting the same overlay slot with the same row is
+    # a no-op) so jit retraces O(log n) shapes
+    def bucket(arr):
+        m = len(arr)
+        b = _pow2_ceil(m)
+        if b == m:
+            return arr
+        return np.concatenate([arr, np.repeat(arr[-1:], b - m, axis=0)], axis=0)
+
+    slot_ids, ov_rows, ov_ids, ov_norms = (
+        index.slot_ids,
+        index.ov_rows,
+        index.ov_ids,
+        index.ov_norms,
+    )
+    if len(dead):
+        slot_ids, ov_rows, ov_ids, ov_norms = _apply_overlay(
+            slot_ids,
+            ov_rows,
+            ov_ids,
+            ov_norms,
+            jnp.asarray(bucket(dead)),
+            jnp.asarray(bucket(pos)),
+            jnp.asarray(bucket(deq_pad)),
+            jnp.asarray(bucket(ids.astype(np.int32))),
+            jnp.asarray(bucket(nrm.astype(np.float32))),
+        )
+    else:
+        pos_b = jnp.asarray(bucket(pos))
+        ov_rows = ov_rows.at[pos_b].set(jnp.asarray(bucket(deq_pad)))
+        ov_ids = ov_ids.at[pos_b].set(jnp.asarray(bucket(ids.astype(np.int32))))
+        ov_norms = ov_norms.at[pos_b].set(jnp.asarray(bucket(nrm.astype(np.float32))))
+
+    # host bookkeeping (see class docstring: serialized by the caller)
+    if index.host_plane is not None:
+        if len(dead):
+            index.slot_ids_host[dead] = -1  # tombstone in the host mirror
+        index.ov_rows_host[pos] = deq_pad
+        index.ov_ids_host[pos] = ids.astype(np.int32)
+        index.ov_norms_host[pos] = nrm.astype(np.float32)
+    for i, item in enumerate(ids):
+        item = int(item)
+        ov_map[item] = int(pos[i])
+        if item < len(index.id_to_slot):
+            index.id_to_slot[item] = -1
+    return dataclasses.replace(
+        index,
+        slot_ids=slot_ids,
+        ov_rows=ov_rows,
+        ov_ids=ov_ids,
+        ov_norms=ov_norms,
+        n_items=max(count, index.n_items),
+        ov_used=used + fresh,
+    )
+
+
+def capacity(index: IVFIndex) -> int:
+    """Rows the handle can represent without a rebuild: the built catalog
+    plus whatever overlay slots remain for appended items."""
+    return index.n_items + (index.ov_rows.shape[0] - index.ov_used)

@@ -739,6 +739,25 @@ def _xla_streaming_topk_impl(
     )
 
 
+def _gathered_pair_scores(
+    mat_t, resid, scales, resid_scales, norms, q, qn, iid, *, cosine
+):
+    """Exact ~14-bit two-plane scores for an explicit candidate column set
+    ``iid`` [b, m]: gather BOTH int8 planes for just those columns and
+    combine ``d1*s1 + d2*s2``. Shared by the chunked scan's candidate tail
+    and the IVF tier's probed-cell scan (ops/ivf.py) — sharing the exact
+    arithmetic (same gather layout, same einsum contraction) is what lets
+    a full-probe IVF scan reproduce the exact path's scores bit-for-bit."""
+    c1 = jnp.take(mat_t, iid, axis=1).astype(jnp.float32)  # [kf, b, m]
+    c2 = jnp.take(resid, iid, axis=1).astype(jnp.float32)
+    d1 = jnp.einsum("bf,fbm->bm", q, c1, precision=jax.lax.Precision.HIGHEST)
+    d2 = jnp.einsum("bf,fbm->bm", q, c2, precision=jax.lax.Precision.HIGHEST)
+    sc = d1 * scales[0][iid] + d2 * resid_scales[0][iid]
+    if cosine:
+        sc = sc / jnp.maximum(norms[0][iid] * qn, 1e-12)
+    return sc
+
+
 def _chunk_tail(
     mat_t, resid, scales, resid_scales, norms, q, qn, poolv, pooli, *,
     k, kc, n_items, cosine,
@@ -756,13 +775,9 @@ def _chunk_tail(
     iid = (
         cid[:, :, None] * _CHUNK + jnp.arange(_CHUNK, dtype=jnp.int32)[None, None, :]
     ).reshape(b, mc * _CHUNK)
-    c1 = jnp.take(mat_t, iid, axis=1).astype(jnp.float32)  # [kf, b, mc*_CHUNK]
-    c2 = jnp.take(resid, iid, axis=1).astype(jnp.float32)
-    d1 = jnp.einsum("bf,fbm->bm", q, c1, precision=jax.lax.Precision.HIGHEST)
-    d2 = jnp.einsum("bf,fbm->bm", q, c2, precision=jax.lax.Precision.HIGHEST)
-    sc = d1 * scales[0][iid] + d2 * resid_scales[0][iid]
-    if cosine:
-        sc = sc / jnp.maximum(norms[0][iid] * qn, 1e-12)
+    sc = _gathered_pair_scores(
+        mat_t, resid, scales, resid_scales, norms, q, qn, iid, cosine=cosine
+    )
     sc = jnp.where(iid < n_items, sc, -jnp.inf)
     v, pos = jax.lax.top_k(sc, k)
     return v, jnp.take_along_axis(iid, pos, axis=1)

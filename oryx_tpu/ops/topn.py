@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from oryx_tpu.ops import ivf as ivf_ops
+from oryx_tpu.ops.ivf import IVFIndex
 from oryx_tpu.ops.pallas_topn import (
     StreamingItemMatrix,
     _is_int8,
@@ -118,6 +120,9 @@ def _dot_topk_batch(mat, norms, queries, k, cosine, download_dtype=None):
 
 def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
     """(indices, scores) of the k best items for one query vector."""
+    if isinstance(uploaded, IVFIndex):
+        idx, vals = ivf_ops.top_k(uploaded, query, k, cosine=cosine)
+        return idx[0], vals[0]
     if isinstance(uploaded, StreamingItemMatrix):
         idx, vals = top_k_streaming(uploaded, query, k, cosine=cosine)
         return idx[0], vals[0]
@@ -133,6 +138,8 @@ def top_k_scores(uploaded, query: np.ndarray, k: int, cosine: bool = False):
 
 def top_k_scores_batch(uploaded, queries: np.ndarray, k: int, cosine: bool = False):
     """Batched top-k for [b, k] query vectors (concurrent requests)."""
+    if isinstance(uploaded, IVFIndex):
+        return ivf_ops.top_k(uploaded, queries, k, cosine=cosine)
     if isinstance(uploaded, StreamingItemMatrix):
         return top_k_streaming(uploaded, queries, k, cosine=cosine)
     mat, norms = uploaded
@@ -361,7 +368,12 @@ def _scatter_rows_t_q(
 
 def capacity(uploaded) -> int:
     """Row capacity of the handle (padding included); rows beyond
-    ``n_items`` can be appended in place on the streaming layout."""
+    ``n_items`` can be appended in place on the streaming layout. For an
+    IVF handle it is the built catalog plus the free overlay slots —
+    overflow forces a rebuild, which is exactly when the routing table
+    should be refreshed anyway."""
+    if isinstance(uploaded, IVFIndex):
+        return ivf_ops.capacity(uploaded)
     if isinstance(uploaded, StreamingItemMatrix):
         return uploaded.mat_t.shape[1]
     mat, _ = uploaded
@@ -379,6 +391,11 @@ def update_rows(uploaded, rows: np.ndarray, values: np.ndarray, n_items: int | N
     The row-count is bucketed to a power of two (padding repeats the last
     row) so jit retraces O(log n) scatter shapes, not one per batch size.
     """
+    if isinstance(uploaded, IVFIndex):
+        # IVF fold-ins route through the pending overlay (scanned exactly
+        # by every query); IVFOverlayFull propagates so the caller can
+        # fall back to a full rebuild
+        return ivf_ops.update_rows(uploaded, rows, values, n_items=n_items)
     rows = np.asarray(rows, dtype=np.int32)
     values = np.ascontiguousarray(values, dtype=np.float32)
     m = len(rows)
@@ -509,6 +526,11 @@ def submit_top_k_multi(
     scans/s regardless of batch size) into a bandwidth/MXU-bound one.
     scan_batch bounds per-scan VMEM ([scan_batch, BLOCK_N] f32 scores)."""
     q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+    if isinstance(uploaded, IVFIndex):
+        # the IVF program does its own QUERY_BLOCK grouping (lax.map over
+        # groups inside one dispatch), so the whole batch submits at once
+        vals, ids = ivf_ops.top_k_device(uploaded, q, k, cosine=cosine)
+        return _async_multi_handle(vals[None], ids[None], q.shape[0])
     q_kb, n = _group_pad(q, scan_batch)
     dl = _auto_download_dtype(uploaded)
     if isinstance(uploaded, StreamingItemMatrix):
@@ -680,6 +702,11 @@ def submit_top_k_multi_indexed(
     /recommend then resolves the user id to a row index and never uploads
     a vector at all."""
     idx = np.atleast_1d(np.asarray(indices, dtype=np.int32))
+    if isinstance(uploaded, IVFIndex):
+        vals, ids = ivf_ops.top_k_device_indexed(
+            uploaded, x_dev, idx, k, cosine=cosine
+        )
+        return _async_multi_handle(vals[None], ids[None], len(idx))
     idx_kb_np, n = _group_pad(idx, scan_batch)
     idx_kb = jnp.asarray(idx_kb_np)
     dl = _auto_download_dtype(uploaded)
@@ -702,6 +729,16 @@ def submit_top_k(
     """Enqueue a batched top-k without waiting: device compute and the
     device→host copy both run asynchronously. Keeping a window of
     handles in flight pipelines transfers behind compute."""
+    if isinstance(uploaded, IVFIndex):
+        vals, ids = ivf_ops.top_k_device(
+            uploaded, np.atleast_2d(queries), k, cosine=cosine
+        )
+        try:
+            vals.copy_to_host_async()
+            ids.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - older array types
+            pass
+        return TopNHandle(vals, ids)
     dl = _auto_download_dtype(uploaded)
     if isinstance(uploaded, StreamingItemMatrix):
         vals, idxs = top_k_streaming_device(

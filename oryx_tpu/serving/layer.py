@@ -380,6 +380,27 @@ class ServingLayer:
             chunk=config.get_optional_int("oryx.serving.scan.chunk"),
             block=config.get_optional_int("oryx.serving.scan.block"),
         )
+        from oryx_tpu.ops.ivf import configure_ann
+
+        configure_ann(
+            enabled=config.get_optional_bool("oryx.serving.scan.ann.enabled"),
+            cells=config.get_optional_int("oryx.serving.scan.ann.cells"),
+            nprobe=config.get_optional_int("oryx.serving.scan.ann.nprobe"),
+            probe_fraction=config.get_optional_float(
+                "oryx.serving.scan.ann.probe-fraction"
+            ),
+            min_items=config.get_optional_int("oryx.serving.scan.ann.min-items"),
+            overlay_capacity=config.get_optional_int(
+                "oryx.serving.scan.ann.overlay-capacity"
+            ),
+            query_block=config.get_optional_int("oryx.serving.scan.ann.query-block"),
+            tile_chunks=config.get_optional_int("oryx.serving.scan.ann.tile-chunks"),
+            host_stage1={"true": True, "false": False}.get(
+                str(
+                    config.get_optional_string("oryx.serving.scan.ann.host-stage1")
+                ).lower()
+            ),
+        )
 
         self.model_manager = None
         self.input_producer = None

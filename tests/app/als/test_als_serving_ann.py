@@ -1,0 +1,158 @@
+"""ANN tier engagement at the serving level: the int8 scan upgrades to
+an IVF index when the catalog crosses `min-items`, speed-layer fold-ins
+stay visible through the index's pending overlay (the update-visibility
+regression the ANN tier must never reintroduce), overlay exhaustion
+degrades to a full re-cluster instead of an error, and the
+`oryx.serving.scan.ann.*` config block actually reaches the knobs."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.common import config as C
+from oryx_tpu.ops import ivf as ivf_ops
+
+
+@pytest.fixture(autouse=True)
+def _restore_ann_knobs():
+    snap = (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    )
+    yield
+    (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    ) = snap
+
+
+def _model(n_items=600, f=8, seed=0):
+    gen = np.random.default_rng(seed)
+    m = ALSServingModel(f, implicit=True, refresh_sec=0.0, score_dtype="int8")
+    m.set_item_vectors(
+        [f"i{j}" for j in range(n_items)],
+        gen.standard_normal((n_items, f)).astype(np.float32),
+    )
+    return m
+
+
+def test_ann_engages_above_min_items():
+    ivf_ops.configure_ann(enabled=True, min_items=500, cells=16, nprobe=16)
+    m = _model(600)
+    q = np.zeros(8, np.float32)
+    q[0] = 1.0
+    res = m.top_n(q, 5)
+    assert len(res) == 5
+    assert isinstance(m._ensure_y_matrix()[2], ivf_ops.IVFIndex)
+    # exact parity at full probe: the ANN answer IS the int8 answer
+    ivf_ops.configure_ann(enabled=False)
+    exact = ALSServingModel(8, implicit=True, refresh_sec=0.0, score_dtype="int8")
+    ids, mats = m.y.to_matrix()
+    exact.set_item_vectors(ids, mats)
+    assert [i for i, _ in res] == [i for i, _ in exact.top_n(q, 5)]
+
+
+def test_ann_stays_off_below_min_items():
+    ivf_ops.configure_ann(enabled=True, min_items=10_000, cells=16)
+    m = _model(600)
+    m.top_n(np.ones(8, np.float32), 3)
+    assert not isinstance(m._ensure_y_matrix()[2], ivf_ops.IVFIndex)
+
+
+def test_speed_layer_folds_stay_visible():
+    """The regression the overlay exists for: a fold-in arriving AFTER the
+    IVF rebuild must show up in the very next query, reassigned exactly
+    (overlay rows are scanned with full-precision scores, never routed
+    through possibly-stale cells)."""
+    ivf_ops.configure_ann(enabled=True, min_items=500, cells=16, nprobe=4)
+    m = _model(600)
+    q = np.zeros(8, np.float32)
+    q[0] = 1.0
+    m.top_n(q, 3)  # builds the IVF index
+    index = m._ensure_y_matrix()[2]
+    assert isinstance(index, ivf_ops.IVFIndex)
+    # brand-new item (speed-layer fold-in): lands in the pending overlay
+    m.set_item_vector("hot-new", (25.0 * q).astype(np.float32))
+    res = m.top_n(q, 3)
+    assert res[0][0] == "hot-new"
+    after = m._ensure_y_matrix()[2]
+    assert after is not index or after.ov_used > 0  # overlay, not rebuild
+    assert isinstance(after, ivf_ops.IVFIndex) and after.ov_used > 0
+    # an UPDATED existing item tombstones its clustered copy: new value
+    # served, old value gone
+    m.set_item_vector("i7", (30.0 * q).astype(np.float32))
+    res = m.top_n(q, 3)
+    assert res[0][0] == "i7"
+    assert [i for i, _ in res].count("i7") == 1
+
+
+def test_overlay_exhaustion_falls_back_to_rebuild():
+    ivf_ops.configure_ann(
+        enabled=True, min_items=500, cells=16, nprobe=16, overlay_capacity=4
+    )
+    m = _model(600)
+    q = np.ones(8, np.float32)
+    m.top_n(q, 3)
+    assert isinstance(m._ensure_y_matrix()[2], ivf_ops.IVFIndex)
+    gen = np.random.default_rng(9)
+    for j in range(6):  # one refresh sees 6 new rows > capacity 4
+        m.set_item_vector(f"new{j}", gen.standard_normal(8).astype(np.float32))
+    m.set_item_vector("winner", (40.0 * q).astype(np.float32))
+    res = m.top_n(q, 3)
+    assert res[0][0] == "winner"
+    rebuilt = m._ensure_y_matrix()[2]
+    assert isinstance(rebuilt, ivf_ops.IVFIndex)
+    assert rebuilt.ov_used == 0  # fresh cluster pass absorbed the folds
+    assert rebuilt.n_items == 607
+
+
+def test_serving_config_block_reaches_knobs():
+    """ServingLayer construction pushes oryx.serving.scan.ann.* into the
+    ops-layer knobs before anything compiles."""
+    from oryx_tpu.serving.layer import ServingLayer
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          input-topic.broker = "inproc://ann-cfg"
+          update-topic.broker = "inproc://ann-cfg"
+          serving {
+            api.port = 0
+            model-manager-class = "oryx_tpu.app.als.serving_model:ALSServingModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+            scan.ann {
+              enabled = true
+              cells = 48
+              nprobe = 5
+              probe-fraction = 0.03
+              min-items = 1234
+              overlay-capacity = 256
+              host-stage1 = false
+            }
+          }
+        }
+        """
+    )
+    ServingLayer(cfg)  # construction alone applies the knobs
+    assert ivf_ops.ANN_ENABLED is True
+    assert ivf_ops.N_CELLS == 48
+    assert ivf_ops.NPROBE == 5
+    assert ivf_ops.PROBE_FRACTION == pytest.approx(0.03)
+    assert ivf_ops.MIN_ITEMS == 1234
+    assert ivf_ops.OVERLAY_CAPACITY == 256
+    assert ivf_ops.HOST_STAGE1 is False
+    assert ivf_ops.ann_active(2000) and not ivf_ops.ann_active(100)

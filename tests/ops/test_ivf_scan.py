@@ -1,0 +1,296 @@
+"""IVF approximate-retrieval tier: recall, exactness contract, and the
+adversarial geometry the serving scan promises to survive. Tier-1
+`-m scan` suite — small catalogs, CPU XLA, well under a minute.
+
+Recall checks are tie-tolerant like the int8 suite's: a returned item
+counts as a hit when its TRUE (float32) score reaches the true k-th best
+minus 1e-5. The full-probe contract is stricter: with nprobe == n_cells
+the ANN path must reproduce the exact int8 scan's top-N BIT-FOR-BIT
+(ids and values), because every candidate rescoring through the shared
+two-plane epilogue in ascending-id order is definitionally the same
+computation.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from oryx_tpu.ops import ivf as ivf_ops
+from oryx_tpu.ops import pallas_topn as pt
+from oryx_tpu.ops import topn as topn_ops
+
+pytestmark = pytest.mark.scan
+
+K = 10
+TIE_TOL = 1e-5
+
+
+@pytest.fixture(autouse=True)
+def _restore_ann_knobs():
+    """configure_ann mutates module globals; leave no test residue."""
+    snap = (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    )
+    yield
+    (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    ) = snap
+
+
+def _recall(mat, queries, idx, k=K, tol=TIE_TOL):
+    """Tie-tolerant recall@k of returned indices vs the exact ranking."""
+    ref = queries @ mat.T
+    hits = 0
+    for r in range(len(queries)):
+        kth = np.partition(ref[r], -k)[-k]
+        rows = np.asarray(idx[r])
+        rows = rows[rows >= 0]
+        hits += int(np.sum(ref[r][rows] >= kth - tol))
+    return hits / (len(queries) * k)
+
+
+def _clustered_case(n=20_000, f=32, b=16, n_centers=64, seed=0, spread=0.3):
+    """Mixture data with queries drawn near the same centers — the
+    catalog geometry IVF assumes (and real factor matrices exhibit)."""
+    gen = np.random.default_rng(seed)
+    centers = gen.standard_normal((n_centers, f)).astype(np.float32)
+    mat = (
+        centers[gen.integers(0, n_centers, n)]
+        + spread * gen.standard_normal((n, f)).astype(np.float32)
+    )
+    queries = (
+        centers[gen.integers(0, n_centers, b)]
+        + spread * gen.standard_normal((b, f)).astype(np.float32)
+    )
+    return mat, queries
+
+
+def test_ivf_recall_seeded():
+    mat, queries = _clustered_case()
+    index = ivf_ops.build_ivf(mat, n_cells=64, seed=1)
+    idx, _vals = ivf_ops.top_k(index, queries, K, nprobe=8)
+    assert _recall(mat, queries, idx) >= 0.95
+
+
+def test_ivf_recall_cosine():
+    mat, queries = _clustered_case(seed=3)
+    index = ivf_ops.build_ivf(mat, n_cells=64, seed=1)
+    idx, _vals = ivf_ops.top_k(index, queries, K, nprobe=8, cosine=True)
+    norms = np.linalg.norm(mat, axis=1)
+    qn = np.linalg.norm(queries, axis=1)
+    ref = (queries @ mat.T) / np.maximum(norms[None, :] * qn[:, None], 1e-12)
+    hits = 0
+    for r in range(len(queries)):
+        kth = np.partition(ref[r], -K)[-K]
+        rows = np.asarray(idx[r])
+        hits += int(np.sum(ref[r][rows[rows >= 0]] >= kth - 1e-6))
+    assert hits / (len(queries) * K) >= 0.95
+
+
+def _exact_int8(mat, queries, k, cosine=False):
+    """The exact int8 scan with its chunk-max prefilter disabled (every
+    chunk rescored). The production prefilter ranks chunks by COARSE
+    plane max and oversamples 1.25x — a heuristic that can drop a tail
+    item whose residual lifts it past a coarser rival, so the bit-for-bit
+    contract is against the truly exact scan, which shares the rescore
+    epilogue and ascending-id tie direction with the ANN full probe."""
+    old = pt.CHUNK_OVERSAMPLE
+    try:
+        pt.CHUNK_OVERSAMPLE = 1e9  # _chunk_k clamps to the chunk count
+        up = pt.upload_streaming(mat, dtype=jnp.int8)
+        vals, idx = pt.top_k_streaming_device(up, queries, k=k, cosine=cosine)
+        return np.asarray(vals), np.asarray(idx)
+    finally:
+        pt.CHUNK_OVERSAMPLE = old
+
+
+def test_full_probe_reproduces_exact_scan_bit_for_bit():
+    """nprobe == n_cells is the exactness contract: identical ids AND
+    identical f32 score bits vs the exact int8 two-plane scan."""
+    mat, queries = _clustered_case(n=20_000, f=32, b=16, seed=5)
+    evals, eidx = _exact_int8(mat, queries, K)
+    index = ivf_ops.build_ivf(mat, n_cells=64, seed=1)
+    aidx, avals = ivf_ops.top_k(index, queries, K, nprobe=index.n_cells)
+    assert np.array_equal(eidx, aidx)
+    assert np.array_equal(evals, avals)
+
+
+def test_full_probe_bit_for_bit_cosine():
+    mat, queries = _clustered_case(n=12_000, f=48, b=8, seed=6)
+    evals, eidx = _exact_int8(mat, queries, K, cosine=True)
+    index = ivf_ops.build_ivf(mat, n_cells=32, seed=2)
+    aidx, avals = ivf_ops.top_k(index, queries, K, nprobe=index.n_cells, cosine=True)
+    assert np.array_equal(eidx, aidx)
+    assert np.array_equal(evals, avals)
+
+
+def test_near_ties_straddling_cell_boundaries():
+    """A near-tie cohort plus a band of clear winners, deliberately
+    scattered so k-means splits them across cells: the probed scan must
+    still return only winners (every returned item's true score within
+    tie tolerance of the k-th winner), never a cohort member that beat a
+    winner by quantization luck."""
+    gen = np.random.default_rng(7)
+    n, f = 16_000, 32
+    base = gen.standard_normal(f).astype(np.float32)
+    base /= np.linalg.norm(base)
+    mat = np.tile(base, (n, 1)).astype(np.float32)
+    # orthogonal jitter: scores against base-aligned queries untouched,
+    # but rows land all over the k-means cells
+    jit = gen.standard_normal((n, f)).astype(np.float32) * 0.35
+    jit -= np.outer(jit @ base, base)
+    mat += jit
+    winners = gen.choice(n, 40, replace=False)
+    mat[winners] += base  # double the base component: clearly ahead
+    queries = np.tile(base, (8, 1)).astype(np.float32)
+    index = ivf_ops.build_ivf(mat, n_cells=16, seed=3)
+    idx, _vals = ivf_ops.top_k(index, queries, K, nprobe=6)
+    ref = queries @ mat.T
+    for r in range(len(queries)):
+        kth = np.partition(ref[r], -K)[-K]
+        rows = np.asarray(idx[r])
+        assert (rows >= 0).all()
+        assert (ref[r][rows] >= kth - TIE_TOL).all()
+        assert len(set(rows.tolist())) == K  # no duplicates across cells
+
+
+def test_empty_cells_are_harmless():
+    """More cells than natural clusters: many cells end up empty, and
+    probe lists that select them must neither crash nor pad results with
+    another cell's items."""
+    gen = np.random.default_rng(11)
+    f = 16
+    blob_a = gen.standard_normal(f).astype(np.float32)
+    blob_b = gen.standard_normal(f).astype(np.float32)
+    # exact duplicates: every copy of a blob routes to the same nearest
+    # centroid, so at most two of the 32 cells can be occupied
+    mat = np.concatenate(
+        [np.tile(blob_a, (1500, 1)), np.tile(blob_b, (1500, 1))]
+    ).astype(np.float32)
+    index = ivf_ops.build_ivf(mat, n_cells=32, seed=4)
+    assert int((index.chunk_count_host == 0).sum()) > 0  # empties exist
+    queries = np.stack([blob_a, blob_b]).astype(np.float32)
+    idx, _vals = ivf_ops.top_k(index, queries, K, nprobe=8)
+    assert _recall(mat, queries, idx) >= 0.95
+    for r in range(2):
+        rows = np.asarray(idx[r])
+        rows = rows[rows >= 0]
+        assert len(set(rows.tolist())) == len(rows)
+    # all-empty probe windows starve gracefully: k beyond catalog pads -1
+    tiny = ivf_ops.build_ivf(mat[:4], n_cells=2, seed=4)
+    idx, vals = ivf_ops.top_k(tiny, queries[:1], 8, nprobe=1)
+    assert (np.asarray(idx)[np.asarray(vals) == -np.inf] == -1).all()
+
+
+def test_power_law_skewed_cells():
+    """Zipf-sized clusters (one giant cell, a long tail of dwarfs): the
+    tile layout must stay sound and recall must hold when most probes
+    land in the giant."""
+    gen = np.random.default_rng(13)
+    f, n_centers = 24, 40
+    sizes = (8000 / np.arange(1, n_centers + 1) ** 1.2).astype(int) + 1
+    centers = gen.standard_normal((n_centers, f)).astype(np.float32) * 2.0
+    mat = np.concatenate(
+        [
+            centers[i] + 0.25 * gen.standard_normal((s, f)).astype(np.float32)
+            for i, s in enumerate(sizes)
+        ]
+    ).astype(np.float32)
+    queries = (
+        centers[gen.integers(0, n_centers, 12)]
+        + 0.25 * gen.standard_normal((12, f)).astype(np.float32)
+    )
+    index = ivf_ops.build_ivf(mat, n_cells=n_centers, seed=5)
+    counts = np.asarray(index.chunk_count_host)
+    assert counts.max() >= 8 * max(1, np.median(counts))  # skew is real
+    idx, _vals = ivf_ops.top_k(index, queries, K, nprobe=6)
+    assert _recall(mat, queries, idx) >= 0.95
+
+
+def test_update_rows_visible_through_ann():
+    """Speed-layer fold-in regression: a touched row must be visible to
+    the very next ANN query (pending overlay), and its score must match
+    a fresh rebuild's quantized score to f32 rounding."""
+    mat, queries = _clustered_case(n=8_000, f=32, b=4, seed=17)
+    index = ivf_ops.build_ivf(mat, n_cells=32, seed=6)
+    target = np.asarray(queries[0], dtype=np.float32)
+    # 3x the query itself: dot 3|q|^2 clears every catalog item (whose
+    # best case is ~|q|^2 from a same-cluster neighbour)
+    newrow = (3.0 * target).astype(np.float32)
+    index = ivf_ops.update_rows(index, np.array([4321]), newrow[None, :])
+    idx, vals = ivf_ops.top_k(index, queries[:1], K, nprobe=4)
+    assert int(idx[0, 0]) == 4321
+    # requantize parity: overlay score == fresh-rebuild quantized score
+    mat2 = mat.copy()
+    mat2[4321] = newrow
+    rebuilt = ivf_ops.build_ivf(mat2, n_cells=32, seed=6)
+    idx2, vals2 = ivf_ops.top_k(rebuilt, queries[:1], K, nprobe=rebuilt.n_cells)
+    pos = list(np.asarray(idx2[0])).index(4321)
+    assert abs(float(vals[0, 0]) - float(vals2[0, pos])) <= 1e-4 * max(
+        1.0, abs(float(vals2[0, pos]))
+    )
+    # the tombstoned copy never resurfaces next to the overlay row
+    assert list(np.asarray(idx[0])).count(4321) == 1
+
+
+def test_overlay_overflow_raises():
+    mat, _ = _clustered_case(n=4_000, f=16, b=1, seed=19)
+    index = ivf_ops.build_ivf(mat, n_cells=16, seed=7, overlay_capacity=8)
+    rows = np.arange(8)
+    index = ivf_ops.update_rows(index, rows, mat[rows] + 0.5)
+    with pytest.raises(ivf_ops.IVFOverlayFull):
+        ivf_ops.update_rows(index, np.array([100]), mat[100:101] + 0.5)
+    # rewriting already-overlaid rows needs no fresh slots: still fine
+    ivf_ops.update_rows(index, rows[:4], mat[rows[:4]] + 1.0)
+
+
+def test_host_and_device_stage1_agree():
+    """The host numpy fast path and the device tile path are the same
+    retrieval: identical probed cells, same quantized values — returned
+    ids may only differ on sub-tolerance ties."""
+    mat, queries = _clustered_case(n=12_000, f=32, b=8, seed=23)
+    ivf_ops.configure_ann(host_stage1=True)
+    host_index = ivf_ops.build_ivf(mat, n_cells=32, seed=8)
+    hidx, _ = ivf_ops.top_k(host_index, queries, K, nprobe=6)
+    ivf_ops.configure_ann(host_stage1=False)
+    dev_index = ivf_ops.build_ivf(mat, n_cells=32, seed=8)
+    assert dev_index.host_plane is None
+    didx, _ = ivf_ops.top_k(dev_index, queries, K, nprobe=6)
+    ref = queries @ mat.T
+    for r in range(len(queries)):
+        kth = np.partition(ref[r], -K)[-K]
+        for rows in (np.asarray(hidx[r]), np.asarray(didx[r])):
+            rows = rows[rows >= 0]
+            assert (ref[r][rows] >= kth - TIE_TOL).all()
+
+
+def test_topn_facade_dispatches_ivf():
+    """ops.topn's isinstance(IVFIndex) branches: scores, batch, update,
+    capacity — the serving layer only ever talks to the facade."""
+    mat, queries = _clustered_case(n=8_000, f=32, b=4, seed=29)
+    index = ivf_ops.build_ivf(mat, n_cells=32, seed=9)
+    ivf_ops.configure_ann(nprobe=8)  # facade reads the module knob
+    ids, vals = topn_ops.top_k_scores(index, queries[0], K)
+    assert len(ids) == K and len(vals) == K
+    bidx, _bvals = topn_ops.top_k_scores_batch(index, queries, K)
+    assert _recall(mat, queries, bidx, k=K) >= 0.9
+    assert topn_ops.capacity(index) >= len(mat)
+    out = topn_ops.update_rows(index, np.array([7]), mat[7:8] * 2.0)
+    assert isinstance(out, ivf_ops.IVFIndex)

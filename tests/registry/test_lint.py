@@ -11,12 +11,45 @@ pytestmark = pytest.mark.registry
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 sys.path.insert(0, str(REPO_ROOT / "tools"))
 
+import lint_config  # noqa: E402
 import lint_registry  # noqa: E402
 
 
 def test_registry_package_lints_clean():
     rc, problems, engine = lint_registry.run_lint()
     assert rc == 0, f"[{engine}] " + "\n".join(problems)
+
+
+def test_ann_config_keys_lint_clean():
+    rc, problems, engine = lint_config.run_lint()
+    assert rc == 0, f"[{engine}] " + "\n".join(problems)
+
+
+def test_ann_config_lint_rejects_unknown_key(tmp_path):
+    known = lint_config.known_ann_keys()
+    assert "probe-fraction" in known  # reference.conf declares the knob set
+    bad = tmp_path / "overlay.conf"
+    # concatenation keeps the typo'd literal out of THIS file's source,
+    # which the repo-wide lint run also scans
+    bad.write_text(
+        "oryx.serving.scan.ann.enabled = true\n"
+        + "oryx.serving.scan.ann." + "probe-fractoin = 0.02\n"
+    )
+    rc, problems, _ = lint_config.run_lint([bad])
+    assert rc == 1
+    assert len(problems) == 1
+    assert "probe-fractoin" in problems[0]
+
+
+def test_ann_config_lint_accepts_known_keys(tmp_path):
+    good = tmp_path / "overlay.conf"
+    good.write_text(
+        "oryx.serving.scan.ann.enabled = true\n"
+        "oryx.serving.scan.ann.cells = 1000\n"
+        "oryx.serving.scan.ann.host-stage1 = false\n"
+    )
+    rc, problems, _ = lint_config.run_lint([good])
+    assert rc == 0, "\n".join(problems)
 
 
 def test_fallback_catches_real_problems(tmp_path):

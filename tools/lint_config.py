@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Config-key lint for the serving scan's ANN tier, wired into tier-1.
+
+A mistyped `oryx.serving.scan.ann.*` key fails SILENTLY: the HOCON
+overlay accepts any path, the serving layer only reads the keys it
+knows, and the operator ships with the exact scan still on — the worst
+kind of perf regression (nothing breaks, everything is just 10x slower
+than provisioned). Sibling of tools/lint_registry.py: the lint walks the
+repo's Python and conf sources for ANN key references and rejects any
+key that reference.conf's `oryx.serving.scan.ann` block (the single
+source of truth for the knob set) does not declare.
+
+Usage: python tools/lint_config.py [path ...]   (default: repo sources)
+Exit code 0 = clean.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+ANN_PREFIX = "oryx.serving.scan.ann"
+DEFAULT_TARGETS = [
+    REPO_ROOT / "oryx_tpu",
+    REPO_ROOT / "tools",
+    REPO_ROOT / "tests",
+    REPO_ROOT / "docs",
+]
+
+# dotted reference in code/docs/conf: oryx.serving.scan.ann.<key>
+_DOTTED = re.compile(r"oryx\.serving\.scan\.ann\.([A-Za-z0-9][A-Za-z0-9-]*)")
+
+
+def known_ann_keys() -> set[str]:
+    """The knob set reference.conf declares under oryx.serving.scan.ann."""
+    sys.path.insert(0, str(REPO_ROOT))
+    from oryx_tpu.common import config as C
+
+    block = C.get_default().get_config(ANN_PREFIX)
+    return set(block.as_dict().keys())
+
+
+def _iter_source_files(paths: list[Path]):
+    for p in paths:
+        if p.is_dir():
+            for ext in ("*.py", "*.conf", "*.md"):
+                yield from sorted(p.rglob(ext))
+        elif p.suffix in (".py", ".conf", ".md"):
+            yield p
+
+
+def _lint_file(path: Path, known: set[str]) -> list[str]:
+    problems: list[str] = []
+    try:
+        text = path.read_text(encoding="utf-8")
+    except OSError as e:  # unreadable file: surface, don't crash the gate
+        return [f"{path}: unreadable: {e}"]
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for m in _DOTTED.finditer(line):
+            key = m.group(1)
+            if key not in known:
+                problems.append(
+                    f"{path}:{lineno}: unknown ANN config key "
+                    f"{ANN_PREFIX}.{key!r} (declared: {', '.join(sorted(known))})"
+                )
+    return problems
+
+
+def run_lint(paths: list[Path] | None = None) -> tuple[int, list[str], str]:
+    """Returns (exit code, problem lines, engine used) — the same shape
+    as lint_registry.run_lint so the tier-1 tests share one idiom."""
+    paths = paths or DEFAULT_TARGETS
+    known = known_ann_keys()
+    problems: list[str] = []
+    for f in _iter_source_files(paths):
+        if f.resolve() == Path(__file__).resolve():
+            continue  # the lint's own docstring/regex isn't a reference
+        problems.extend(_lint_file(f, known))
+    return (1 if problems else 0), problems, "ann-config-keys"
+
+
+def main(argv: list[str]) -> int:
+    paths = [Path(a) for a in argv] or None
+    rc, problems, engine = run_lint(paths)
+    for line in problems:
+        print(line)
+    print(
+        f"lint_config [{engine}]: "
+        f"{'clean' if rc == 0 else f'{len(problems)} problem(s)'}"
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -94,6 +94,14 @@ def main() -> None:
         help="LSH sample rate (oryx.test.als.benchmark.lshSampleRate "
         "analogue); < 1 switches to the LSH-pruned path, 1.0 = exact scan",
     )
+    ap.add_argument(
+        "--ann", action="store_true",
+        help="serve through the IVF ANN tier instead of LSH: --lsh "
+        "doubles as the probe fraction (the reference's sampleRate is "
+        "'fraction of the catalog each query scans', which is exactly "
+        "oryx.serving.scan.ann.probe-fraction); needs --items >= the "
+        "ann.min-items floor to actually engage",
+    )
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
 
@@ -101,24 +109,42 @@ def main() -> None:
     from oryx_tpu.serving.layer import ServingLayer
     from tools.traffic import report, worker
 
+    # --ann maps the reference's sampleRate knob onto the IVF tier: the
+    # probe fraction plays the same "scan this fraction of the catalog"
+    # role, pushed through the real config path so ServingLayer's
+    # configure_ann wiring is what the benchmark exercises
+    ann_block = (
+        f"scan.ann {{ enabled = true, probe-fraction = {args.lsh} }}"
+        if args.ann
+        else ""
+    )
     cfg = C.get_default().with_overlay(
-        """
-        oryx {
+        f"""
+        oryx {{
           id = "LoadBench"
           input-topic.broker = "inproc://loadbench"
           update-topic.broker = "inproc://loadbench"
-          serving {
+          serving {{
             api.port = 0
             api.read-only = true
             model-manager-class = "tools.load_benchmark:LoadTestModelManager"
             application-resources = "oryx_tpu.app.als.endpoints"
-          }
-        }
+            {ann_block}
+          }}
+        }}
         """
     )
 
     t0 = time.perf_counter()
-    model = build_model(args.users, args.items, args.features, lsh_sample_rate=args.lsh)
+    model = build_model(
+        args.users,
+        args.items,
+        args.features,
+        # ANN and LSH are exclusive pruning tiers: with --ann the model
+        # stays on the quantized scan (sample_rate 1.0) and the serving
+        # upload builds the IVF index instead
+        lsh_sample_rate=1.0 if args.ann else args.lsh,
+    )
     print(f"model built in {time.perf_counter() - t0:.1f}s", flush=True)
 
     layer = ServingLayer(cfg)
@@ -166,7 +192,8 @@ def main() -> None:
             with open(args.out, "a", encoding="utf-8") as f:
                 f.write(
                     f"=== load_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===\n"
-                    f"{args.users}u x {args.items}i x {args.features}f, lsh {args.lsh}, "
+                    f"{args.users}u x {args.items}i x {args.features}f, "
+                    f"{'ann probe-fraction' if args.ann else 'lsh'} {args.lsh}, "
                     f"{args.workers} workers x {args.seconds:.0f}s, backend "
                     f"{jax.default_backend()}/"
                     f"{getattr(jax.devices()[0], 'device_kind', '?')}\n"
