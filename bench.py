@@ -1005,6 +1005,104 @@ def bench_serving_closed_loop() -> None:
         layer.close()
 
 
+def bench_serving_open_loop() -> None:
+    """OPEN-loop serving rows: arrivals fire on their own Poisson clock
+    regardless of outstanding responses, so offered vs achieved rate and
+    queue-inclusive p99 are measured the way production traffic would
+    experience them (closed-loop rows above can never show queueing —
+    the generator slows down with the server). Three rows: steady state
+    at 1 and 3 replicas, then the rotation row — a scripted generation
+    publish + chaos window + rollback mid-run at a held offered rate,
+    with the failed-request count in the row (0 = zero-downtime held)."""
+    import tempfile
+
+    from oryx_tpu.loadgen import OpenLoopEngine, PoissonProcess, PowerLawUsers
+    from tools.fleet import FleetHarness, default_scenario, run_scenario
+
+    rate = float(os.environ.get("ORYX_BENCH_OL_RATE", 150.0))
+    seconds = float(os.environ.get("ORYX_BENCH_OL_SECONDS", 6.0))
+    n_users = int(os.environ.get("ORYX_BENCH_OL_USERS", 2_000_000))
+
+    for replicas, order in ((1, 96), (3, 97)):
+        with tempfile.TemporaryDirectory() as tmp:
+            with FleetHarness(replicas, tmp, bus_name=f"benchol{replicas}") as fleet:
+                first = fleet.publish(metric=0.90)
+                if not fleet.wait_converged(first, timeout=30.0):
+                    raise RuntimeError("open-loop bench: fleet never converged")
+                engine = OpenLoopEngine(fleet.targets, template="/probe/recommend/u%d")
+                result = engine.run(
+                    PoissonProcess(rate=rate, seed=7),
+                    PowerLawUsers(n_users, exponent=1.1, hot_count=16,
+                                  hot_weight=0.2, seed=7),
+                    seconds,
+                )
+        s = result.summary()
+        detail = (
+            f"open-loop Poisson {s['offered_rate']:.0f} rps offered over "
+            f"{seconds:.0f}s, {replicas} replica(s): achieved "
+            f"{s['achieved_rate']:.0f} rps, p50 {s['p50_ms']:.1f} ms / "
+            f"queue-inclusive p99 {s['p99_ms']:.1f} ms (service p99 "
+            f"{s['service_p99_ms']:.1f} ms), {s['failed']} failed, "
+            f"{s['queued_arrivals']} queued arrivals"
+        )
+        print(f"bench[serving-open {replicas}r]: {detail}", file=sys.stderr)
+        _emit(
+            f"open-loop serving, {replicas} replica(s), Poisson "
+            f"{rate:.0f} rps offered, power-law users (achieved rate; "
+            f"vs_baseline = achieved/offered, 1.0 = kept up)",
+            s["achieved_rate"],
+            "requests/sec",
+            s["achieved_rate"] / max(s["offered_rate"], 1e-9),
+            order=order,
+            detail=detail,
+            p50_ms=s["p50_ms"],
+            p99_ms=s["p99_ms"],
+            service_p99_ms=s["service_p99_ms"],
+            offered_rate=s["offered_rate"],
+            failed=s["failed"],
+            queued_arrivals=s["queued_arrivals"],
+            replicas=replicas,
+        )
+
+    # rotation under load: publish + chaos + rollback mid-run, 3 replicas
+    with tempfile.TemporaryDirectory() as tmp:
+        with FleetHarness(3, tmp, bus_name="bencholrot") as fleet:
+            first = fleet.publish(metric=0.90)
+            if not fleet.wait_converged(first, timeout=30.0):
+                raise RuntimeError("open-loop bench: fleet never converged")
+            scenario = default_scenario(rate=rate, seconds=max(seconds, 8.0))
+            result, verdict, _runner = run_scenario(fleet, scenario)
+            converged = fleet.wait_converged(fleet.generations[-1], timeout=15.0)
+    s = result.summary()
+    detail = (
+        f"generation rotation under load (publish + chaos window + "
+        f"rollback mid-run, 3 replicas, {s['offered_rate']:.0f} rps "
+        f"offered): achieved {s['achieved_rate']:.0f} rps, p99 "
+        f"{s['p99_ms']:.1f} ms, {s['failed']} failed request(s), SLO "
+        f"{'PASS' if verdict.passed else 'FAIL ' + '; '.join(verdict.violations)}, "
+        f"fleet {'re-converged' if converged else 'DID NOT re-converge'}"
+    )
+    print(f"bench[serving-open rotation]: {detail}", file=sys.stderr)
+    _emit(
+        "open-loop rotation-under-load, 3 replicas: publish + chaos + "
+        "rollback mid-run at held offered rate (achieved rate; "
+        "vs_baseline = achieved/offered with zero failures required)",
+        s["achieved_rate"],
+        "requests/sec",
+        (s["achieved_rate"] / max(s["offered_rate"], 1e-9))
+        if s["failed"] == 0 and verdict.passed
+        else 0.0,
+        order=98,
+        detail=detail,
+        p99_ms=s["p99_ms"],
+        offered_rate=s["offered_rate"],
+        failed=s["failed"],
+        slo_passed=verdict.passed,
+        converged=converged,
+        replicas=3,
+    )
+
+
 BENCHES = [
     ("kmeans", bench_kmeans),
     ("als", bench_als),
@@ -1014,6 +1112,7 @@ BENCHES = [
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),
     ("serving-closed", bench_serving_closed_loop),
+    ("serving-open", bench_serving_open_loop),
     ("serving-250", bench_serving_250),
     ("serving", bench_serving),
 ]

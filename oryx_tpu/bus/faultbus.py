@@ -46,7 +46,15 @@ import numpy as np
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, get_broker
 from oryx_tpu.common import metrics
 
-__all__ = ["FaultBroker", "FaultState", "get_state", "reset", "set_outage"]
+__all__ = [
+    "FaultBroker",
+    "FaultState",
+    "get_state",
+    "reset",
+    "schedule_phases",
+    "set_levers",
+    "set_outage",
+]
 
 _FAULT_KEYS = ("drop", "delay_ms", "dup", "fail_connect", "seed")
 
@@ -70,8 +78,68 @@ class FaultState:
         self.duplicated_records = 0
         self.injected_errors = 0
         self.rolls = 0  # fault-schedule consultations (proof chaos ran)
+        # scenario scripting: timed lever phases applied lazily on the data
+        # path (schedule_phases); empty = static levers from the locator
+        self._phases: list[dict] = []
+        self._phase_t0: float = 0.0
+        self._phase_clock = time.monotonic
+        self.phases_applied = 0
+
+    # -- scenario scripting hooks -------------------------------------------
+
+    def set_levers(
+        self,
+        drop: float | None = None,
+        delay_ms: float | None = None,
+        dup: float | None = None,
+        outage: bool | None = None,
+    ) -> None:
+        """Reset fault levers mid-run (the scripted-scenario control
+        surface; each None leaves that lever untouched)."""
+        with self.lock:
+            if drop is not None:
+                self.drop = float(drop)
+            if delay_ms is not None:
+                self.delay = float(delay_ms) / 1000.0
+            if dup is not None:
+                self.dup = float(dup)
+        if outage is not None:
+            self.outage = bool(outage)
+
+    def schedule_phases(self, phases: list[dict], clock=time.monotonic) -> None:
+        """Arm a timed fault scenario: each phase is a dict with an ``at``
+        offset in seconds (relative to this call) plus any of
+        ``drop`` / ``delay_ms`` / ``dup`` / ``outage``. Phases are applied
+        lazily as the data path consults the fault schedule, so no extra
+        thread is needed and a quiet bus advances no phases. The fleet
+        harness uses this to open and close a chaos window mid-run
+        (tools/fleet.py scenario actions)."""
+        with self.lock:
+            self._phases = sorted((dict(p) for p in phases), key=lambda p: p.get("at", 0.0))
+            self._phase_clock = clock
+            self._phase_t0 = clock()
+
+    def _tick(self) -> None:
+        """Apply any scheduled phases that have come due."""
+        if not self._phases:
+            return
+        due: list[dict] = []
+        with self.lock:
+            elapsed = self._phase_clock() - self._phase_t0
+            while self._phases and self._phases[0].get("at", 0.0) <= elapsed:
+                due.append(self._phases.pop(0))
+        for p in due:
+            self.set_levers(
+                drop=p.get("drop"),
+                delay_ms=p.get("delay_ms"),
+                dup=p.get("dup"),
+                outage=p.get("outage"),
+            )
+            self.phases_applied += 1
+            metrics.registry.counter("bus.fault.phases-applied").inc()
 
     def roll(self) -> float:
+        self._tick()
         with self.lock:
             self.rolls += 1
             return float(self.rng.random())
@@ -84,6 +152,7 @@ class FaultState:
             return False
 
     def check_outage(self, what: str) -> None:
+        self._tick()
         if self.outage:
             self.injected_errors += 1
             metrics.registry.counter("bus.fault.injected-errors").inc()
@@ -138,6 +207,18 @@ def get_state(locator: str) -> "FaultState":
 def set_outage(locator: str, down: bool) -> None:
     """Flip the injected-outage lever for a fault locator."""
     get_state(locator).outage = down
+
+
+def set_levers(locator: str, **levers) -> None:
+    """Reset fault levers (drop / delay_ms / dup / outage) for a locator
+    mid-run — the programmatic scenario control surface."""
+    get_state(locator).set_levers(**levers)
+
+
+def schedule_phases(locator: str, phases: list[dict], clock=time.monotonic) -> None:
+    """Arm a timed chaos scenario on a locator (see
+    FaultState.schedule_phases for the phase dict format)."""
+    get_state(locator).schedule_phases(phases, clock=clock)
 
 
 def reset() -> None:

@@ -13,9 +13,18 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections import deque
 from typing import Any
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry", "timed"]
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SLOWindow",
+    "registry",
+    "timed",
+]
 
 
 class Counter:
@@ -147,6 +156,88 @@ class MetricsRegistry:
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()
+
+
+class SLOWindow:
+    """Sliding-window SLO accounting with burn rates (Google SRE workbook
+    multi-window style): record per-request (ok, latency) events, then ask
+    for the error rate, a latency quantile, or a *burn rate* — the ratio
+    of the observed bad fraction to the fraction the SLO budgets — over
+    any trailing window up to `horizon_s`.
+
+    A burn rate of 1.0 consumes the error budget exactly as fast as the
+    SLO allows; sustained > 1.0 means the budget exhausts early (14.4x
+    over 1h burns a 30-day 99.9% budget in ~2 days — the classic paging
+    threshold). The open-loop traffic harness asserts burn rates over
+    short windows as first-class test outcomes (docs/traffic-harness.md).
+
+    Thread-safe; `clock` is injectable for deterministic tests.
+    """
+
+    def __init__(self, horizon_s: float = 600.0, clock=time.monotonic) -> None:
+        self._horizon = horizon_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        # (t, ok, latency_s) — appended monotonically, pruned from the left
+        self._events: deque[tuple[float, bool, float]] = deque()
+
+    def record(self, ok: bool, latency_s: float, now: float | None = None) -> None:
+        t = self._clock() if now is None else now
+        with self._lock:
+            self._events.append((t, bool(ok), float(latency_s)))
+            cutoff = t - self._horizon
+            while self._events and self._events[0][0] < cutoff:
+                self._events.popleft()
+
+    def _window(self, window_s: float, now: float | None) -> list[tuple[float, bool, float]]:
+        t = self._clock() if now is None else now
+        cutoff = t - window_s
+        with self._lock:
+            return [e for e in self._events if e[0] >= cutoff]
+
+    def count(self, window_s: float, now: float | None = None) -> int:
+        return len(self._window(window_s, now))
+
+    def error_rate(self, window_s: float, now: float | None = None) -> float:
+        """Fraction of requests in the window that failed (0.0 when empty)."""
+        ev = self._window(window_s, now)
+        if not ev:
+            return 0.0
+        return sum(1 for _, ok, _ in ev if not ok) / len(ev)
+
+    def error_burn_rate(
+        self, window_s: float, slo_error_rate: float, now: float | None = None
+    ) -> float:
+        """observed error fraction / budgeted error fraction over the window."""
+        if slo_error_rate <= 0.0:
+            # a zero-error SLO: any failure is an infinite burn
+            return math.inf if self.error_rate(window_s, now) > 0.0 else 0.0
+        return self.error_rate(window_s, now) / slo_error_rate
+
+    def latency_quantile(self, q: float, window_s: float, now: float | None = None) -> float:
+        """Latency quantile over the window's requests (0.0 when empty)."""
+        lats = sorted(lat for _, _, lat in self._window(window_s, now))
+        if not lats:
+            return 0.0
+        return lats[min(len(lats) - 1, int(q * len(lats)))]
+
+    def latency_burn_rate(
+        self,
+        window_s: float,
+        threshold_s: float,
+        slo_violation_rate: float,
+        now: float | None = None,
+    ) -> float:
+        """Burn rate of a latency SLO of the form "no more than
+        `slo_violation_rate` of requests slower than `threshold_s`"
+        (e.g. p99 <= 50 ms is threshold_s=0.05, slo_violation_rate=0.01)."""
+        ev = self._window(window_s, now)
+        if not ev:
+            return 0.0
+        slow = sum(1 for _, _, lat in ev if lat > threshold_s) / len(ev)
+        if slo_violation_rate <= 0.0:
+            return math.inf if slow > 0.0 else 0.0
+        return slow / slo_violation_rate
 
 
 registry = MetricsRegistry()

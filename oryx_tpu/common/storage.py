@@ -19,6 +19,7 @@ import contextlib
 import gzip
 import os
 import shutil
+import threading
 from pathlib import Path
 from typing import IO, Iterator
 
@@ -93,9 +94,19 @@ def open_write(uri: str | os.PathLike, mode: str = "wb") -> Iterator[IO]:
     else:
         p = _local(uri)
         p.parent.mkdir(parents=True, exist_ok=True)
-        tmp = p.parent / f".{p.name}.tmp"
-        with open(tmp, mode, encoding="utf-8" if "b" not in mode else None) as f:
-            yield f
+        # tmp name must be unique PER WRITER: concurrent writers of the
+        # same target sharing one tmp path race each other's atomic
+        # replace (writer A's replace unlinks the tmp writer B is about
+        # to replace -> FileNotFoundError; surfaced by concurrent
+        # /model/rollback requests moving the CHAMPION pointer)
+        tmp = p.parent / f".{p.name}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, mode, encoding="utf-8" if "b" not in mode else None) as f:
+                yield f
+        except BaseException:
+            with contextlib.suppress(Exception):
+                tmp.unlink()
+            raise
         tmp.replace(p)
 
 

@@ -1,0 +1,297 @@
+"""The open-loop engine: fire arrivals on their own clock, measure what
+production users would feel.
+
+Closed-loop generators (tools/traffic.py) hide overload: a slow server
+slows the *generator*, so measured latency stays flat while real demand
+would be queueing. This engine schedules requests from an arrival
+process and fires them regardless of outstanding responses; latency is
+measured **from the scheduled arrival time**, so scheduler lag and
+worker-queue wait — the queueing delay open-loop exists to expose — land
+in the reported percentiles instead of vanishing (the
+coordinated-omission correction, per Tene's HdrHistogram argument).
+
+Concurrency is bounded (``max_inflight`` pool workers) but *accounted*:
+an arrival that finds every worker busy queues, and its eventual latency
+includes the wait. ``LoadResult.queued_arrivals`` counts them — a
+nonzero value at a sustainable rate means the bound, not the server, is
+the bottleneck, and the run should be re-read accordingly.
+
+Routing is readiness-aware across N replica targets: a poller thread
+watches each target's /readyz and arrivals only route to ready replicas
+(round-robin). A draining or faulted replica drops out of rotation
+exactly the way it would behind a production load balancer — and if NO
+replica is ready, the arrival is recorded as a ``no-ready-replica``
+failure, which is what makes "zero-downtime" an assertable outcome.
+
+Failures are classified by kind (timeout / http-5xx / http-4xx /
+connection / no-ready-replica), never folded into latency stats.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from oryx_tpu.common.metrics import SLOWindow
+
+__all__ = ["LoadResult", "OpenLoopEngine", "RequestRecord", "Target", "classify_error"]
+
+
+def classify_error(exc: Exception) -> str:
+    """Map a request exception to an error KIND — timeouts must never be
+    indistinguishable from 5xx (they exhaust client patience and server
+    capacity in completely different ways)."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return f"http-{exc.code // 100}xx"
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return "timeout"
+    if isinstance(exc, urllib.error.URLError):
+        reason = getattr(exc, "reason", None)
+        if isinstance(reason, (socket.timeout, TimeoutError)):
+            return "timeout"
+        return "connection"
+    return "connection"
+
+
+class Target:
+    """One serving replica the engine routes to."""
+
+    def __init__(self, name: str, base_url: str) -> None:
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.ready = True  # until the poller learns otherwise
+        self.slo = SLOWindow()
+        self.ok = 0
+        self.failed = 0
+        self.error_kinds: Counter = Counter()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Target({self.name} @ {self.base_url}, ready={self.ready})"
+
+
+@dataclass
+class RequestRecord:
+    t_sched: float  # scheduled arrival, seconds from run start
+    latency: float  # completion - scheduled arrival (includes queueing)
+    service: float  # completion - send (server + network only)
+    target: str
+    ok: bool
+    kind: str  # "ok" or an error kind
+
+
+@dataclass
+class LoadResult:
+    duration_s: float
+    offered: int  # arrivals scheduled
+    completed: int  # responses received (ok or failed)
+    ok: int
+    failed: int
+    error_kinds: Counter
+    records: list[RequestRecord]
+    queued_arrivals: int  # arrivals that found all workers busy
+    peak_inflight: int
+    per_target: dict[str, Target]
+
+    @property
+    def offered_rate(self) -> float:
+        return self.offered / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def achieved_rate(self) -> float:
+        return self.ok / self.duration_s if self.duration_s else 0.0
+
+    @property
+    def error_rate(self) -> float:
+        return self.failed / self.completed if self.completed else 0.0
+
+    def latency_quantile(self, q: float) -> float:
+        lats = sorted(r.latency for r in self.records if r.ok)
+        if not lats:
+            return 0.0
+        return lats[min(len(lats) - 1, int(q * len(lats)))]
+
+    def service_quantile(self, q: float) -> float:
+        svc = sorted(r.service for r in self.records if r.ok)
+        if not svc:
+            return 0.0
+        return svc[min(len(svc) - 1, int(q * len(svc)))]
+
+    def summary(self) -> dict:
+        return {
+            "duration_s": round(self.duration_s, 3),
+            "offered": self.offered,
+            "offered_rate": round(self.offered_rate, 2),
+            "achieved_rate": round(self.achieved_rate, 2),
+            "ok": self.ok,
+            "failed": self.failed,
+            "error_rate": round(self.error_rate, 6),
+            "error_kinds": dict(self.error_kinds),
+            "p50_ms": round(self.latency_quantile(0.50) * 1000, 2),
+            "p99_ms": round(self.latency_quantile(0.99) * 1000, 2),
+            "service_p99_ms": round(self.service_quantile(0.99) * 1000, 2),
+            "queued_arrivals": self.queued_arrivals,
+            "peak_inflight": self.peak_inflight,
+            "per_target": {
+                name: {"ok": t.ok, "failed": t.failed, "errors": dict(t.error_kinds)}
+                for name, t in self.per_target.items()
+            },
+        }
+
+
+class OpenLoopEngine:
+    def __init__(
+        self,
+        targets: list[Target],
+        template: str = "/probe/recommend/u%d",
+        max_inflight: int = 128,
+        timeout_s: float = 10.0,
+        readiness_poll_s: float = 0.2,
+    ) -> None:
+        if not targets:
+            raise ValueError("need at least one target")
+        self.targets = targets
+        self.template = template
+        self.max_inflight = int(max_inflight)
+        self.timeout_s = float(timeout_s)
+        self.readiness_poll_s = float(readiness_poll_s)
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._peak_inflight = 0
+        self._stop = threading.Event()
+
+    # -- readiness routing ---------------------------------------------------
+
+    def _poll_readiness(self) -> None:
+        while not self._stop.wait(self.readiness_poll_s):
+            for t in self.targets:
+                try:
+                    with urllib.request.urlopen(
+                        f"{t.base_url}/readyz", timeout=self.timeout_s
+                    ) as resp:
+                        t.ready = resp.status == 200
+                except urllib.error.HTTPError as e:
+                    # 404 = no /readyz resource on this server: treat as
+                    # ready (bare routers); 503 = deliberately not ready
+                    t.ready = e.code == 404
+                except Exception:
+                    t.ready = False
+
+    def _pick_target(self) -> Target | None:
+        with self._lock:
+            n = len(self.targets)
+            for i in range(n):
+                t = self.targets[(self._rr + i) % n]
+                if t.ready:
+                    self._rr = (self._rr + i + 1) % n
+                    return t
+        return None
+
+    # -- request execution ---------------------------------------------------
+
+    def _execute(self, t_run0: float, t_sched: float, user: int, sink: list) -> None:
+        t_send = time.perf_counter()
+        target = self._pick_target()
+        ok = False
+        kind = "ok"
+        if target is None:
+            kind = "no-ready-replica"
+        else:
+            path = self.template % user if "%d" in self.template else self.template
+            try:
+                with urllib.request.urlopen(
+                    target.base_url + path, timeout=self.timeout_s
+                ) as resp:
+                    resp.read()
+                    ok = 200 <= resp.status < 300
+                    if not ok:  # non-2xx that didn't raise (3xx)
+                        kind = f"http-{resp.status // 100}xx"
+            except Exception as e:  # noqa: BLE001 - classified, not swallowed
+                kind = classify_error(e)
+        t_end = time.perf_counter()
+        rec = RequestRecord(
+            t_sched=t_sched,
+            latency=(t_end - t_run0) - t_sched,
+            service=t_end - t_send,
+            target=target.name if target is not None else "-",
+            ok=ok,
+            kind=kind,
+        )
+        with self._lock:
+            sink.append(rec)
+            self._inflight -= 1
+        if target is not None:
+            target.slo.record(ok, rec.latency)
+            with self._lock:
+                if ok:
+                    target.ok += 1
+                else:
+                    target.failed += 1
+                    target.error_kinds[kind] += 1
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self, arrivals, users, duration_s: float) -> LoadResult:
+        """Drive `arrivals` over `duration_s` seconds against the targets,
+        users drawn from `users` (PowerLawUsers or any .one() provider).
+        Returns after all scheduled requests complete (each is bounded by
+        the request timeout, so the tail is bounded too)."""
+        records: list[RequestRecord] = []
+        offered = 0
+        queued = 0
+        self._stop.clear()
+        poller = None
+        if self.readiness_poll_s > 0:
+            poller = threading.Thread(
+                target=self._poll_readiness, name="LoadgenReadiness", daemon=True
+            )
+            poller.start()
+        pool = ThreadPoolExecutor(
+            max_workers=self.max_inflight, thread_name_prefix="LoadgenWorker"
+        )
+        t_run0 = time.perf_counter()
+        try:
+            for t_sched in arrivals.times(duration_s):
+                # open loop: sleep until the scheduled arrival, then fire
+                # whether or not earlier requests came back
+                delay = t_sched - (time.perf_counter() - t_run0)
+                if delay > 0:
+                    time.sleep(delay)
+                user = users.one()
+                with self._lock:
+                    self._inflight += 1
+                    if self._inflight > self.max_inflight:
+                        queued += 1
+                    self._peak_inflight = max(self._peak_inflight, self._inflight)
+                offered += 1
+                pool.submit(self._execute, t_run0, t_sched, user, records)
+            pool.shutdown(wait=True)
+        finally:
+            self._stop.set()
+            pool.shutdown(wait=False)
+            if poller is not None:
+                poller.join(timeout=self.readiness_poll_s + self.timeout_s + 1.0)
+        with self._lock:
+            recs = list(records)
+        kinds = Counter(r.kind for r in recs if not r.ok)
+        n_ok = sum(1 for r in recs if r.ok)
+        return LoadResult(
+            # rates are over the SCHEDULED window: the post-deadline tail
+            # draining responses is not extra serving time
+            duration_s=duration_s,
+            offered=offered,
+            completed=len(recs),
+            ok=n_ok,
+            failed=len(recs) - n_ok,
+            error_kinds=kinds,
+            records=recs,
+            queued_arrivals=queued,
+            peak_inflight=self._peak_inflight,
+            per_target={t.name: t for t in self.targets},
+        )

@@ -108,3 +108,17 @@ def probe_model(ctx: ServingContext, req: Request) -> Response:
         raise OryxServingException(503, "model not yet available")
     body = {"generation_id": model.generation_id, "extensions": model.extensions}
     return Response(200, body, content_type="application/json")
+
+
+@resource("GET", "/probe/recommend/{userID}")
+def probe_recommend(ctx: ServingContext, req: Request) -> Response:
+    """A /recommend-shaped traffic target for the open-loop fleet harness
+    (tools/fleet.py): per-user path (so the generator's power-law user
+    skew exercises real routing) answering with the generation that
+    served it — the response-level evidence a rotation happened under
+    load with zero failures."""
+    model = ctx.model_manager.get_model() if ctx.model_manager else None
+    if model is None:
+        raise OryxServingException(503, "model not yet available")
+    body = {"user": req.params["userID"], "generation_id": model.generation_id}
+    return Response(200, body, content_type="application/json")

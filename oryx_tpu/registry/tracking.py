@@ -38,6 +38,22 @@ _MODEL_KEYS = (b"MODEL", b"MODEL-REF")
 
 LIVE_GENERATION_GAUGE = "serving.model.live-generation"
 DUPLICATES_COUNTER = "serving.model.duplicates-suppressed"
+FLEET_SKEW_GAUGE = "serving.model.generation-skew"
+
+
+def record_fleet_skew(live_generations) -> int:
+    """Generation skew across a fleet of serving replicas: the number of
+    *extra* distinct generations live at once (0 = every replica that has
+    a model agrees). Replicas that have not yet resolved a generation
+    (None) don't count as skew — they are catching up, not disagreeing.
+    Published as the ``serving.model.generation-skew`` gauge; the fleet
+    driver (tools/fleet.py) polls replica /healthz bodies and records the
+    skew each sample, and the rotation-under-load test asserts it returns
+    to 0 after a rotation settles."""
+    gens = {g for g in live_generations if g is not None}
+    skew = max(0, len(gens) - 1)
+    metrics.registry.gauge(FLEET_SKEW_GAUGE).set(skew)
+    return skew
 
 
 def generation_of_model_message(key: str, message: str) -> str | None:

@@ -154,6 +154,10 @@ class ServingHealth:
         self.stream_healthy: bool | None = None
         self.last_update_time: float | None = None
         self.consume_thread: SupervisedThread | None = None
+        # drain-aware shutdown: once True, /ready and /readyz answer 503 so
+        # load balancers stop routing here, while in-flight requests (and
+        # any still arriving from stale routing tables) complete normally
+        self.draining: bool = False
         # generation id of the live model (set by the GenerationTracker as
         # MODEL/MODEL-REF records flow past); None until one arrives or
         # when models carry no generation identity
@@ -193,7 +197,10 @@ class ServingHealth:
 
 @resource("GET", "/ready")
 def _ready(ctx: ServingContext, req: Request) -> Response:
-    """503 until the model is sufficiently loaded (Ready.java:34-42)."""
+    """503 until the model is sufficiently loaded (Ready.java:34-42) — and
+    again once the instance is draining for shutdown."""
+    if ctx.health is not None and ctx.health.draining:
+        return Response(503, None)
     if _model_ready(ctx):
         return Response(200, None)
     return Response(503, None)
@@ -220,19 +227,30 @@ def _healthz(ctx: ServingContext, req: Request) -> Response:
 @resource("GET", "/readyz")
 def _readyz(ctx: ServingContext, req: Request) -> Response:
     """Strict readiness for load balancers: the model must be loaded AND
-    the update stream must not be known-down. Degraded instances keep
-    /healthz green but drop out of /readyz rotation."""
+    the update stream must not be known-down AND the instance must not be
+    draining. Degraded/draining instances keep /healthz green but drop
+    out of /readyz rotation."""
     ready = _model_ready(ctx)
     stream_ok = ctx.health is None or ctx.health.stream_healthy is not False
-    body = {"model_ready": ready, "stream_ok": stream_ok}
-    return Response(200 if ready and stream_ok else 503, body, content_type="application/json")
+    draining = ctx.health is not None and ctx.health.draining
+    body = {"model_ready": ready, "stream_ok": stream_ok, "draining": draining}
+    ok = ready and stream_ok and not draining
+    return Response(200 if ok else 503, body, content_type="application/json")
 
 
 @resource("GET", "/metrics")
 def _metrics(ctx: ServingContext, req: Request) -> Response:
     """Request QPS/latency histograms and model state, as JSON — the
-    observability the reference lacks (SURVEY.md §5)."""
+    observability the reference lacks (SURVEY.md §5). Request-path metrics
+    come from this instance's own registry when one is attached, so N
+    replicas in one process each report their *own* traffic (the fleet
+    harness computes per-replica SLO burn rates from exactly this)."""
     snap = metrics.registry.snapshot()
+    if ctx.instance_metrics is not None:
+        # instance-scoped values shadow the process-global ones: in a
+        # multi-replica process the shared registry aggregates all
+        # replicas, the instance registry is this replica alone
+        snap.update(ctx.instance_metrics.snapshot())
     manager = ctx.model_manager
     model = manager.get_model() if manager is not None else None
     if model is not None:
@@ -300,12 +318,24 @@ def _model_rollback(ctx: ServingContext, req: Request) -> Response:
     return Response(200, body, content_type="application/json")
 
 
-def _observe_request(method: str, status: int, t0: float) -> None:
+def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
+    dt = time.perf_counter() - t0
     metrics.registry.counter(f"serving.requests.{method}").inc()
     metrics.registry.counter(f"serving.responses.{status // 100}xx").inc()
-    metrics.registry.histogram("serving.request.seconds").observe(
-        time.perf_counter() - t0
-    )
+    metrics.registry.histogram("serving.request.seconds").observe(dt)
+    if layer is None:
+        return
+    # instance-scoped mirrors (per-replica truth in a multi-replica
+    # process) plus the per-generation counter that makes a rotation
+    # observable: the live generation at response time is stamped on the
+    # request, so a rotation shows up as traffic moving between
+    # serving.requests.generation.<gen> counters, not as a gap
+    im = layer.instance_metrics
+    im.counter(f"serving.requests.{method}").inc()
+    im.counter(f"serving.responses.{status // 100}xx").inc()
+    im.histogram("serving.request.seconds").observe(dt)
+    generation = layer.health.live_generation or "none"
+    im.counter(f"serving.requests.generation.{generation}").inc()
 
 
 def _model_ready(ctx: ServingContext) -> bool:
@@ -411,6 +441,12 @@ class ServingLayer:
         self._stop_event = threading.Event()
         self.health = ServingHealth()
         self.retry_policy = RetryPolicy.from_config(config, "oryx.serving.retry")
+        # instance-scoped metrics: in a multi-replica process (tools/fleet.py)
+        # the module-global registry aggregates every replica; this registry
+        # is this replica alone, and /metrics serves it shadowing the global
+        self.instance_metrics = metrics.MetricsRegistry()
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
 
         # model registry over the batch model dir: /model/generations +
         # rollback, and live-generation tracking with duplicate-MODEL
@@ -487,7 +523,11 @@ class ServingLayer:
                 from oryx_tpu.registry.store import publish_generation
 
                 # lazy producer: rollbacks are rare, no point holding an
-                # update-topic producer open on every serving instance
+                # update-topic producer open on every serving instance.
+                # The lock covers the WHOLE publish, not just producer
+                # creation: concurrent rollback requests serialize, so two
+                # racing rollbacks can never interleave their MODEL bytes
+                # on the topic — the last one to publish wins cleanly.
                 with self._rollback_lock:
                     if self._rollback_producer is None:
                         self._rollback_producer = get_broker(update_broker_loc).producer(
@@ -508,6 +548,7 @@ class ServingLayer:
             self.health,
             registry=self.registry_store,
             rollback_publisher=rollback_publisher,
+            instance_metrics=self.instance_metrics,
         )
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
@@ -563,9 +604,60 @@ class ServingLayer:
         if self._server_thread is not None:
             self._server_thread.join(timeout)
 
-    def close(self) -> None:
+    # -- drain-aware shutdown -----------------------------------------------
+
+    def _request_began(self) -> None:
+        with self._inflight_cond:
+            self._inflight += 1
+            n = self._inflight
+        self.instance_metrics.gauge("serving.requests.in-flight").set(n)
+
+    def _request_ended(self) -> None:
+        with self._inflight_cond:
+            self._inflight -= 1
+            n = self._inflight
+            if n <= 0:
+                self._inflight_cond.notify_all()
+        self.instance_metrics.gauge("serving.requests.in-flight").set(n)
+
+    @property
+    def inflight_requests(self) -> int:
+        with self._inflight_cond:
+            return self._inflight
+
+    def begin_drain(self) -> None:
+        """Start refusing NEW traffic at the readiness level: /ready and
+        /readyz flip to 503 so load balancers (and the open-loop engine's
+        readiness router) stop sending here, while requests already in
+        flight — or still arriving from stale routing tables — complete
+        normally. The first half of a zero-downtime rolling restart."""
+        self.health.draining = True
+        self.instance_metrics.gauge("serving.draining").set(1)
+        log.info("ServingLayer :%d draining (readiness now 503)", self.port)
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until no requests are in flight (or timeout). Returns
+        True when the instance is idle and safe to close."""
+        deadline = time.monotonic() + timeout
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+        return True
+
+    def close(self, drain_seconds: float = 0.0) -> None:
         if getattr(self, "_close_done", False):
             return
+        if drain_seconds > 0:
+            self.begin_drain()
+            if not self.drain(drain_seconds):
+                log.warning(
+                    "close: %d request(s) still in flight after %.1fs drain",
+                    self.inflight_requests,
+                    drain_seconds,
+                )
         self._close_done = True
         if self._server is not None:
             self._server.shutdown()
@@ -611,18 +703,25 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
 
         def _handle(self, method: str) -> None:
             t0 = time.perf_counter()
+            layer._request_began()
+            try:
+                self._handle_counted(method, t0)
+            finally:
+                layer._request_ended()
+
+        def _handle_counted(self, method: str, t0: float) -> None:
             try:
                 status, payload, ct, extra = self._dispatch(method)
             except OryxServingException as e:
-                _observe_request(method, e.status, t0)
+                _observe_request(method, e.status, t0, layer)
                 self._send_error(e.status, e.message)
                 return
             except Exception:
                 log.exception("internal error handling %s %s", method, self.path)
-                _observe_request(method, 500, t0)
+                _observe_request(method, 500, t0, layer)
                 self._send_error(500, "internal error")
                 return
-            _observe_request(method, status, t0)
+            _observe_request(method, status, t0, layer)
             body = payload
             headers = dict(extra)
             if len(body) > 1024 and "gzip" in self.headers.get("Accept-Encoding", ""):

@@ -108,6 +108,7 @@ class ServingContext:
         health=None,
         registry=None,
         rollback_publisher=None,
+        instance_metrics=None,
     ) -> None:
         self.model_manager = model_manager
         self.input_producer = input_producer
@@ -121,6 +122,9 @@ class ServingContext:
         # callable(generation_id) -> publish key, provided by ServingLayer
         # (republishes an archived generation onto the update topic)
         self.rollback_publisher = rollback_publisher
+        # this replica's own MetricsRegistry (per-replica truth when many
+        # ServingLayers share one process); None in bare router tests
+        self.instance_metrics = instance_metrics
 
 
 # ---------------------------------------------------------------------------

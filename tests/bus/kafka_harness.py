@@ -1,0 +1,171 @@
+"""Local Kafka broker harness for the kafka:// integration tests.
+
+The reference project ships LocalKafkaBroker/LocalZKServer so its Kafka
+tests are self-contained; this is the same idea for the rebuild. The
+``kafka_bootstrap`` fixture resolves, in order:
+
+1. ``ORYX_KAFKA_BOOTSTRAP`` — an externally managed broker; yielded
+   as-is, nothing started or stopped.
+2. A local single-node KRaft broker, started from a Kafka distribution
+   found via ``KAFKA_HOME`` or ``kafka-server-start.sh`` on PATH, on
+   ephemeral ports under a pytest tmp dir, torn down after the test.
+3. Neither available -> ``pytest.skip`` with a reason naming what was
+   missing — the integration tests degrade to skips, never to errors.
+
+kafka-python must be importable in every case (the adapter needs it);
+its absence also skips.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+import uuid
+from pathlib import Path
+
+import pytest
+
+__all__ = ["LocalKafkaBroker", "find_kafka_distribution", "kafka_bootstrap"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_port(port: int, timeout: float = 30.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                return True
+        except OSError:
+            time.sleep(0.3)
+    return False
+
+
+def find_kafka_distribution() -> Path | None:
+    """Locate a Kafka distribution's bin/ directory: $KAFKA_HOME/bin, or
+    the directory holding kafka-server-start.sh on PATH."""
+    home = os.environ.get("KAFKA_HOME")
+    if home and (Path(home) / "bin" / "kafka-server-start.sh").exists():
+        return Path(home) / "bin"
+    on_path = shutil.which("kafka-server-start.sh")
+    if on_path:
+        return Path(on_path).parent
+    return None
+
+
+class LocalKafkaBroker:
+    """One single-node KRaft broker on ephemeral ports (the rebuild's
+    LocalKafkaBroker): format storage, start, wait for the listener,
+    terminate on close. State lives under `work_dir`."""
+
+    def __init__(self, bin_dir: Path, work_dir: Path) -> None:
+        self.bin_dir = Path(bin_dir)
+        self.work_dir = Path(work_dir)
+        self.port = _free_port()
+        self.controller_port = _free_port()
+        self.bootstrap = f"127.0.0.1:{self.port}"
+        self._proc: subprocess.Popen | None = None
+        self.log_path = self.work_dir / "kafka-server.log"
+
+    def _write_config(self) -> Path:
+        log_dirs = self.work_dir / "kraft-logs"
+        log_dirs.mkdir(parents=True, exist_ok=True)
+        cfg = self.work_dir / "server.properties"
+        cfg.write_text(
+            "\n".join(
+                [
+                    "process.roles=broker,controller",
+                    "node.id=1",
+                    f"controller.quorum.voters=1@127.0.0.1:{self.controller_port}",
+                    f"listeners=PLAINTEXT://127.0.0.1:{self.port},"
+                    f"CONTROLLER://127.0.0.1:{self.controller_port}",
+                    f"advertised.listeners=PLAINTEXT://{self.bootstrap}",
+                    "controller.listener.names=CONTROLLER",
+                    "inter.broker.listener.name=PLAINTEXT",
+                    f"log.dirs={log_dirs}",
+                    "num.partitions=1",
+                    "offsets.topic.replication.factor=1",
+                    "transaction.state.log.replication.factor=1",
+                    "transaction.state.log.min.isr=1",
+                    "group.initial.rebalance.delay.ms=0",
+                    "auto.create.topics.enable=false",
+                ]
+            )
+            + "\n",
+            encoding="utf-8",
+        )
+        return cfg
+
+    def start(self, timeout: float = 45.0) -> None:
+        cfg = self._write_config()
+        cluster_id = uuid.uuid4().hex[:22]
+        with open(self.log_path, "ab") as log:
+            subprocess.run(
+                [
+                    str(self.bin_dir / "kafka-storage.sh"),
+                    "format", "-t", cluster_id, "-c", str(cfg),
+                ],
+                check=True, stdout=log, stderr=subprocess.STDOUT, timeout=60,
+            )
+            self._proc = subprocess.Popen(
+                [str(self.bin_dir / "kafka-server-start.sh"), str(cfg)],
+                stdout=log, stderr=subprocess.STDOUT,
+            )
+        if not _wait_port(self.port, timeout):
+            self.close()
+            raise RuntimeError(
+                f"local Kafka never opened {self.bootstrap}; see {self.log_path}"
+            )
+
+    def close(self) -> None:
+        if self._proc is None:
+            return
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=10)
+        self._proc = None
+
+    def __enter__(self) -> "LocalKafkaBroker":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+@pytest.fixture(scope="module")
+def kafka_bootstrap(tmp_path_factory):
+    """bootstrap host:port for kafka:// tests — external broker, locally
+    started broker, or a clean skip (see module docstring)."""
+    try:
+        import kafka  # noqa: F401
+    except ImportError:
+        pytest.skip("kafka-python not installed")
+    external = os.environ.get("ORYX_KAFKA_BOOTSTRAP")
+    if external:
+        yield external
+        return
+    bin_dir = find_kafka_distribution()
+    if bin_dir is None:
+        pytest.skip(
+            "no ORYX_KAFKA_BOOTSTRAP and no Kafka distribution "
+            "(KAFKA_HOME or kafka-server-start.sh on PATH)"
+        )
+    broker = LocalKafkaBroker(bin_dir, tmp_path_factory.mktemp("kafka"))
+    try:
+        broker.start()
+    except Exception as e:  # noqa: BLE001 - startup failure = skip, not error
+        broker.close()
+        pytest.skip(f"local Kafka failed to start: {e}")
+    yield broker.bootstrap
+    broker.close()

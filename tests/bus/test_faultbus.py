@@ -179,3 +179,68 @@ def test_unknown_query_keys_pass_through_to_inner(tmp_path):
     assert inner == "tcp://h:1234?connect_timeout=5"
     assert params == {"drop": "0.1", "seed": "2"}
     assert "drop=0.1" in canon and "connect_timeout" not in canon
+
+
+# -- scenario scripting (the fleet harness's chaos control surface) ----------
+
+
+def test_set_levers_reconfigures_mid_run():
+    locator = "fault+inproc://levers?drop=0&seed=1"
+    broker = bus.get_broker(locator)
+    broker.create_topic("t", 1)
+    with broker.producer("t") as p:
+        p.send("k", "m0")  # drop=0: always succeeds
+        faultbus.set_levers(locator, drop=1.0)
+        with pytest.raises(ConnectionError):
+            p.send("k", "m1")
+        faultbus.set_levers(locator, drop=0.0)
+        p.send("k", "m2")
+    # outage lever works through the same surface
+    faultbus.set_levers(locator, outage=True)
+    with broker.producer("t") as p:
+        with pytest.raises(ConnectionError, match="outage"):
+            p.send("k", "m3")
+    faultbus.set_levers(locator, outage=False)
+
+
+def test_scheduled_phases_apply_lazily_on_data_path():
+    """A timed chaos window: phases arm levers at offsets, applied by the
+    data path's own consultations — no scheduler thread, deterministic
+    under an injected clock."""
+    locator = "fault+inproc://phases?seed=2"
+    state = get_state(locator)
+    clock_t = [0.0]
+    faultbus.schedule_phases(
+        locator,
+        [
+            {"at": 5.0, "drop": 1.0},
+            {"at": 1.0, "delay_ms": 0.0, "dup": 0.5},  # out of order on purpose
+        ],
+        clock=lambda: clock_t[0],
+    )
+    assert state.phases_applied == 0
+    state.roll()  # t=0: nothing due
+    assert state.phases_applied == 0 and state.drop == 0.0
+    clock_t[0] = 1.5  # first phase due
+    state.roll()
+    assert state.phases_applied == 1
+    assert state.dup == 0.5 and state.drop == 0.0
+    clock_t[0] = 6.0  # second phase due
+    state.check_outage("poll")  # outage checks also tick the schedule
+    assert state.phases_applied == 2
+    assert state.drop == 1.0
+
+
+def test_scheduled_phases_drive_real_traffic():
+    locator = "fault+inproc://phasetraffic?seed=3"
+    broker = bus.get_broker(locator)
+    broker.create_topic("t", 1)
+    clock_t = [0.0]
+    faultbus.schedule_phases(
+        locator, [{"at": 1.0, "drop": 1.0}], clock=lambda: clock_t[0]
+    )
+    with broker.producer("t") as p:
+        p.send("k", "before")  # phase not due: clean
+        clock_t[0] = 2.0
+        with pytest.raises(ConnectionError):
+            p.send("k", "during")

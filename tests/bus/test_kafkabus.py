@@ -1,30 +1,25 @@
 """Kafka adapter tests.
 
 The unit half runs everywhere (locator wiring, graceful absence of the
-optional kafka-python dependency). The integration half needs a real
-broker: run with ``-m kafka`` and ``ORYX_KAFKA_BOOTSTRAP=host:port`` in
-an environment where kafka-python is installed.
+optional kafka-python dependency). The integration half gets its broker
+from the ``kafka_bootstrap`` fixture (tests/bus/kafka_harness.py): an
+external ``ORYX_KAFKA_BOOTSTRAP`` broker if set, else a locally started
+single-node KRaft broker, else a clean skip. Run with ``-m kafka``.
 """
 
 from __future__ import annotations
 
-import os
 import uuid
 
 import pytest
+
+from kafka_harness import kafka_bootstrap  # noqa: F401 - pytest fixture
 
 _HAS_KAFKA_LIB = True
 try:
     import kafka  # noqa: F401
 except ImportError:
     _HAS_KAFKA_LIB = False
-
-_BOOTSTRAP = os.environ.get("ORYX_KAFKA_BOOTSTRAP")
-
-kafka_integration = pytest.mark.skipif(
-    not (_HAS_KAFKA_LIB and _BOOTSTRAP),
-    reason="needs kafka-python + ORYX_KAFKA_BOOTSTRAP pointing at a broker",
-)
 
 
 def test_kafka_locator_without_library_raises_helpfully():
@@ -37,13 +32,12 @@ def test_kafka_locator_without_library_raises_helpfully():
 
 
 @pytest.mark.kafka
-@kafka_integration
-def test_kafka_roundtrip_with_group_resume():
+def test_kafka_roundtrip_with_group_resume(kafka_bootstrap):  # noqa: F811
     """Full Broker SPI against a real Kafka: create topic, produce,
     consume with a group, commit, resume from the committed offset."""
     from oryx_tpu import bus
 
-    broker = bus.get_broker(f"kafka://{_BOOTSTRAP}")
+    broker = bus.get_broker(f"kafka://{kafka_bootstrap}")
     topic = f"oryx-it-{uuid.uuid4().hex[:10]}"
     group = f"g-{uuid.uuid4().hex[:8]}"
     broker.create_topic(topic, 1)
@@ -75,8 +69,7 @@ def test_kafka_roundtrip_with_group_resume():
 
 
 @pytest.mark.kafka
-@kafka_integration
-def test_speed_layer_over_kafka(tmp_path):
+def test_speed_layer_over_kafka(tmp_path, kafka_bootstrap):  # noqa: F811
     """The real SpeedLayer against kafka:// locators — the 'layers run
     against a real broker with offsets resuming' contract."""
     import time
@@ -89,7 +82,7 @@ def test_speed_layer_over_kafka(tmp_path):
     from oryx_tpu.common import pmml as pmml_io
     from oryx_tpu.lambda_.speed import SpeedLayer
 
-    locator = f"kafka://{_BOOTSTRAP}"
+    locator = f"kafka://{kafka_bootstrap}"
     suffix = uuid.uuid4().hex[:8]
     input_topic, update_topic = f"OryxInput-{suffix}", f"OryxUpdate-{suffix}"
     broker = bus.get_broker(locator)

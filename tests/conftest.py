@@ -74,6 +74,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet: multi-replica serving fleet under open-loop load (generation "
+        "rotation, rollback, chaos windows, drain restarts; zero failed "
+        "requests as the SLO assertion); tier-1-safe, select with -m fleet",
+    )
+    config.addinivalue_line(
+        "markers",
         "trainers: batch-trainer equivalence suite (RDF histogram modes, "
         "k-means device init / mini-batch, ALS compiled-run cache + "
         "zero-recompile regression); fast and tier-1-safe, select with "

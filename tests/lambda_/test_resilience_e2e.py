@@ -221,7 +221,8 @@ def test_readyz_flips_across_injected_outage():
         faultbus.set_outage(loc, True)
         assert wait_until(lambda: _http_status(port, "/readyz")[0] == 503)
         status, body = _http_status(port, "/readyz")
-        assert body == {"model_ready": True, "stream_ok": False}
+        assert body == {"model_ready": True, "stream_ok": False,
+                        "draining": False}
         # degraded, not dead: liveness stays green, the last good model
         # still answers
         status, body = _http_status(port, "/healthz")

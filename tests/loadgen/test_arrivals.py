@@ -1,0 +1,72 @@
+"""Arrival-process contracts: determinism per seed, rate fidelity within
+statistical tolerance, and the diurnal curve's shape actually showing up
+in the arrival density."""
+
+import math
+
+import pytest
+
+from oryx_tpu.loadgen import DiurnalRampProcess, PoissonProcess
+
+pytestmark = pytest.mark.fleet
+
+
+def test_poisson_deterministic_per_seed():
+    a = list(PoissonProcess(rate=200.0, seed=42).times(2.0))
+    b = list(PoissonProcess(rate=200.0, seed=42).times(2.0))
+    c = list(PoissonProcess(rate=200.0, seed=43).times(2.0))
+    assert a == b
+    assert a != c
+
+
+def test_poisson_times_increasing_and_bounded():
+    times = list(PoissonProcess(rate=500.0, seed=1).times(1.5))
+    assert all(0.0 < t < 1.5 for t in times)
+    assert times == sorted(times)
+    assert len(set(times)) == len(times)
+
+
+def test_poisson_rate_within_statistical_tolerance():
+    rate, duration = 400.0, 5.0
+    n = len(list(PoissonProcess(rate=rate, seed=7).times(duration)))
+    expected = rate * duration
+    # Poisson sd = sqrt(mean); 5 sigma leaves ~1e-6 flake probability
+    assert abs(n - expected) < 5.0 * math.sqrt(expected)
+
+
+def test_poisson_rejects_nonpositive_rate():
+    with pytest.raises(ValueError):
+        PoissonProcess(rate=0.0)
+
+
+def test_diurnal_rate_curve_endpoints():
+    p = DiurnalRampProcess(base_rate=50.0, peak_rate=200.0, period_s=10.0)
+    assert p.offered_rate(0.0) == pytest.approx(50.0)
+    assert p.offered_rate(5.0) == pytest.approx(200.0)  # peak at period/2
+    assert p.offered_rate(10.0) == pytest.approx(50.0)  # back to trough
+
+
+def test_diurnal_density_follows_curve():
+    p = DiurnalRampProcess(base_rate=20.0, peak_rate=400.0, period_s=8.0, seed=5)
+    times = list(p.times(8.0))
+    trough = sum(1 for t in times if t < 2.0 or t >= 6.0)
+    peak = sum(1 for t in times if 2.0 <= t < 6.0)
+    # the peak half-period must dominate decisively, not marginally
+    assert peak > 3 * trough
+    expected = p.expected_arrivals(8.0)
+    assert abs(len(times) - expected) < 5.0 * math.sqrt(expected)
+
+
+def test_diurnal_deterministic_per_seed():
+    mk = lambda s: list(  # noqa: E731
+        DiurnalRampProcess(base_rate=30.0, peak_rate=120.0, period_s=4.0, seed=s).times(4.0)
+    )
+    assert mk(9) == mk(9)
+    assert mk(9) != mk(10)
+
+
+def test_diurnal_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        DiurnalRampProcess(base_rate=100.0, peak_rate=50.0, period_s=10.0)
+    with pytest.raises(ValueError):
+        DiurnalRampProcess(base_rate=10.0, peak_rate=20.0, period_s=0.0)

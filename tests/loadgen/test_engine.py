@@ -1,0 +1,171 @@
+"""Open-loop engine contracts against a controllable local HTTP server:
+queueing delay is measured (not hidden), failures are classified by
+kind, readiness gates routing, and no-ready-replica is a recorded
+failure rather than a silent drop."""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from oryx_tpu.loadgen import OpenLoopEngine, PoissonProcess, Target
+
+pytestmark = pytest.mark.fleet
+
+
+class FixedUsers:
+    """Deterministic stand-in for PowerLawUsers."""
+
+    def one(self) -> int:
+        return 7
+
+
+class ControlServer:
+    """Local HTTP server with scriptable latency / status / readiness."""
+
+    def __init__(self) -> None:
+        self.latency_s = 0.0
+        self.status = 200
+        self.ready = True
+        self.hits = 0
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    self.send_response(200 if outer.ready else 503)
+                    self.end_headers()
+                    self.wfile.write(b"{}")
+                    return
+                outer.hits += 1
+                if outer.latency_s:
+                    time.sleep(outer.latency_s)
+                self.send_response(outer.status)
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        self.base = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture()
+def server():
+    s = ControlServer()
+    yield s
+    s.close()
+
+
+def _run(engine, rate=50.0, seconds=1.0, seed=1):
+    return engine.run(PoissonProcess(rate=rate, seed=seed), FixedUsers(), seconds)
+
+
+def test_clean_run_counts_and_rates(server):
+    engine = OpenLoopEngine([Target("t0", server.base)], template="/probe/u%d")
+    result = _run(engine, rate=60.0, seconds=1.0)
+    assert result.offered > 0
+    assert result.completed == result.offered
+    assert result.failed == 0 and result.ok == result.offered
+    assert result.error_rate == 0.0
+    assert result.offered_rate == pytest.approx(result.offered / 1.0)
+    assert server.hits == result.offered
+
+
+def test_queueing_delay_is_measured_not_hidden(server):
+    """The open-loop property: with one worker and a slow server, later
+    arrivals queue, and their latency (from scheduled arrival) includes
+    the wait even though service time stays flat."""
+    server.latency_s = 0.10
+    engine = OpenLoopEngine(
+        [Target("t0", server.base)], template="/probe/u%d", max_inflight=1
+    )
+    result = _run(engine, rate=40.0, seconds=0.5)
+    assert result.queued_arrivals > 0
+    # service time ~100 ms, but queue-inclusive p99 must be far above it
+    assert result.service_quantile(0.99) < 0.35
+    assert result.latency_quantile(0.99) > 2.0 * result.service_quantile(0.99)
+
+
+def test_http_5xx_classified_not_conflated(server):
+    server.status = 500
+    engine = OpenLoopEngine([Target("t0", server.base)], template="/probe/u%d")
+    result = _run(engine, rate=40.0, seconds=0.5)
+    assert result.ok == 0
+    assert result.failed == result.completed > 0
+    assert set(result.error_kinds) == {"http-5xx"}
+    assert result.per_target["t0"].error_kinds["http-5xx"] == result.failed
+
+
+def test_timeout_classified_as_timeout(server):
+    server.latency_s = 2.0
+    engine = OpenLoopEngine(
+        [Target("t0", server.base)], template="/probe/u%d", timeout_s=0.2
+    )
+    result = _run(engine, rate=6.0, seconds=0.5)
+    assert result.failed > 0
+    assert set(result.error_kinds) == {"timeout"}
+
+
+def test_connection_refused_classified_as_connection():
+    # nothing listens on this port (bound-then-closed ephemeral port)
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    engine = OpenLoopEngine(
+        [Target("t0", f"http://127.0.0.1:{port}")],
+        template="/probe/u%d",
+        readiness_poll_s=0,  # no poller: exercise the request path itself
+    )
+    result = _run(engine, rate=10.0, seconds=0.3)
+    assert result.failed == result.completed > 0
+    assert set(result.error_kinds) == {"connection"}
+
+
+def test_readiness_gates_routing(server):
+    """Two targets, one draining: the poller must pull it out of rotation
+    and all traffic lands on the ready replica."""
+    draining = ControlServer()
+    draining.ready = False
+    try:
+        t_ok, t_drain = Target("ok", server.base), Target("drain", draining.base)
+        t_drain.ready = False  # poller would learn this; pre-seed to avoid racing
+        engine = OpenLoopEngine(
+            [t_ok, t_drain], template="/probe/u%d", readiness_poll_s=0.05
+        )
+        result = _run(engine, rate=50.0, seconds=0.6)
+        assert result.failed == 0
+        assert result.per_target["drain"].ok == 0
+        assert result.per_target["ok"].ok == result.ok > 0
+        assert draining.hits == 0
+    finally:
+        draining.close()
+
+
+def test_no_ready_replica_is_a_recorded_failure(server):
+    t = Target("t0", server.base)
+    t.ready = False
+    engine = OpenLoopEngine([t], template="/probe/u%d", readiness_poll_s=0)
+    result = _run(engine, rate=30.0, seconds=0.3)
+    assert result.completed == result.offered > 0
+    assert result.ok == 0
+    assert set(result.error_kinds) == {"no-ready-replica"}
+    assert server.hits == 0
+
+
+def test_engine_requires_targets():
+    with pytest.raises(ValueError):
+        OpenLoopEngine([])

@@ -1,0 +1,118 @@
+"""Scenario parsing + the timed action runner: actions fire in order at
+their offsets, handler failures are recorded (never raised), unknown
+verbs are surfaced, stop() halts the timeline."""
+
+import json
+import time
+
+import pytest
+
+from oryx_tpu.loadgen import (
+    Action,
+    DiurnalRampProcess,
+    PoissonProcess,
+    PowerLawUsers,
+    Scenario,
+    ScenarioRunner,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+SCENARIO_DICT = {
+    "duration_s": 8,
+    "template": "/probe/recommend/u%d",
+    "arrivals": {"process": "poisson", "rate": 150, "seed": 7},
+    "skew": {"users": 2_000_000, "exponent": 1.1, "hot_count": 16, "hot_weight": 0.2},
+    "slo": {"p99_ms": 800, "error_rate": 0.0, "window_s": 5},
+    "actions": [
+        {"at": 6.0, "do": "rollback", "generation": "first"},
+        {"at": 2.0, "do": "publish", "metric": 0.95},
+        {"at": 2.5, "do": "chaos", "drop": 0.2, "delay_ms": 5, "dup": 0.2},
+    ],
+}
+
+
+def test_from_dict_parses_and_sorts_actions():
+    s = Scenario.from_dict(SCENARIO_DICT)
+    assert s.duration_s == 8.0
+    assert [a.do for a in s.actions] == ["publish", "chaos", "rollback"]
+    assert s.actions[0].args == {"metric": 0.95}
+    assert s.actions[1].args == {"drop": 0.2, "delay_ms": 5, "dup": 0.2}
+    assert s.slo.p99_ms == 800
+    assert s.slo.error_rate == 0.0
+
+
+def test_from_file_roundtrip(tmp_path):
+    p = tmp_path / "scenario.json"
+    p.write_text(json.dumps(SCENARIO_DICT))
+    s = Scenario.from_file(str(p))
+    assert s.template == "/probe/recommend/u%d"
+    assert len(s.actions) == 3
+
+
+def test_build_arrivals_and_skew():
+    s = Scenario.from_dict(SCENARIO_DICT)
+    arrivals = s.build_arrivals()
+    assert isinstance(arrivals, PoissonProcess)
+    assert arrivals.rate == 150.0
+    skew = s.build_skew()
+    assert isinstance(skew, PowerLawUsers)
+    assert skew.n_users == 2_000_000 and skew.hot_count == 16
+
+    diurnal = Scenario.from_dict(
+        {"arrivals": {"process": "diurnal", "base_rate": 10, "peak_rate": 40, "period_s": 5}}
+    ).build_arrivals()
+    assert isinstance(diurnal, DiurnalRampProcess)
+
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        Scenario.from_dict({"arrivals": {"process": "warp"}}).build_arrivals()
+
+
+def test_runner_fires_actions_in_order():
+    fired = []
+    runner = ScenarioRunner(
+        [
+            Action(0.15, "b", {"x": 2}),
+            Action(0.05, "a", {"x": 1}),
+        ],
+        {"a": lambda x: fired.append(("a", x)), "b": lambda x: fired.append(("b", x))},
+    )
+    t0 = time.monotonic()
+    runner.start()
+    runner.join(timeout=5.0)
+    assert fired == [("a", 1), ("b", 2)]
+    assert [a.do for a in runner.executed] == ["a", "b"]
+    assert not runner.errors
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_runner_records_handler_failures_and_unknown_verbs():
+    boom = RuntimeError("boom")
+
+    def explode():
+        raise boom
+
+    runner = ScenarioRunner(
+        [Action(0.0, "explode"), Action(0.0, "nosuch"), Action(0.01, "ok")],
+        {"explode": explode, "ok": lambda: None},
+    )
+    runner.start()
+    runner.join(timeout=5.0)
+    assert [a.do for a in runner.executed] == ["ok"]
+    kinds = {a.do: type(e) for a, e in runner.errors}
+    assert kinds == {"explode": RuntimeError, "nosuch": ValueError}
+
+
+def test_runner_stop_halts_timeline():
+    fired = []
+    runner = ScenarioRunner(
+        [Action(0.02, "a"), Action(5.0, "late")],
+        {"a": lambda: fired.append("a"), "late": lambda: fired.append("late")},
+    )
+    runner.start()
+    time.sleep(0.1)
+    runner.stop()
+    runner.join(timeout=2.0)
+    assert not runner.is_alive()
+    assert fired == ["a"]

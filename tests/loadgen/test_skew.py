@@ -1,0 +1,75 @@
+"""User-skew sampler contracts: O(1) power-law sampling really is
+head-heavy, the hot-key overlay concentrates the declared fraction, and
+everything is deterministic per seed."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.loadgen import PowerLawUsers
+
+pytestmark = pytest.mark.fleet
+
+
+def test_deterministic_per_seed():
+    a = PowerLawUsers(1_000_000, seed=3).sample(500)
+    b = PowerLawUsers(1_000_000, seed=3).sample(500)
+    c = PowerLawUsers(1_000_000, seed=4).sample(500)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_ids_in_range_over_millions_of_users():
+    ids = PowerLawUsers(5_000_000, exponent=1.2, seed=1).sample(20_000)
+    assert ids.min() >= 0
+    assert ids.max() < 5_000_000
+    # the tail is actually reachable, not collapsed onto the head
+    assert ids.max() > 100_000
+
+
+def test_power_law_head_dominates():
+    n = 1_000_000
+    ids = PowerLawUsers(n, exponent=1.1, seed=2).sample(50_000)
+    head_share = float(np.mean(ids < n // 100))  # top 1% of the id space
+    assert head_share > 0.25  # vastly more than the uniform 1%
+
+
+def test_higher_exponent_concentrates_harder():
+    n = 1_000_000
+    mild = PowerLawUsers(n, exponent=1.05, seed=6).sample(30_000)
+    steep = PowerLawUsers(n, exponent=1.5, seed=6).sample(30_000)
+    share = lambda ids: float(np.mean(ids < 1000))  # noqa: E731
+    assert share(steep) > share(mild)
+
+
+def test_hot_key_overlay_concentration():
+    users = PowerLawUsers(
+        1_000_000, exponent=1.1, hot_count=8, hot_weight=0.5, seed=11
+    )
+    ids = users.sample(20_000)
+    hot_share = float(np.mean(ids < 8))
+    # >= hot_weight: the power-law body also lands on low ids sometimes
+    assert hot_share >= 0.45
+
+
+def test_exponent_one_special_case():
+    ids = PowerLawUsers(100_000, exponent=1.0, seed=5).sample(10_000)
+    assert ids.min() >= 0 and ids.max() < 100_000
+    assert float(np.mean(ids < 1000)) > 0.3  # log-uniform head dominance
+
+
+def test_one_returns_python_int():
+    u = PowerLawUsers(1000, seed=0)
+    v = u.one()
+    assert isinstance(v, int)
+    assert 0 <= v < 1000
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        PowerLawUsers(0)
+    with pytest.raises(ValueError):
+        PowerLawUsers(10, exponent=0.0)
+    with pytest.raises(ValueError):
+        PowerLawUsers(10, hot_weight=1.5)
+    with pytest.raises(ValueError):
+        PowerLawUsers(10, hot_weight=0.5, hot_count=0)

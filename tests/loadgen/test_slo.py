@@ -1,0 +1,162 @@
+"""SLO accounting: SLOWindow burn rates under a fake clock, verdict
+composition over engine results, and server-side burn from /metrics
+snapshot differencing."""
+
+import math
+from collections import Counter
+
+import pytest
+
+from oryx_tpu.common.metrics import SLOWindow
+from oryx_tpu.loadgen import SLOSpec, Target, evaluate_slo
+from oryx_tpu.loadgen.engine import LoadResult, RequestRecord
+from oryx_tpu.loadgen.slo import burn_from_metrics
+
+pytestmark = pytest.mark.fleet
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# -- SLOWindow ---------------------------------------------------------------
+
+
+def test_window_error_rate_and_pruning():
+    clk = FakeClock()
+    w = SLOWindow(horizon_s=100.0, clock=clk)
+    for i in range(10):
+        clk.t = float(i)
+        w.record(ok=i != 3, latency_s=0.01)  # one failure at t=3
+    clk.t = 9.0
+    assert w.count(100.0) == 10
+    assert w.error_rate(100.0) == pytest.approx(0.1)
+    # a window that excludes t=3 sees no failures
+    assert w.error_rate(5.0) == 0.0
+    # horizon pruning: jump far ahead, record once, old events are gone
+    clk.t = 200.0
+    w.record(ok=True, latency_s=0.01)
+    assert w.count(1000.0) == 1
+
+
+def test_zero_error_slo_burns_infinitely_on_any_failure():
+    clk = FakeClock()
+    w = SLOWindow(clock=clk)
+    w.record(ok=True, latency_s=0.01)
+    assert w.error_burn_rate(60.0, slo_error_rate=0.0) == 0.0
+    w.record(ok=False, latency_s=0.01)
+    assert w.error_burn_rate(60.0, slo_error_rate=0.0) == math.inf
+
+
+def test_burn_rate_is_observed_over_budget():
+    clk = FakeClock()
+    w = SLOWindow(clock=clk)
+    for i in range(100):
+        w.record(ok=i % 10 != 0, latency_s=0.01)  # 10% failures
+    assert w.error_burn_rate(60.0, slo_error_rate=0.01) == pytest.approx(10.0)
+    assert w.error_burn_rate(60.0, slo_error_rate=0.10) == pytest.approx(1.0)
+    assert w.error_burn_rate(60.0, slo_error_rate=0.20) == pytest.approx(0.5)
+
+
+def test_latency_quantile_and_latency_burn():
+    clk = FakeClock()
+    w = SLOWindow(clock=clk)
+    for i in range(100):
+        w.record(ok=True, latency_s=0.001 * (i + 1))  # 1..100 ms
+    assert w.latency_quantile(0.50, 60.0) == pytest.approx(0.051)
+    assert w.latency_quantile(0.99, 60.0) == pytest.approx(0.100)
+    # 5% of requests exceed 95 ms; budget of 1% -> burn 5
+    assert w.latency_burn_rate(60.0, 0.095, 0.01) == pytest.approx(5.0)
+    assert w.latency_burn_rate(60.0, 0.200, 0.01) == 0.0
+
+
+def test_empty_window_is_quiet():
+    w = SLOWindow(clock=FakeClock())
+    assert w.error_rate(60.0) == 0.0
+    assert w.error_burn_rate(60.0, 0.0) == 0.0
+    assert w.latency_quantile(0.99, 60.0) == 0.0
+
+
+# -- evaluate_slo ------------------------------------------------------------
+
+
+def _result(latencies_s, failed_kinds=(), target=None):
+    target = target or Target("r0", "http://127.0.0.1:1")
+    records = [
+        RequestRecord(t_sched=i * 0.01, latency=lat, service=lat, target="r0", ok=True, kind="ok")
+        for i, lat in enumerate(latencies_s)
+    ]
+    for j, kind in enumerate(failed_kinds):
+        records.append(
+            RequestRecord(t_sched=j * 0.01, latency=0.0, service=0.0, target="r0", ok=False, kind=kind)
+        )
+    return LoadResult(
+        duration_s=1.0,
+        offered=len(records),
+        completed=len(records),
+        ok=len(latencies_s),
+        failed=len(failed_kinds),
+        error_kinds=Counter(failed_kinds),
+        records=records,
+        queued_arrivals=0,
+        peak_inflight=1,
+        per_target={"r0": target},
+    )
+
+
+def test_verdict_passes_clean_run():
+    verdict = evaluate_slo(_result([0.01] * 50), SLOSpec(p99_ms=100.0))
+    assert verdict
+    assert verdict.passed and not verdict.violations
+    assert verdict.failed_requests == 0
+
+
+def test_zero_downtime_slo_fails_on_single_failure():
+    verdict = evaluate_slo(
+        _result([0.01] * 50, failed_kinds=["http-5xx"]),
+        SLOSpec(p99_ms=100.0, error_rate=0.0),
+    )
+    assert not verdict
+    assert any("zero-downtime" in v for v in verdict.violations)
+    assert "http-5xx" in verdict.violations[-1] or "http-5xx" in str(verdict.violations)
+
+
+def test_p99_violation_detected():
+    verdict = evaluate_slo(
+        _result([0.01] * 98 + [0.5, 0.6]), SLOSpec(p99_ms=100.0)
+    )
+    assert not verdict.passed
+    assert any("p99" in v for v in verdict.violations)
+
+
+def test_nonzero_error_budget_allows_some_failures():
+    verdict = evaluate_slo(
+        _result([0.01] * 99, failed_kinds=["timeout"]),
+        SLOSpec(p99_ms=100.0, error_rate=0.05, max_burn=math.inf),
+    )
+    assert verdict.passed, verdict.violations
+
+
+# -- burn_from_metrics -------------------------------------------------------
+
+
+def _snap(n2xx, n5xx):
+    return {
+        "serving.responses.2xx": {"type": "counter", "value": n2xx},
+        "serving.responses.5xx": {"type": "counter", "value": n5xx},
+    }
+
+
+def test_burn_from_metrics_differences_counters():
+    before, after = _snap(100, 0), _snap(190, 10)  # 10 bad of 100 new
+    assert burn_from_metrics(before, after, 60.0, 0.01) == pytest.approx(10.0)
+    assert burn_from_metrics(before, after, 60.0, 0.0) == math.inf
+    assert burn_from_metrics(before, before, 60.0, 0.01) == 0.0
+
+
+def test_burn_from_metrics_handles_missing_counters():
+    assert burn_from_metrics({}, {}, 60.0, 0.01) == 0.0

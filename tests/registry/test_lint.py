@@ -12,6 +12,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 sys.path.insert(0, str(REPO_ROOT / "tools"))
 
 import lint_config  # noqa: E402
+import lint_deploy  # noqa: E402
 import lint_registry  # noqa: E402
 
 
@@ -49,6 +50,47 @@ def test_ann_config_lint_accepts_known_keys(tmp_path):
         "oryx.serving.scan.ann.host-stage1 = false\n"
     )
     rc, problems, _ = lint_config.run_lint([good])
+    assert rc == 0, "\n".join(problems)
+
+
+def test_deploy_manifests_lint_clean():
+    rc, problems, engine = lint_deploy.run_lint()
+    assert rc == 0, f"[{engine}] " + "\n".join(problems)
+
+
+def test_deploy_lint_rejects_bad_manifest(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    # concatenation keeps the typo'd literals out of THIS file's source
+    bad.write_text(
+        'args: ["serv' + 'nig", "--conf", "/etc/oryx/oryx.conf"]\n'
+        "httpGet: {path: /red" + "dy, port: 8080}\n"
+        "# reads oryx.serving.api.pr" + "ot at startup\n"
+    )
+    rc, problems, _ = lint_deploy.run_lint([bad])
+    assert rc == 1
+    assert len(problems) == 3
+    joined = "\n".join(problems)
+    assert "not an oryx_tpu CLI command" in joined
+    assert "probe path" in joined
+    assert "not declared in reference.conf" in joined
+
+
+def test_deploy_lint_rejects_missing_copy_source(tmp_path):
+    df = tmp_path / "Dockerfile"
+    df.write_text("FROM python:3.12-slim\nCOPY no_such_dir/ no_such_dir/\n")
+    rc, problems, _ = lint_deploy.run_lint([df])
+    assert rc == 1
+    assert "COPY source" in problems[0]
+
+
+def test_deploy_lint_accepts_real_manifest_shapes(tmp_path):
+    good = tmp_path / "good.yaml"
+    good.write_text(
+        'args: ["serving", "--conf", "/etc/oryx/oryx.conf"]\n'
+        "httpGet: {path: /ready, port: 8080}\n"
+        "# tune oryx.serving.api.port per environment\n"
+    )
+    rc, problems, _ = lint_deploy.run_lint([good])
     assert rc == 0, "\n".join(problems)
 
 

@@ -1,0 +1,237 @@
+"""Multi-replica serving fleet under open-loop load (-m fleet).
+
+The acceptance scenario from the ISSUE: N real ServingLayer replicas on
+one chaos-wrapped update topic, fixed offered rate held by the open-loop
+engine, and mid-run the driver publishes a new generation, opens a
+seeded fault window on the update bus (drops / delays / duplicate MODEL
+deliveries), closes it, and rolls back — with ZERO failed requests and
+fleet p99 inside the SLO as hard assertions, plus rolling drain-restarts
+proving the zero-downtime half of the story.
+
+These are real-sleep tests (seconds each, not minutes) — they stay in
+tier-1 because zero-downtime is exactly the property that rots silently
+when it is only checked by hand."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+from oryx_tpu.bus.faultbus import get_state
+from oryx_tpu.loadgen import OpenLoopEngine, PoissonProcess, PowerLawUsers
+from oryx_tpu.registry.tracking import record_fleet_skew
+
+from fleet import FleetHarness, default_scenario, run_scenario  # noqa: E402
+
+pytestmark = pytest.mark.fleet
+
+
+def _generation_counters(layer) -> dict[str, float]:
+    """Per-generation request counters from one replica's instance-scoped
+    metrics (the observability the rotation assertions run on)."""
+    snap = layer.instance_metrics.snapshot()
+    prefix = "serving.requests.generation."
+    return {
+        name[len(prefix):]: entry["value"]
+        for name, entry in snap.items()
+        if name.startswith(prefix)
+    }
+
+
+def test_three_replica_rotation_under_chaos_zero_downtime(tmp_path):
+    """THE acceptance scenario: 3 replicas, fixed offered rate, publish +
+    chaos window + rollback mid-run; zero failed requests, p99 in SLO,
+    fleet converged back on the first generation with zero skew."""
+    with FleetHarness(3, str(tmp_path), bus_name="fleet-acceptance") as fleet:
+        first = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(first, timeout=15.0)
+
+        scenario = default_scenario(rate=120.0, seconds=8.0)
+        result, verdict, runner = run_scenario(fleet, scenario)
+
+        # every scripted action executed, none errored
+        assert not runner.errors, runner.errors
+        assert [a.do for a in runner.executed] == ["chaos", "publish", "chaos", "rollback"]
+
+        # zero-downtime: not one failed request across the whole timeline
+        assert result.failed == 0, dict(result.error_kinds)
+        assert result.ok == result.offered > 0
+        assert verdict.passed, verdict.violations
+        assert verdict.p99_ms <= scenario.slo.p99_ms
+
+        # the chaos window was actually consulted on the update path
+        assert get_state(fleet.chaos_locator).rolls > 0
+
+        # the fleet converged back on generation A with zero skew
+        assert fleet.generations[0] == first and fleet.generations[-1] == first
+        assert fleet.wait_converged(first, timeout=10.0)
+        assert record_fleet_skew(fleet.replica_generations()) == 0
+
+        second = fleet.generations[1]
+        for i, layer in enumerate(fleet.replicas):
+            # exactly A -> B -> A reached each manager: duplicate MODEL
+            # deliveries from the dup/drop levers were all suppressed
+            assert layer.model_manager.model_swaps == 3, f"replica {i}"
+            # rotation is observable: every replica served traffic under
+            # BOTH generations (per-generation request counters)
+            gens = _generation_counters(layer)
+            assert gens.get(first, 0) > 0, f"replica {i}: {gens}"
+            assert gens.get(second, 0) > 0, f"replica {i}: {gens}"
+
+        # every replica took a share of the load through the router
+        for name, target in result.per_target.items():
+            assert target.ok > 0, name
+
+
+def test_rolling_restart_under_load_zero_downtime(tmp_path):
+    """Drain-aware rolling restart of every replica, one at a time, while
+    the offered rate holds: readiness pulls the draining replica out of
+    rotation, in-flight requests finish, a fresh replica replays the
+    topic and rejoins — and no request ever fails."""
+    with FleetHarness(2, str(tmp_path), bus_name="fleet-restart") as fleet:
+        gen = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(gen, timeout=15.0)
+        originals = list(fleet.replicas)
+
+        engine = OpenLoopEngine(
+            fleet.targets, template="/probe/recommend/u%d", readiness_poll_s=0.1
+        )
+        from oryx_tpu.loadgen import Action, ScenarioRunner
+
+        runner = ScenarioRunner(
+            [
+                Action(0.8, "restart", {"replica": 0, "drain_s": 5.0}),
+                Action(2.8, "restart", {"replica": 1, "drain_s": 5.0}),
+            ],
+            fleet.handlers(),
+        )
+        runner.start()
+        result = engine.run(
+            PoissonProcess(rate=60.0, seed=3), PowerLawUsers(100_000, seed=3), 6.0
+        )
+        runner.join(timeout=15.0)
+
+        assert not runner.errors, runner.errors
+        assert len(runner.executed) == 2
+        assert result.failed == 0, dict(result.error_kinds)
+        # both slots hold FRESH replicas that replayed to the generation
+        assert fleet.replicas[0] is not originals[0]
+        assert fleet.replicas[1] is not originals[1]
+        assert fleet.wait_converged(gen, timeout=10.0)
+        for layer in fleet.replicas:
+            assert layer.model_manager.model_swaps >= 1
+        # traffic flowed to both slots across the rotation
+        assert result.per_target["replica-0"].ok > 0
+        assert result.per_target["replica-1"].ok > 0
+
+
+def test_rollback_hammered_concurrently_under_traffic(tmp_path):
+    """POST /model/rollback/<gen> from many threads while GET traffic
+    flows: every POST succeeds, no request fails, the tracker lands on
+    exactly one generation fleet-wide, and duplicate-MODEL suppression
+    holds (the hammering causes exactly ONE extra swap, not N)."""
+    with FleetHarness(2, str(tmp_path), bus_name="fleet-hammer") as fleet:
+        first = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(first, timeout=15.0)
+        second = fleet.publish(metric=0.95)
+        assert fleet.wait_converged(second, timeout=15.0)
+
+        statuses: list[int] = []
+        statuses_lock = threading.Lock()
+
+        def hammer():
+            time.sleep(0.5)  # let traffic establish first
+            for _ in range(3):
+                req = urllib.request.Request(
+                    f"{fleet.targets[0].base_url}/model/rollback/{first}",
+                    method="POST",
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        code = resp.status
+                except urllib.error.HTTPError as e:  # noqa: F821
+                    code = e.code
+                with statuses_lock:
+                    statuses.append(code)
+
+        threads = [threading.Thread(target=hammer, daemon=True) for _ in range(6)]
+        for t in threads:
+            t.start()
+        engine = OpenLoopEngine(fleet.targets, template="/probe/recommend/u%d")
+        result = engine.run(
+            PoissonProcess(rate=80.0, seed=5), PowerLawUsers(100_000, seed=5), 4.0
+        )
+        for t in threads:
+            t.join(timeout=15.0)
+
+        assert len(statuses) == 18
+        assert all(s == 200 for s in statuses), statuses
+        assert result.failed == 0, dict(result.error_kinds)
+        # 18 rollback publishes of the SAME generation: the first swaps
+        # every replica back to A, the other 17 MODEL deliveries are
+        # suppressed as duplicates of the live generation
+        assert fleet.wait_converged(first, timeout=10.0)
+        assert record_fleet_skew(fleet.replica_generations()) == 0
+        for i, layer in enumerate(fleet.replicas):
+            assert layer.model_manager.model_swaps == 3, f"replica {i}"
+
+
+def test_drain_aware_shutdown(tmp_path):
+    """begin_drain flips readiness to 503 while the replica keeps serving;
+    drain() blocks on the in-flight count; close(drain_seconds) runs the
+    full drain-then-stop path."""
+    import json
+    import urllib.error
+
+    def get(url):
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    with FleetHarness(1, str(tmp_path), bus_name="fleet-drain") as fleet:
+        gen = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(gen, timeout=15.0)
+        layer = fleet.replicas[0]
+        base = fleet.targets[0].base_url
+
+        status, body = get(f"{base}/readyz")
+        assert status == 200
+        assert json.loads(body)["draining"] is False
+
+        layer.begin_drain()
+        status, body = get(f"{base}/readyz")
+        assert status == 503
+        assert json.loads(body)["draining"] is True
+        assert get(f"{base}/ready")[0] == 503
+        # draining gates READINESS only — in-flight/new requests still work
+        status, body = get(f"{base}/probe/recommend/u1")
+        assert status == 200
+        assert json.loads(body)["generation_id"] == gen
+
+        # drain() waits on the in-flight count, not wall-clock. The GET
+        # above can return to the client a beat before the server-side
+        # handler decrements the counter, so settle rather than assert
+        # an instantaneous zero.
+        deadline = time.monotonic() + 2.0
+        while layer.inflight_requests and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert layer.inflight_requests == 0
+        assert layer.drain(timeout=1.0) is True
+        layer._request_began()
+        assert layer.inflight_requests == 1
+        assert layer.drain(timeout=0.2) is False  # held open -> times out
+        layer._request_ended()
+        assert layer.drain(timeout=1.0) is True
+
+        layer.close(drain_seconds=2.0)  # full drain-then-stop path
+        fleet.replicas = []  # already closed; stop() must not double-close

@@ -1,0 +1,371 @@
+#!/usr/bin/env python
+"""Multi-replica serving fleet driver: N ServingLayers, one update topic,
+open-loop traffic, scripted chaos — zero-downtime as an assertion.
+
+The reference Oryx 2 serving tier scales horizontally: replicas share
+one Kafka update topic and model generations rotate under live traffic.
+This driver stands that topology up in one process — N real ServingLayer
+replicas (each with its own HTTP port, update consumer, generation
+tracker, and instance-scoped /metrics) consuming one update topic
+through the fault-injecting chaos bus — then drives an open-loop load
+scenario against the fleet while publishing generations, rolling back,
+and opening chaos windows mid-run. The verdict (oryx_tpu/loadgen/slo.py)
+asserts the SLO: zero failed requests across a rotation, p99 within
+budget, burn rates under threshold, generation skew settled to 0.
+
+Scenario actions (oryx_tpu/loadgen/scenario.py format):
+  publish   {metric}                — run a ScriptedMetricUpdate batch
+                                      generation and publish it
+  rollback  {generation, replica}   — POST /model/rollback/<gen> to one
+                                      replica; "first"/"previous" resolve
+                                      against the published order
+  chaos     {drop, delay_ms, dup, outage} — set the fault-bus levers
+  restart   {replica, drain_s}      — drain-aware rolling restart of one
+                                      replica (readiness 503 -> in-flight
+                                      drain -> close -> fresh replica)
+
+Usage:
+    python tools/fleet.py --replicas 3 --rate 150 --seconds 10
+    python tools/fleet.py --replicas 3 --scenario scenario.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from oryx_tpu import bus
+from oryx_tpu.bus import faultbus
+from oryx_tpu.bus.core import KeyMessage
+from oryx_tpu.common import config as C
+from oryx_tpu.loadgen import (
+    OpenLoopEngine,
+    Scenario,
+    ScenarioRunner,
+    Target,
+    evaluate_slo,
+)
+from oryx_tpu.registry.tracking import record_fleet_skew
+from oryx_tpu.serving.layer import ServingLayer
+
+UPDATE_TOPIC = "OryxUpdate"
+
+
+def _http(method: str, url: str, timeout: float = 10.0):
+    req = urllib.request.Request(url, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class FleetHarness:
+    """N in-process ServingLayer replicas on one (chaos-wrapped) update
+    topic plus the driver-side machinery to publish generations, roll
+    back, flip chaos levers, and drain-restart replicas."""
+
+    def __init__(
+        self,
+        n_replicas: int,
+        work_dir: str,
+        bus_name: str = "fleet",
+        chaos_seed: int = 7,
+        skew_poll_s: float = 0.25,
+    ) -> None:
+        self.n_replicas = int(n_replicas)
+        self.work_dir = str(work_dir)
+        self.inner_locator = f"inproc://{bus_name}"
+        # replicas consume through the chaos wrapper; levers start at zero
+        # and scenario actions (or schedule_phases) open the fault window
+        self.chaos_locator = (
+            f"fault+{self.inner_locator}?drop=0&delay_ms=0&dup=0&seed={chaos_seed}"
+        )
+        self.model_dir = f"{self.work_dir}/model"
+        self.data_dir = f"{self.work_dir}/data"
+        self.replicas: list[ServingLayer] = []
+        self.targets: list[Target] = []
+        self.generations: list[str] = []  # publish order, ids = timestamp ms
+        self._next_ts = 1000
+        self._skew_poll_s = float(skew_poll_s)
+        self._skew_thread: threading.Thread | None = None
+        self._skew_stop = threading.Event()
+        self.skew_samples: list[tuple[float, list[str | None], int]] = []
+
+    # -- replica lifecycle ---------------------------------------------------
+
+    def _replica_config(self, metric: float = 1.0):
+        return C.get_default().with_overlay(
+            f"""
+            oryx {{
+              id = "Fleet"
+              input-topic.broker = "{self.inner_locator}"
+              update-topic.broker = "{self.chaos_locator}"
+              batch.storage {{ data-dir = "{self.data_dir}/"
+                               model-dir = "{self.model_dir}/" }}
+              serving {{
+                api.port = 0
+                model-manager-class = "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+                application-resources = "oryx_tpu.registry.testing"
+              }}
+              ml {{
+                eval {{ candidates = 1, test-fraction = 0.5 }}
+                gate.max-regression = 0.05
+              }}
+              test.scripted-metric = {metric}
+            }}
+            """
+        )
+
+    def _start_replica(self) -> ServingLayer:
+        layer = ServingLayer(self._replica_config())
+        layer.start()
+        return layer
+
+    def start(self) -> None:
+        bus.get_broker(self.inner_locator).create_topic(UPDATE_TOPIC, 1)
+        for i in range(self.n_replicas):
+            layer = self._start_replica()
+            self.replicas.append(layer)
+            self.targets.append(
+                Target(f"replica-{i}", f"http://127.0.0.1:{layer.port}")
+            )
+        self._skew_stop.clear()
+        self._skew_thread = threading.Thread(
+            target=self._watch_skew, name="FleetSkewWatch", daemon=True
+        )
+        self._skew_thread.start()
+
+    def stop(self) -> None:
+        self._skew_stop.set()
+        if self._skew_thread is not None:
+            self._skew_thread.join(timeout=self._skew_poll_s + 2.0)
+        for layer in self.replicas:
+            layer.close()
+
+    def __enter__(self) -> "FleetHarness":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- observation ---------------------------------------------------------
+
+    def replica_generations(self) -> list[str | None]:
+        """Each replica's live generation, straight from the trackers (the
+        /healthz body reports the same value over HTTP)."""
+        return [layer.health.live_generation for layer in self.replicas]
+
+    def _watch_skew(self) -> None:
+        t0 = time.monotonic()
+        while not self._skew_stop.wait(self._skew_poll_s):
+            gens = self.replica_generations()
+            skew = record_fleet_skew(gens)
+            self.skew_samples.append((time.monotonic() - t0, gens, skew))
+
+    def metrics_snapshot(self, replica: int) -> dict:
+        status, body = _http(
+            "GET", f"{self.targets[replica].base_url}/metrics"
+        )
+        if status != 200:
+            return {}
+        return json.loads(body)
+
+    def wait_converged(self, generation: str, timeout: float = 10.0) -> bool:
+        """True once every replica serves `generation` (skew settled)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(g == generation for g in self.replica_generations()):
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- scenario actions ----------------------------------------------------
+
+    def publish(self, metric: float = 1.0) -> str:
+        """Run one ScriptedMetricUpdate batch generation against the shared
+        model dir and publish it on the update topic (through the RAW inner
+        broker — the batch layer is not the chaos target here)."""
+        from oryx_tpu.registry.testing import ScriptedMetricUpdate
+
+        ts = self._next_ts
+        self._next_ts += 1000
+        update = ScriptedMetricUpdate(self._replica_config(metric))
+        data = [KeyMessage(None, f"r{i}") for i in range(6)]
+        broker = bus.get_broker(self.inner_locator)
+        with broker.producer(UPDATE_TOPIC) as producer:
+            update.run_update(ts, data, [], self.model_dir, producer)
+        self.generations.append(str(ts))
+        return str(ts)
+
+    def _resolve_generation(self, generation: str) -> str:
+        if generation == "first":
+            return self.generations[0]
+        if generation == "previous":
+            return self.generations[-2]
+        return generation
+
+    def rollback(self, generation: str = "previous", replica: int = 0) -> str:
+        gen = self._resolve_generation(str(generation))
+        status, body = _http(
+            "POST", f"{self.targets[replica].base_url}/model/rollback/{gen}"
+        )
+        if status != 200:
+            raise RuntimeError(f"rollback to {gen} failed: {status} {body[:200]!r}")
+        self.generations.append(gen)
+        return gen
+
+    def chaos(self, **levers) -> None:
+        """Set the fault-bus levers (drop / delay_ms / dup / outage) on the
+        replicas' update-topic consumption path."""
+        faultbus.set_levers(self.chaos_locator, **levers)
+
+    def chaos_phases(self, phases: list[dict]) -> None:
+        faultbus.schedule_phases(self.chaos_locator, phases)
+
+    def restart(self, replica: int = 0, drain_s: float = 5.0) -> None:
+        """Drain-aware rolling restart: readiness flips to 503, the load
+        router stops sending within its poll interval, in-flight requests
+        complete, the replica closes, and a fresh one takes its slot (and
+        its Target, at a new port) once it has replayed the topic."""
+        old = self.replicas[replica]
+        old.begin_drain()
+        # let readiness pollers observe the 503 before tearing down
+        time.sleep(0.6)
+        old.drain(drain_s)
+        old.close()
+        fresh = self._start_replica()
+        self.replicas[replica] = fresh
+        self.targets[replica].base_url = f"http://127.0.0.1:{fresh.port}"
+
+    def handlers(self) -> dict:
+        return {
+            "publish": self.publish,
+            "rollback": self.rollback,
+            "chaos": self.chaos,
+            "restart": self.restart,
+        }
+
+
+def run_scenario(
+    harness: FleetHarness,
+    scenario: Scenario,
+    max_inflight: int = 128,
+    timeout_s: float = 10.0,
+):
+    """Drive one scripted scenario: traffic + action timeline + verdict.
+    Returns (LoadResult, SLOVerdict, ScenarioRunner)."""
+    engine = OpenLoopEngine(
+        harness.targets,
+        template=scenario.template,
+        max_inflight=max_inflight,
+        timeout_s=timeout_s,
+    )
+    runner = ScenarioRunner(scenario.actions, harness.handlers())
+    runner.start()
+    try:
+        result = engine.run(
+            scenario.build_arrivals(), scenario.build_skew(), scenario.duration_s
+        )
+    finally:
+        runner.stop()
+        runner.join(timeout=5.0)
+    verdict = evaluate_slo(result, scenario.slo)
+    for action, err in runner.errors:
+        verdict.passed = False
+        verdict.violations.append(f"scenario action {action.do}@{action.at}: {err!r}")
+    return result, verdict, runner
+
+
+def default_scenario(rate: float, seconds: float, seed: int = 7) -> Scenario:
+    """The rotation-under-chaos proof: publish gen B mid-run, open a
+    drop/delay/dup chaos window on the update bus, close it, then roll
+    back to gen A — all while the generator holds the offered rate."""
+    return Scenario.from_dict(
+        {
+            "duration_s": seconds,
+            "template": "/probe/recommend/u%d",
+            "arrivals": {"process": "poisson", "rate": rate, "seed": seed},
+            "skew": {
+                "users": 2_000_000,
+                "exponent": 1.1,
+                "hot_count": 16,
+                "hot_weight": 0.2,
+                "seed": seed,
+            },
+            "slo": {"p99_ms": 1000.0, "error_rate": 0.0, "window_s": 5.0},
+            # ordering is load-bearing: the chaos window opens BEFORE the
+            # publish so generation B's MODEL delivery is what gets
+            # dropped/delayed/duplicated, and it closes well before the
+            # rollback so a stashed duplicate of B cannot redeliver after
+            # A is re-published (which would swap the fleet back)
+            "actions": [
+                {"at": seconds * 0.25, "do": "chaos", "drop": 0.25, "delay_ms": 5, "dup": 0.25},
+                {"at": seconds * 0.35, "do": "publish", "metric": 0.95},
+                {"at": seconds * 0.60, "do": "chaos", "drop": 0, "delay_ms": 0, "dup": 0},
+                {"at": seconds * 0.80, "do": "rollback", "generation": "first"},
+            ],
+        }
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--rate", type=float, default=150.0)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--scenario", default=None, help="scenario JSON file")
+    ap.add_argument("--work-dir", default=None, help="model/data dir (default: temp)")
+    ap.add_argument("--max-inflight", type=int, default=128)
+    args = ap.parse_args()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        work_dir = args.work_dir or tmp
+        scenario = (
+            Scenario.from_file(args.scenario)
+            if args.scenario
+            else default_scenario(args.rate, args.seconds, args.seed)
+        )
+        with FleetHarness(args.replicas, work_dir, chaos_seed=args.seed) as fleet:
+            first = fleet.publish(metric=0.90)
+            if not fleet.wait_converged(first, timeout=15.0):
+                print("fleet: replicas never converged on the first generation")
+                return 2
+            result, verdict, runner = run_scenario(
+                fleet, scenario, max_inflight=args.max_inflight
+            )
+            settled = fleet.wait_converged(fleet.generations[-1], timeout=10.0)
+            final_skew = record_fleet_skew(fleet.replica_generations())
+            report = {
+                "replicas": args.replicas,
+                "scenario_actions": [a.do for a in runner.executed],
+                "generations": fleet.generations,
+                "converged": settled,
+                "final_skew": final_skew,
+                "max_skew_observed": max((s for _, _, s in fleet.skew_samples), default=0),
+                "slo": {
+                    "passed": verdict.passed,
+                    "p99_ms": round(verdict.p99_ms, 2),
+                    "error_rate": verdict.error_rate,
+                    "violations": verdict.violations,
+                },
+                **result.summary(),
+            }
+            print(json.dumps(report, indent=2))
+            return 0 if verdict.passed and settled else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

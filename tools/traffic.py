@@ -18,13 +18,26 @@ from __future__ import annotations
 
 import argparse
 import random
+import sys
 import threading
 import time
 import urllib.request
+from collections import Counter
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from oryx_tpu.loadgen.engine import classify_error
 
 
 def worker(base: str, template: str, users: int, deadline: float,
            latencies: list, errors: list, stop: threading.Event) -> None:
+    """One closed-loop worker. Successes append their latency to
+    `latencies`; failures append their error KIND (a string like
+    "timeout" / "http-5xx" / "connection") to `errors` — a timeout and a
+    500 are different operational events and must never be conflated,
+    and a failure's wall time is not a service latency, so it never
+    lands in the latency histogram."""
     rng = random.Random(threading.get_ident())
     while time.perf_counter() < deadline and not stop.is_set():
         path = template % rng.randrange(users) if "%d" in template else template
@@ -32,30 +45,41 @@ def worker(base: str, template: str, users: int, deadline: float,
         try:
             with urllib.request.urlopen(base + path, timeout=30) as resp:
                 resp.read()
-                ok = 200 <= resp.status < 300
-        except Exception:
-            ok = False
-        dt = time.perf_counter() - t0
-        (latencies if ok else errors).append(dt)
+                if 200 <= resp.status < 300:
+                    latencies.append(time.perf_counter() - t0)
+                else:
+                    errors.append(f"http-{resp.status // 100}xx")
+        except Exception as e:  # noqa: BLE001 - classified, counted
+            errors.append(classify_error(e))
 
 
-def report(latencies: list[float], errors: list[float], elapsed: float,
+def report(latencies: list[float], errors: list[str], elapsed: float,
            workers: int, label: str = "requests") -> None:
-    """Throughput + latency percentile summary (TrafficUtil's stats log)."""
+    """Throughput + latency percentile summary (TrafficUtil's stats log),
+    plus error rate broken down by kind."""
     lat = sorted(latencies)
     n = len(lat)
+    n_err = len(errors)
+    kinds = Counter(errors)
+    err_line = (
+        f"errors: {n_err} ({n_err / (n + n_err):.2%} of requests) "
+        f"by kind {dict(kinds)}"
+        if n_err
+        else "errors: 0"
+    )
     if n == 0:
-        print(f"{label}: no successful requests ({len(errors)} errors)")
+        print(f"{label}: no successful requests | {err_line}")
         return
 
     def pct(p: float) -> float:
         return lat[min(n - 1, int(p * n))] * 1000
 
     print(
-        f"{label}: {n} ok, {len(errors)} failed | "
+        f"{label}: {n} ok, {n_err} failed | "
         f"{n / elapsed:.1f} qps over {elapsed:.1f}s x {workers} workers\n"
         f"latency ms: mean {sum(lat) / n * 1000:.1f}  p50 {pct(0.50):.1f}  "
-        f"p90 {pct(0.90):.1f}  p99 {pct(0.99):.1f}  max {lat[-1] * 1000:.1f}"
+        f"p90 {pct(0.90):.1f}  p99 {pct(0.99):.1f}  max {lat[-1] * 1000:.1f}\n"
+        f"{err_line}"
     )
 
 
