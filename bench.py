@@ -21,8 +21,10 @@ Metrics (vs_baseline frames):
    useful FLOPs / wall / chip peak bf16 FLOP/s.
 3. als-scale — implicit power-law training ratings/s (f32 and bf16
    Gramians).
-4. speed — sustained events/s through the REAL SpeedLayer over the file
-   bus vs the BASELINE.json 100K events/s target.
+4. speed — sustained events/s through the REAL SpeedLayer over the shm
+   bus vs the BASELINE.json 100K events/s target: a backlog row
+   (pre-encoded ring drain, layer capacity) and a live row (producer
+   processes racing the layer).
 5. serving closed-loop — 1..3 concurrent SYNCHRONOUS clients through the
    real HTTP serving path (ServingLayer + endpoints + micro-batcher):
    true per-request p50/p99 next to the pipelined-throughput rows, the
@@ -837,24 +839,25 @@ def bench_rdf() -> None:
 
 def bench_speed() -> None:
     """Run the real-SpeedLayer bench as a subprocess (own process: it
-    spins threads and a file bus) and relay the median of its metric
-    over the trial protocol."""
+    spins threads, producer processes, and an shm bus). Two rows:
+    backlog mode (pre-encoded events drained from the ring — the
+    layer-capacity measure) and live mode (producer processes racing the
+    layer — the end-to-end measure). The trial protocol runs INSIDE the
+    subprocess (--trials): model seeding is paid once per mode instead
+    of once per trial, and the per-trial rates come back in the JSON."""
 
-    def one_trial() -> dict:
+    def run_mode(label: str, extra: list) -> dict:
         proc = subprocess.run(
             [
                 sys.executable,
                 os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
-                "--seconds",
-                "30",
-                "--prefill",
-                "1600000",
-                "--batch-events",
-                "400000",
+                "--trials",
+                str(_TRIALS),
+                *extra,
             ],
             capture_output=True,
             text=True,
-            timeout=400,
+            timeout=600,
             env=dict(os.environ),
         )
         sys.stderr.write(proc.stderr[-1500:])
@@ -863,26 +866,34 @@ def bench_speed() -> None:
             if ln.startswith("{") and '"metric"' in ln:
                 line = ln
         if proc.returncode != 0 or line is None:
-            raise RuntimeError(f"speed bench failed rc={proc.returncode}")
+            raise RuntimeError(
+                f"speed bench ({label}) failed rc={proc.returncode}"
+            )
         return json.loads(line)
 
-    runs = [one_trial() for _ in range(_TRIALS)]
-    d = _median_run(runs, "value")
-    rate, vs, tf = _rate_row([t["value"] for t in runs], SPEED_TARGET_EPS)
-    _emit(
-        f"speed layer sustained fold-in over file bus, median of "
-        f"{tf['trials']} runs, vs 100K events/s BASELINE target "
-        f"({os.cpu_count()}-core host)",
-        rate,
-        "events/sec",
-        vs,
-        order=30,
-        detail=d["metric"],
-        # the speed layer is a host pipeline (bus I/O + parse + fold-in);
-        # label it as such rather than stamping this process's jax backend
-        backend=d.get("backend", f"host/{os.cpu_count()}-core"),
-        **tf,
-    )
+    modes = [
+        ("backlog", ["--prefill", "500000"]),
+        ("live", ["--seconds", "12", "--producers", "2"]),
+    ]
+    for idx, (label, extra) in enumerate(modes):
+        d = run_mode(label, extra)
+        rates = d.get("rates") or [d["value"]]
+        rate, vs, tf = _rate_row(rates, SPEED_TARGET_EPS)
+        _emit(
+            f"speed layer sustained fold-in over shm bus, {label} mode, "
+            f"median of {tf['trials']} trials, vs 100K events/s BASELINE "
+            f"target ({os.cpu_count()}-core host)",
+            rate,
+            "events/sec",
+            vs,
+            order=30 + idx,
+            detail=d["metric"],
+            # the speed layer is a host pipeline (bus I/O + parse +
+            # fold-in); label it as such rather than stamping this
+            # process's jax backend
+            backend=f"host/{os.cpu_count()}-core",
+            **tf,
+        )
 
 
 def bench_serving_closed_loop() -> None:

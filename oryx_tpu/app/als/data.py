@@ -291,18 +291,13 @@ def decay_columns(
     return InteractionColumns(users, items, values, ts)
 
 
-def rating_matrix_from_columns(cols: InteractionColumns, implicit: bool) -> RatingMatrix:
-    """Vectorized aggregate + index: same semantics as
-    ``to_rating_matrix(aggregate(...))`` — implicit sums with NaN
-    poisoning, explicit last-in-timestamp-order wins, NaN aggregates
-    (deletes) dropped, vocab built from surviving pairs only."""
-    users, items, values, ts = cols
+def _aggregate_indexed(uinv, n_items, iinv, values, ts, implicit):
+    """The shared aggregate core: pair (user,item) codes, combine repeated
+    pairs (implicit: float64 sum with NaN poisoning; explicit: last in
+    (timestamp, arrival) order wins), drop NaN aggregates (deletes).
+    Returns (surviving user codes, item codes, aggregated values)."""
     n = len(values)
-    if n == 0:
-        return RatingMatrix([], [], np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.float32))
-    uq, uinv = np.unique(users, return_inverse=True)
-    iq, iinv = np.unique(items, return_inverse=True)
-    pair = uinv.astype(np.int64) * len(iq) + iinv.astype(np.int64)
+    pair = uinv.astype(np.int64) * n_items + iinv.astype(np.int64)
     pq, pinv = np.unique(pair, return_inverse=True)
     if implicit:
         agg = np.bincount(pinv, weights=values.astype(np.float64), minlength=len(pq))
@@ -317,12 +312,69 @@ def rating_matrix_from_columns(cols: InteractionColumns, implicit: bool) -> Rati
         agg = values[order][last]
     keep = ~np.isnan(agg)
     pq, agg = pq[keep], agg[keep]
-    uu_codes = pq // len(iq)
-    ii_codes = pq % len(iq)
+    return pq // n_items, pq % n_items, agg
+
+
+def rating_matrix_from_columns(cols: InteractionColumns, implicit: bool) -> RatingMatrix:
+    """Vectorized aggregate + index: same semantics as
+    ``to_rating_matrix(aggregate(...))`` — implicit sums with NaN
+    poisoning, explicit last-in-timestamp-order wins, NaN aggregates
+    (deletes) dropped, vocab built from surviving pairs only."""
+    users, items, values, ts = cols
+    n = len(values)
+    if n == 0:
+        return RatingMatrix([], [], np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.float32))
+    uq, uinv = np.unique(users, return_inverse=True)
+    iq, iinv = np.unique(items, return_inverse=True)
+    uu_codes, ii_codes, agg = _aggregate_indexed(uinv, len(iq), iinv, values, ts, implicit)
     u_used, uu = np.unique(uu_codes, return_inverse=True)
     i_used, ii = np.unique(ii_codes, return_inverse=True)
     user_ids = [b.decode("utf-8", "replace") for b in uq[u_used].tolist()]
     item_ids = [b.decode("utf-8", "replace") for b in iq[i_used].tolist()]
+    return RatingMatrix(
+        user_ids,
+        item_ids,
+        uu.astype(np.int32),
+        ii.astype(np.int32),
+        agg.astype(np.float32),
+    )
+
+
+def rating_matrix_from_int_columns(
+    users: np.ndarray,
+    items: np.ndarray,
+    values: np.ndarray,
+    timestamps: np.ndarray | None,
+    implicit: bool,
+    user_prefix: bytes = b"u",
+    item_prefix: bytes = b"i",
+) -> RatingMatrix:
+    """Typed-transport twin of :func:`rating_matrix_from_columns`: int32 id
+    codes straight off a columnar bus frame, aggregated by the SAME core.
+    The S-id path would render "u%d"/"i%d" strings for every event and
+    then parse them back; here strings are materialized ONLY for the ids
+    that survive aggregation (one np.char.mod over the used vocab), so the
+    per-event cost is pure integer arithmetic. Vocab order is numeric
+    rather than lexicographic — RatingMatrix consumers index through
+    user_ids/item_ids, so ordering is internal only."""
+    n = len(values)
+    if n == 0:
+        return RatingMatrix([], [], np.empty(0, np.int32), np.empty(0, np.int32), np.empty(0, np.float32))
+    values = np.asarray(values, dtype=np.float32)
+    ts = (
+        np.zeros(n, dtype=np.int64)
+        if timestamps is None
+        else np.asarray(timestamps, dtype=np.int64)
+    )
+    uq, uinv = np.unique(np.asarray(users), return_inverse=True)
+    iq, iinv = np.unique(np.asarray(items), return_inverse=True)
+    uu_codes, ii_codes, agg = _aggregate_indexed(uinv, len(iq), iinv, values, ts, implicit)
+    u_used, uu = np.unique(uu_codes, return_inverse=True)
+    i_used, ii = np.unique(ii_codes, return_inverse=True)
+    up = user_prefix.decode("ascii", "replace")
+    ip = item_prefix.decode("ascii", "replace")
+    user_ids = np.char.mod(up + "%d", uq[u_used]).tolist()
+    item_ids = np.char.mod(ip + "%d", iq[i_used]).tolist()
     return RatingMatrix(
         user_ids,
         item_ids,

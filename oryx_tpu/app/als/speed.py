@@ -26,7 +26,7 @@ from oryx_tpu.app.als import data as als_data
 from oryx_tpu.app.als.common import apply_up_lines, consume_blocks_columnar
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
-from oryx_tpu.common.records import Records
+from oryx_tpu.common.records import InteractionBlock, Records
 from oryx_tpu.common.text import json_str as _json_str, read_json
 from oryx_tpu.common.vectormath import Solver, SingularMatrixSolverException, get_solver
 from oryx_tpu.native.store import (
@@ -234,14 +234,55 @@ class ALSSpeedModelManager(SpeedModelManager):
         # (ALSSpeedModelManager.buildUpdates:136-138 gates identically)
         if model is None or model.get_fraction_loaded() < self.min_model_load_fraction:
             return []
-        # columnar parse + aggregate: one numpy pass over the micro-batch
-        # (same semantics as parse_interactions + aggregate; the indexed
-        # form gives aggregated (user, item, value) triples directly).
-        # Records input (the layer's poll_block drain) stays columnar end
-        # to end; plain iterables pay one encode per record.
+        return self.fold_parsed(self.parse_batch(new_data))
+
+    def parse_batch(self, new_data: Iterable[KeyMessage]):
+        """Stage 1 of the staged micro-batch: parse + aggregate the raw
+        events into a RatingMatrix. Model-independent, so the pipelined
+        layer can run it on the parse worker while the fold worker is
+        still busy with the previous batch. Returns None when the batch
+        holds no events.
+
+        Typed :class:`InteractionBlock` batches (binary columnar bus
+        frames) skip text entirely — int codes flow straight into the
+        shared aggregate core; a batch mixing typed and text blocks (or
+        typed blocks with differing prefixes/timestamp presence) falls
+        back through the blocks' rendered ``messages``, which is the
+        exact same wire text the producer would have sent line-framed.
+        """
         if isinstance(new_data, Records):
+            blocks = list(new_data.blocks())
+            if blocks and all(isinstance(b, InteractionBlock) for b in blocks):
+                first = blocks[0]
+                has_ts = first.timestamps is not None
+                if all(
+                    b.user_prefix == first.user_prefix
+                    and b.item_prefix == first.item_prefix
+                    and (b.timestamps is not None) == has_ts
+                    for b in blocks
+                ):
+                    if len(blocks) == 1:
+                        users, items, values = first.users, first.items, first.values
+                        ts = first.timestamps
+                    else:
+                        users = np.concatenate([b.users for b in blocks])
+                        items = np.concatenate([b.items for b in blocks])
+                        values = np.concatenate([b.values for b in blocks])
+                        ts = (
+                            np.concatenate([b.timestamps for b in blocks])
+                            if has_ts
+                            else None
+                        )
+                    return als_data.rating_matrix_from_int_columns(
+                        users, items, values, ts, self.implicit,
+                        first.user_prefix, first.item_prefix,
+                    )
+            # columnar text parse + aggregate: one numpy pass over the
+            # micro-batch (same semantics as parse_interactions +
+            # aggregate; the indexed form gives aggregated (user, item,
+            # value) triples directly)
             cols = als_data.concat_columns(
-                [als_data.parse_interaction_block(b.messages) for b in new_data.blocks()]
+                [als_data.parse_interaction_block(b.messages) for b in blocks]
             )
         else:
             msgs = [
@@ -249,10 +290,31 @@ class ALSSpeedModelManager(SpeedModelManager):
                 for km in new_data
             ]
             if not msgs:
-                return []
+                return None
             cols = als_data.parse_interaction_block(msgs)
         rm = als_data.rating_matrix_from_columns(cols, self.implicit)
-        if len(rm.values) == 0:
+        return rm if len(rm.values) else None
+
+    def _device_gramian(self, solver: Solver):
+        """The solver's Gramian as a cached device array: solver caches
+        invalidate exactly when the Gramian changes (writes, rotation),
+        so a fresh Solver is the only event that re-pays the upload."""
+        from oryx_tpu.ops import als as als_ops
+
+        g = getattr(solver, "_device_gramian", None)
+        if g is None:
+            g = als_ops.device_gramian(solver.matrix)
+            solver._device_gramian = g
+        return g
+
+    def fold_parsed(self, rm) -> list[str]:
+        """Stage 2: fold an aggregated RatingMatrix into the live model
+        and render the update messages. Re-checks the load-fraction gate
+        (the pipeline parses ahead of the model becoming ready)."""
+        model = self.model
+        if rm is None or len(rm.values) == 0:
+            return []
+        if model is None or model.get_fraction_loaded() < self.min_model_load_fraction:
             return []
         try:
             yty = model.get_yty_solver()
@@ -271,19 +333,30 @@ class ALSSpeedModelManager(SpeedModelManager):
         from oryx_tpu.ops import als as als_ops
 
         n = len(rm.values)
-        # object-array gather: one C pass per side instead of a Python
-        # list-index loop per event
+        # vocab-level gather: one native fetch per UNIQUE id, expanded to
+        # per-event rows by a fancy-index copy — the store pays |vocab|
+        # hash lookups and one id-payload pack instead of one per event
         user_ids_arr = np.asarray(rm.user_ids, dtype=object)
         item_ids_arr = np.asarray(rm.item_ids, dtype=object)
-        users = user_ids_arr[rm.user_idx].tolist()
-        items = item_ids_arr[rm.item_idx].tolist()
-        xu, xu_valid = model.x.get_batch(users, dim=model.features)
-        yi, yi_valid = model.y.get_batch(items, dim=model.features)
+        xu_vocab, xu_ok = model.x.get_batch(user_ids_arr.tolist(), dim=model.features)
+        yi_vocab, yi_ok = model.y.get_batch(item_ids_arr.tolist(), dim=model.features)
+        xu, xu_valid = xu_vocab[rm.user_idx], xu_ok[rm.user_idx]
+        yi, yi_valid = yi_vocab[rm.item_idx], yi_ok[rm.item_idx]
         values = rm.values
-        new_xu, x_upd, new_yi, y_upd = als_ops.fold_in_batch(
-            yty.matrix, xtx.matrix, xu, xu_valid, yi, yi_valid, values,
-            self.implicit, backend=self.fold_backend,
+        session = als_ops.FoldInSession(
+            yty.matrix, xtx.matrix, self.implicit, backend=self.fold_backend
         )
+        if session.resolved_backend(n, model.features) == "device":
+            # device-resident Gramians: uploaded once per Solver (i.e.
+            # only when vector writes or a rotation invalidated the
+            # cache), not once per micro-batch. Host/auto folds keep the
+            # float64 originals — their Cholesky runs in f64, and the
+            # device path casts to f32 regardless, so results are
+            # bit-identical to the unbatched fold either way.
+            session.yty = self._device_gramian(yty)
+            session.xtx = self._device_gramian(xtx)
+        session.add_block(xu, xu_valid, yi, yi_valid, values)
+        new_xu, x_upd, new_yi, y_upd = session.solve()
         x_rows = np.nonzero(x_upd)[0]
         y_rows = np.nonzero(y_upd)[0]
         known = not self.no_known_items

@@ -175,6 +175,9 @@ def get_broker(locator: str) -> Broker:
 
     inproc://<name> — process-local named broker (tests, single-process runs)
     file:/<dir> or file://<dir> or a bare path — file-backed broker
+    shm:/<dir>[?ring_mb=N&full_block_ms=MS&frame_records=K] — shared-memory
+        ring-buffer broker with a zero-copy columnar block format
+        (oryx_tpu.bus.shmbus; the high-rate speed-layer transport)
     tcp://host:port[?connect_timeout=S&retry_max_attempts=N&...] —
         networked bus server (oryx_tpu.bus.netbus; start one with
         `python -m oryx_tpu bus-serve`)
@@ -202,6 +205,14 @@ def get_broker(locator: str) -> Broker:
         from oryx_tpu.bus.kafkabus import KafkaBroker
 
         return KafkaBroker(locator[len("kafka://") :])
+    if locator.startswith("shm:"):
+        path = locator[len("shm:") :]
+        while path.startswith("//"):
+            path = path[1:]
+        path, _, query = path.partition("?")
+        from oryx_tpu.bus.shmbus import ShmBroker
+
+        return ShmBroker(path, **ShmBroker.options_from_query(query))
     if locator.startswith("file:"):
         path = locator[len("file:") :]
         while path.startswith("//"):

@@ -312,6 +312,34 @@ class _FaultProducer(TopicProducer):
             metrics.registry.counter("bus.fault.duplicated").inc(len(records))
         return n
 
+    def send_interactions(self, users, items, values, **kwargs) -> int:
+        """Typed columnar produce (block-framed transports): the same
+        drop/delay/dup levers as send_many, rolled once per call — a
+        dropped request never reaches the ring, a dup re-sends the whole
+        column set."""
+        send = getattr(self._inner, "send_interactions", None)
+        if send is None:
+            raise NotImplementedError(
+                f"{type(self._inner).__name__} does not support send_interactions"
+            )
+        state = self._state
+        state.check_outage("produce")
+        n = len(values)
+        if n == 0:
+            return 0
+        r = state.roll()
+        if r < state.drop:
+            state.injected_errors += 1
+            metrics.registry.counter("bus.fault.injected-errors").inc()
+            raise ConnectionError("injected transient produce failure")
+        state.maybe_delay()
+        sent = send(users, items, values, **kwargs)
+        if state.dup > 0.0 and r < state.drop + state.dup:
+            send(users, items, values, **kwargs)
+            state.duplicated_records += n
+            metrics.registry.counter("bus.fault.duplicated").inc(n)
+        return sent
+
     def close(self) -> None:
         self._inner.close()
 
@@ -366,11 +394,28 @@ class _FaultConsumer(TopicConsumer):
         pre = self._inner.positions()
 
         def stash(batch):
+            # block-framed transports hand out zero-copy views whose
+            # lifetime ends at the next poll; a stashed duplicate must
+            # outlive that, so copy it out of the transport buffer
+            if hasattr(batch, "materialize"):
+                batch = batch.materialize()
             self._redeliver_block = batch
 
         return self._fault_fetch(
             lambda: self._inner.poll_block(max_records, timeout), pre, len, stash
         )
+
+    def pin(self) -> None:
+        """Guard-freeze passthrough for block-framed transports (no-op on
+        brokers without a guard)."""
+        inner_pin = getattr(self._inner, "pin", None)
+        if inner_pin is not None:
+            inner_pin()
+
+    def release(self) -> None:
+        inner_release = getattr(self._inner, "release", None)
+        if inner_release is not None:
+            inner_release()
 
     def positions(self) -> dict[int, int]:
         return self._inner.positions()

@@ -34,12 +34,10 @@ from __future__ import annotations
 import fcntl
 import json
 import os
-import re
 import time
 from pathlib import Path
 
-import numpy as np
-
+from oryx_tpu.bus import blockcodec
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, partition_for
 
 _OFFSETS_DIR = "__offsets__"
@@ -251,52 +249,19 @@ _READ_CHUNK_BYTES = 1 << 20
 
 # -- record wire format -------------------------------------------------------
 #
-# One record per line: `<key>\t<message>`, fields backslash-escaped for
-# \\ \t \n \r \0, None key encoded as a single NUL byte. Chosen over
-# JSON-per-line deliberately: framework messages are themselves JSON
-# ("UP" deltas, MODEL PMML), and JSON-in-JSON escapes every quote — which
-# forced the consumer's hot path through json.loads per record. With
-# tab-framing, typical records contain no escapes at all and both ends
-# are pure byte slicing. Legacy `{"k":...,"m":...}` lines still decode.
+# One record per line: `<key>\t<message>` with backslash escapes; see
+# bus/blockcodec.py, the single home of both the text and the binary
+# frame codecs (shared with netbus and shmbus so the formats cannot
+# drift). The old private names stay as aliases for callers that grew
+# up importing them from here.
 
-_ESC_MAP = {0x5C: 0x5C, 0x74: 0x09, 0x6E: 0x0A, 0x72: 0x0D, 0x30: 0x00}
-_NEEDS_ESC = re.compile(r"[\\\t\n\r\x00]")  # one C scan per field, not 5
-# batch form for send_many's joined slices: \t and \n are the legitimate
-# separators and \x00 the legitimate None-key marker there, so those
-# three are checked by count, not by pattern
-_NEEDS_ESC_BODY = re.compile(r"[\\\r]")
-_SENTINEL = object()
-
-
-def _enc_field(s: str) -> str:
-    if _NEEDS_ESC.search(s) is not None:
-        s = (
-            s.replace("\\", "\\\\")
-            .replace("\t", "\\t")
-            .replace("\n", "\\n")
-            .replace("\r", "\\r")
-            .replace("\x00", "\\0")
-        )
-    return s
-
-
-def _encode_record(key: str | None, message: str) -> str:
-    k = "\x00" if key is None else _enc_field(key)
-    return k + "\t" + _enc_field(message)
-
-
-def _unescape(b: bytes) -> bytes:
-    out = bytearray()
-    i, n = 0, len(b)
-    while i < n:
-        c = b[i]
-        if c == 0x5C and i + 1 < n:
-            out.append(_ESC_MAP.get(b[i + 1], b[i + 1]))
-            i += 2
-        else:
-            out.append(c)
-            i += 1
-    return bytes(out)
+_ESC_MAP = blockcodec._ESC_MAP
+_NEEDS_ESC = blockcodec._NEEDS_ESC
+_NEEDS_ESC_BODY = blockcodec._NEEDS_ESC_BODY
+_SENTINEL = blockcodec._SENTINEL
+_enc_field = blockcodec.enc_field
+_encode_record = blockcodec.encode_record
+_unescape = blockcodec.unescape
 
 
 class _FileProducer(TopicProducer):
@@ -521,25 +486,7 @@ class _FileConsumer(TopicConsumer):
 
     @staticmethod
     def _decode_line(line: bytes) -> KeyMessage | None:
-        if line.startswith(b'{"k":'):  # legacy JSON-per-line record
-            try:
-                rec = json.loads(line)
-                return KeyMessage(rec.get("k"), rec.get("m", ""))
-            except json.JSONDecodeError:
-                pass  # not legacy after all; try the tab format
-        tab = line.find(b"\t")
-        if tab == -1:
-            return None  # corrupt complete line: skip it for good
-        kf, mf = line[:tab], line[tab + 1 :]
-        # the None sentinel is a LITERAL lone NUL (the encoder escapes any
-        # real NUL), so test before unescaping
-        if kf == b"\x00":
-            key = None
-        else:
-            key = (_unescape(kf) if b"\\" in kf else kf).decode("utf-8", "replace")
-        if b"\\" in mf:
-            mf = _unescape(mf)
-        return KeyMessage(key, mf.decode("utf-8", "replace"))
+        return blockcodec.decode_line(line)
 
     def _read_partition(self, i: int, budget: int, out: list[KeyMessage]) -> None:
         """Append up to `budget` records from partition i."""
@@ -614,137 +561,11 @@ class _FileConsumer(TopicConsumer):
         return self._closed
 
 
-def _lines_to_block_standalone(raw: list[bytes], RecordBlock):
-    # vectorized fast path: a batch is nearly always escape-free,
-    # non-legacy (one memchr over the joined blob) and single-key
-    # ("UP" runs, None-keyed input) — verify every line shares line
-    # 0's key prefix, then strip it with one C-level memcpy view. No
-    # per-line Python: this path carries the 100K+ events/s drain.
-    blob = b"\n".join(raw)
-    if b"\\" not in blob and b'{"k":' not in blob:
-        tab = raw[0].find(b"\t")
-        if tab != -1:
-            pref = raw[0][: tab + 1]
-            arr = np.array(raw, dtype="S")
-            w = arr.dtype.itemsize
-            m = w - len(pref)
-            if m > 0 and bool(np.char.startswith(arr, pref).all()):
-                body = arr.view("S1").reshape(len(raw), w)[:, len(pref):]
-                msgs_a = np.ascontiguousarray(body).view(f"S{m}").ravel()
-                key = pref[:-1]
-                if key == b"\x00":
-                    return RecordBlock(None, msgs_a)  # no key column
-                return RecordBlock(
-                    np.full(len(raw), key, dtype=f"S{max(1, len(key))}"),
-                    msgs_a,
-                    None,
-                )
-    msgs: list[bytes] = []
-    keys: list[bytes] = []
-    nones: list[bool] = []
-    any_key = False
-    for line in raw:
-        if b"\\" not in line and not line.startswith(b'{"k":'):
-            tab = line.find(b"\t")
-            if tab != -1:
-                kf = line[:tab]
-                if kf == b"\x00":
-                    keys.append(b"")
-                    nones.append(True)
-                else:
-                    keys.append(kf)
-                    nones.append(False)
-                    any_key = True
-                msgs.append(line[tab + 1 :])
-                continue
-        rec = _FileConsumer._decode_line(line)  # legacy/escaped/corrupt: slow path
-        if rec is None:
-            continue
-        if rec.key is None:
-            keys.append(b"")
-            nones.append(True)
-        else:
-            keys.append(rec.key.encode("utf-8"))
-            nones.append(False)
-            any_key = True
-        msgs.append(rec.message.encode("utf-8"))
-    if not msgs:
-        return None
-    np_msgs = np.array(msgs, dtype="S")
-    if not any_key:
-        return RecordBlock(None, np_msgs)
-    return RecordBlock(
-        np.array(keys, dtype="S"),
-        np_msgs,
-        np.array(nones, dtype=bool) if any(nones) else None,
-    )
-
-
-# ---------------------------------------------------------------------------
-# Wire codec for transported record batches (the TCP bus in bus/netbus.py
-# ships batches in the same tab-framed line format as the on-disk
-# segments, so both ends reuse the splitter/decoder above)
-# ---------------------------------------------------------------------------
-
-_NEEDS_ESC_B = re.compile(rb"[\\\t\n\r\x00]")
-
-
-def _enc_field_b(b: bytes) -> bytes:
-    if _NEEDS_ESC_B.search(b) is not None:
-        b = (
-            b.replace(b"\\", b"\\\\")
-            .replace(b"\t", b"\\t")
-            .replace(b"\n", b"\\n")
-            .replace(b"\r", b"\\r")
-            .replace(b"\x00", b"\\0")
-        )
-    return b
-
-
-def _encode_wire_lines(records, slice_bytes: int = 8 << 20):
-    """Yield (blob, count) slices of tab-framed lines for an iterable of
-    (key, message) pairs — the producer-side transport encoding."""
-    lines: list[str] = []
-    size = n = 0
-    last_key: object = _SENTINEL
-    ek = ""
-    for key, message in records:
-        if key is not last_key:
-            ek = "\x00" if key is None else _enc_field(key)
-            last_key = key
-        ln = ek + "\t" + _enc_field(message)
-        lines.append(ln)
-        size += len(ln) + 1
-        n += 1
-        if size >= slice_bytes:
-            yield ("\n".join(lines) + "\n").encode("utf-8"), n
-            lines, size, n = [], 0, 0
-    if lines:
-        yield ("\n".join(lines) + "\n").encode("utf-8"), n
-
-
-def _decode_wire_lines(blob: bytes):
-    """Inverse of _encode_wire_lines: yield (key, message) pairs."""
-    for line in blob.split(b"\n"):
-        if not line:
-            continue
-        rec = _FileConsumer._decode_line(line)
-        if rec is not None:
-            yield rec.key, rec.message
-
-
-def _encode_block_lines(block) -> bytes:
-    """A RecordBlock as a tab-framed line blob (poll response transport)."""
-    msgs = block.messages.tolist()
-    if block.keys is None:
-        return b"".join(b"\x00\t" + _enc_field_b(m) + b"\n" for m in msgs)
-    keys = block.keys.tolist()
-    nones = (
-        block.none_keys.tolist()
-        if block.none_keys is not None
-        else [False] * len(keys)
-    )
-    return b"".join(
-        (b"\x00" if nn else _enc_field_b(k)) + b"\t" + _enc_field_b(m) + b"\n"
-        for k, m, nn in zip(keys, msgs, nones)
-    )
+# transported-batch codec aliases (implementation: bus/blockcodec.py,
+# shared with netbus and shmbus so the wire formats cannot drift)
+_lines_to_block_standalone = blockcodec.lines_to_block
+_NEEDS_ESC_B = blockcodec._NEEDS_ESC_B
+_enc_field_b = blockcodec.enc_field_b
+_encode_wire_lines = blockcodec.encode_wire_lines
+_decode_wire_lines = blockcodec.decode_wire_lines
+_encode_block_lines = blockcodec.encode_block_lines

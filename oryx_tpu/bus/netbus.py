@@ -92,9 +92,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 try:
                     if op == "produce":
                         # payload: tab-framed lines, one per record
-                        from oryx_tpu.bus.filebus import _decode_wire_lines
+                        from oryx_tpu.bus.blockcodec import decode_wire_lines
 
-                        records = _decode_wire_lines(payload)
+                        records = decode_wire_lines(payload)
                         with broker.producer(req["topic"]) as p:
                             n = p.send_many(records)
                         _send_frame(sock, {"ok": True, "n": n})
@@ -113,9 +113,9 @@ class _Handler(socketserver.BaseRequestHandler):
                             max_records=int(req.get("max_records", 1000)),
                             timeout=float(req.get("timeout", 0.1)),
                         )
-                        from oryx_tpu.bus.filebus import _encode_block_lines
+                        from oryx_tpu.bus.blockcodec import encode_block_lines
 
-                        blob = _encode_block_lines(block) if block is not None else b""
+                        blob = encode_block_lines(block) if block is not None else b""
                         _send_frame(
                             sock,
                             {
@@ -307,13 +307,13 @@ class _NetProducer(TopicProducer):
         self.send_many([(key, message)])
 
     def send_many(self, records: Iterable[tuple[str | None, str]]) -> int:
-        from oryx_tpu.bus.filebus import _encode_wire_lines
+        from oryx_tpu.bus.blockcodec import encode_wire_lines
 
         n = 0
         # ship in bounded slices so one huge publish (a model) streams.
         # A slice retried after a reconnect may already have landed
         # server-side: at-least-once, like every broker here.
-        for blob, count in _encode_wire_lines(records, slice_bytes=8 << 20):
+        for blob, count in encode_wire_lines(records, slice_bytes=8 << 20):
             self._broker._invoke(lambda: {"op": "produce", "topic": self._topic}, blob)
             n += count
         return n
@@ -357,7 +357,7 @@ class _NetConsumer(TopicConsumer):
         return list(block.iter_key_messages())
 
     def poll_block(self, max_records: int = 1000, timeout: float = 0.1):
-        from oryx_tpu.bus.filebus import _lines_to_block_standalone
+        from oryx_tpu.bus.blockcodec import lines_to_block
         from oryx_tpu.common.records import RecordBlock
 
         resp, blob = self._broker._invoke(
@@ -368,7 +368,7 @@ class _NetConsumer(TopicConsumer):
             self._last_positions = {int(k): int(v) for k, v in resp["positions"].items()}
         if not blob:
             return None
-        return _lines_to_block_standalone(blob.split(b"\n")[:-1], RecordBlock)
+        return lines_to_block(blob.split(b"\n")[:-1], RecordBlock)
 
     def positions(self) -> dict[int, int]:
         resp, _ = self._broker._invoke(

@@ -78,6 +78,85 @@ class RecordBlock:
         return RecordBlock(None, msgs)
 
 
+class InteractionBlock:
+    """A typed columnar chunk of rating events: int32 id codes + f32
+    values, straight off a binary bus frame (bus/blockcodec.py kind=2).
+
+    Quacks like a None-keyed :class:`RecordBlock` (``keys``/``messages``/
+    ``none_keys``/``len``/``iter_key_messages``) so generic consumers and
+    the dead-letter path keep working, but parse-aware consumers (the ALS
+    speed manager) read ``users``/``items``/``values`` directly — the
+    decode stage becomes array views instead of text splitting. The
+    arrays may be zero-copy views over transport memory: they are valid
+    until the consumer's next poll (or release()), the same lifetime
+    contract GuardedBlockFeed already enforces for update blocks.
+    """
+
+    __slots__ = ("users", "items", "values", "timestamps",
+                 "user_prefix", "item_prefix", "_messages")
+
+    keys = None  # input events are None-keyed, like the text path
+    none_keys = None
+
+    def __init__(
+        self,
+        users: np.ndarray,
+        items: np.ndarray,
+        values: np.ndarray,
+        timestamps: np.ndarray | None = None,
+        user_prefix: bytes = b"u",
+        item_prefix: bytes = b"i",
+    ) -> None:
+        self.users = users  # int32 id codes
+        self.items = items  # int32 id codes
+        self.values = values  # float32
+        self.timestamps = timestamps  # int64 ms, or None
+        self.user_prefix = user_prefix
+        self.item_prefix = item_prefix
+        self._messages = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def materialize(self) -> "InteractionBlock":
+        """Copy the columns out of transport memory (for holders that
+        outlive the poll window, e.g. a chaos-dup stash)."""
+        return InteractionBlock(
+            np.array(self.users), np.array(self.items), np.array(self.values),
+            None if self.timestamps is None else np.array(self.timestamps),
+            self.user_prefix, self.item_prefix,
+        )
+
+    @property
+    def messages(self) -> np.ndarray:
+        """Text rendering ``<up><user>,<ip><item>,<value>[,<ts>]`` as an
+        S-array — the compatibility path (generic managers, dead-letter
+        replay); parse-aware consumers never touch it. ``%.9g`` prints
+        enough digits to round-trip any float32 exactly."""
+        if self._messages is None:
+            up = self.user_prefix.decode("ascii", "replace")
+            ip = self.item_prefix.decode("ascii", "replace")
+            us, its = self.users.tolist(), self.items.tolist()
+            vs = self.values.tolist()
+            if self.timestamps is not None:
+                ts = self.timestamps.tolist()
+                lines = [
+                    f"{up}{u},{ip}{i},{v:.9g},{t}".encode()
+                    for u, i, v, t in zip(us, its, vs, ts)
+                ]
+            else:
+                lines = [
+                    f"{up}{u},{ip}{i},{v:.9g}".encode()
+                    for u, i, v in zip(us, its, vs)
+                ]
+            self._messages = np.array(lines, dtype="S") if lines else np.empty(0, "S1")
+        return self._messages
+
+    def iter_key_messages(self) -> Iterator[KeyMessage]:
+        for m in self.messages.tolist():
+            yield KeyMessage(None, m.decode("utf-8", "replace"))
+
+
 class Records:
     """Re-iterable collection of records; base contract for the batch
     update's ``new_data``/``past_data`` arguments."""

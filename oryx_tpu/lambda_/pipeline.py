@@ -1,0 +1,235 @@
+"""Pipelined speed-layer micro-batching: parse → fold → publish.
+
+The monolithic ``run_one_batch`` serializes four phases — drain, parse,
+fold, publish — so the fold solve (the only phase that can use the
+accelerator) idles while text is split and bus bytes move, and vice
+versa. This module runs the phases on three supervised workers joined by
+bounded hand-off queues:
+
+  stage 1  drain + parse    input bus → RatingMatrix (model-independent)
+  stage 2  fold             RatingMatrix → update messages (the solve)
+  stage 3  publish + commit update bus write, then offset commit
+
+Backpressure is structural: each queue holds at most
+``oryx.speed.pipeline.queue-depth`` batches and ``put`` blocks, so a slow
+fold stalls the parse stage (and, through the consumer, the bus — the
+shm ring's guard does the same one level down) instead of buffering
+without bound.
+
+At-least-once is preserved by construction: stage 1 snapshots the
+consumer's positions when it finishes a drain, and ONLY stage 3 — after
+the publish succeeded — writes them to the offset ledger
+(``broker.set_offsets``). A crash anywhere between drain and commit
+replays the batch; nothing is ever committed ahead of its updates. The
+consumer itself is never ``commit()``-ed from the pipeline.
+
+A batch whose fold raises is re-queued at the head of the parse→fold
+queue (order preserved) and retried up to ``_FOLD_MAX_ATTEMPTS`` times;
+then it is dropped with ``speed.pipeline.fold-dropped`` counting the
+lost events — the pipelined analogue of the dead-letter quarantine.
+
+Managers exposing the staged API (``parse_batch``/``fold_parsed``, e.g.
+ALSSpeedModelManager) parse on stage 1; for anything else stage 1
+materializes the drained blocks (transport views don't survive the
+hand-off) and stage 2 calls plain ``build_updates``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from oryx_tpu.common import metrics
+from oryx_tpu.common.records import BlockRecords
+
+log = logging.getLogger(__name__)
+
+_FOLD_MAX_ATTEMPTS = 3
+
+
+class HandoffQueue:
+    """A bounded stage-to-stage hand-off: blocking ``put`` (backpressure),
+    timeout ``get``, and ``unget`` to return an item to the HEAD for an
+    in-order retry. ``unget`` may exceed the bound by one — a retrying
+    stage must never deadlock against its own upstream."""
+
+    def __init__(self, depth: int) -> None:
+        if depth < 1:
+            raise ValueError("queue depth must be >= 1")
+        self._depth = depth
+        self._items: list = []
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def put(self, item, stop_event: threading.Event | None = None) -> bool:
+        """Append; blocks while full. Returns False if stopped first."""
+        with self._not_full:
+            while len(self._items) >= self._depth:
+                if stop_event is not None and stop_event.is_set():
+                    return False
+                self._not_full.wait(0.1)
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
+    def get(self, timeout: float = 0.2):
+        """Pop the head, or None after ``timeout`` with nothing queued."""
+        deadline = time.monotonic() + timeout
+        with self._not_empty:
+            while not self._items:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._not_empty.wait(remaining)
+            item = self._items.pop(0)
+            self._not_full.notify()
+            return item
+
+    def unget(self, item) -> None:
+        """Put back at the head (retry without reordering)."""
+        with self._not_empty:
+            self._items.insert(0, item)
+            self._not_empty.notify()
+
+
+class SpeedPipeline:
+    """The three supervised stages, owned by a :class:`SpeedLayer`.
+
+    Threads run under the layer's retry policy and count toward
+    ``layer.healthy()``; the layer's stop event stops all three.
+    """
+
+    def __init__(self, layer) -> None:
+        self._layer = layer
+        config = layer.config
+        depth = config.get_optional_int("oryx.speed.pipeline.queue-depth") or 2
+        min_batch_ms = config.get_optional_int("oryx.speed.pipeline.min-batch-ms")
+        self._min_batch_sec = (200 if min_batch_ms is None else min_batch_ms) / 1000.0
+        self._parsed = HandoffQueue(depth)
+        self._folded = HandoffQueue(depth)
+        manager = layer.manager
+        self._staged = hasattr(manager, "parse_batch") and hasattr(
+            manager, "fold_parsed"
+        )
+        self.threads: list = []
+
+    def start(self) -> None:
+        layer = self._layer
+        self.threads = [
+            layer.supervise(
+                "SpeedPipelineParse", self._parse_step, loop=True,
+                metrics_prefix="speed.pipeline.parse",
+            ),
+            layer.supervise(
+                "SpeedPipelineFold", self._fold_step, loop=True,
+                metrics_prefix="speed.pipeline.fold",
+            ),
+            layer.supervise(
+                "SpeedPipelinePublish", self._publish_step, loop=True,
+                metrics_prefix="speed.pipeline.publish",
+            ),
+        ]
+        log.info(
+            "speed pipeline started: depth=%d min-batch=%.0fms staged=%s",
+            self._parsed._depth, self._min_batch_sec * 1000, self._staged,
+        )
+
+    # -- stage 1: drain + parse ---------------------------------------------
+
+    def _parse_step(self) -> None:
+        """Drain one accumulation window off the input bus and parse it.
+
+        Transport blocks may be zero-copy views whose lifetime ends at the
+        consumer's next poll; the consumer is pinned across the multi-poll
+        drain and everything is copied out (parsed, or materialized) BEFORE
+        the hand-off, so nothing downstream touches transport memory.
+        """
+        layer = self._layer
+        consumer = layer.input_consumer()
+        limit = layer.max_batch_events
+        deadline = time.monotonic() + self._min_batch_sec
+        pin = getattr(consumer, "pin", None)
+        if pin is not None:
+            pin()
+        try:
+            blocks, total = layer.drain_input_blocks(limit, deadline=deadline)
+            if total == 0:
+                return
+            positions = dict(consumer.positions())
+            if self._staged:
+                payload = layer.manager.parse_batch(BlockRecords(blocks))
+            else:
+                payload = BlockRecords(
+                    [
+                        b.materialize() if hasattr(b, "materialize") else b
+                        for b in blocks
+                    ]
+                )
+        finally:
+            release = getattr(consumer, "release", None)
+            if release is not None:
+                release()
+        self._parsed.put((payload, total, positions, 0), layer._stop_event)
+
+    # -- stage 2: fold -------------------------------------------------------
+
+    def _fold_step(self) -> None:
+        item = self._parsed.get(timeout=0.2)
+        if item is None:
+            return
+        payload, total, positions, attempts = item
+        try:
+            with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
+                if self._staged:
+                    result = self._layer.manager.fold_parsed(payload)
+                else:
+                    result = self._layer.manager.build_updates(payload)
+                updates = list(result)
+        except Exception:
+            attempts += 1
+            if attempts >= _FOLD_MAX_ATTEMPTS:
+                metrics.registry.counter("speed.pipeline.fold-dropped").inc(total)
+                log.exception(
+                    "dropping batch of %d event(s) after %d failed fold(s)",
+                    total, attempts,
+                )
+                return
+            metrics.registry.counter("speed.pipeline.fold-retries").inc()
+            self._parsed.unget((payload, total, positions, attempts))
+            raise  # the supervisor logs, counts and backs off
+        self._folded.put((updates, total, positions), self._layer._stop_event)
+
+    # -- stage 3: publish + commit -------------------------------------------
+
+    def _publish_step(self) -> None:
+        item = self._folded.get(timeout=0.2)
+        if item is None:
+            return
+        updates, total, positions = item
+        layer = self._layer
+        ub = layer.update_broker()
+        sent = 0
+        if ub is not None and updates:
+            records = [("UP", update) for update in updates]
+            with ub.producer(layer.update_topic) as producer:
+                sent = layer.retry_policy.call(
+                    lambda: producer.send_many(records),
+                    retry_on=(ConnectionError, OSError),
+                    metrics_prefix="speed.publish",
+                    stop_event=layer._stop_event,
+                )
+        # the at-least-once commit point: updates are on the bus, so the
+        # drained range may now be marked consumed
+        if layer.id and positions:
+            layer.input_broker().set_offsets(
+                layer.group_id, layer.input_topic, positions
+            )
+        metrics.registry.counter("speed.events").inc(total)
+        metrics.registry.counter("speed.updates").inc(sent)
+        layer._batch_count += 1

@@ -21,6 +21,7 @@ retried under the same policy.
 from __future__ import annotations
 
 import logging
+import time
 
 from oryx_tpu.common.records import BlockRecords
 from oryx_tpu.common import metrics, profiling
@@ -49,17 +50,26 @@ class SpeedLayer(AbstractLayer):
         self.dead_letter_max_failures = (
             config.get_optional_int("oryx.update-topic.dead-letter.max-consume-failures") or 3
         )
+        self.pipeline_enabled = bool(
+            config.get("oryx.speed.pipeline.enabled", None)
+        )
         self.manager = load_instance_of(self.model_manager_class, config)
         self._input_consumer = None
         self._update_consumer = None
         self._consume_thread = None
         self._batch_thread = None
+        self._pipeline = None
         self._batch_count = 0
 
     def prepare_input(self) -> None:
         """Attach the input consumer; from this point input is observed."""
         if self._input_consumer is None:
             self._input_consumer = self.make_input_consumer()
+
+    def input_consumer(self):
+        """The layer's input consumer, attaching it on first use."""
+        self.prepare_input()
+        return self._input_consumer
 
     def start(self) -> None:
         self.init_topics()
@@ -81,13 +91,22 @@ class SpeedLayer(AbstractLayer):
             on_failure=feed.record_failure,
         )
         self.prepare_input()
-        self._batch_thread = self.supervise(
-            "SpeedLayer", self._one_interval, loop=True, metrics_prefix="speed.batch"
-        )
+        if self.pipeline_enabled:
+            # three-stage pipelined micro-batching: parse/fold/publish on
+            # separate supervised workers with bounded hand-off queues
+            from oryx_tpu.lambda_.pipeline import SpeedPipeline
+
+            self._pipeline = SpeedPipeline(self)
+            self._pipeline.start()
+        else:
+            self._batch_thread = self.supervise(
+                "SpeedLayer", self._one_interval, loop=True, metrics_prefix="speed.batch"
+            )
         log.info(
-            "SpeedLayer started: interval=%ss manager=%s",
+            "SpeedLayer started: interval=%ss manager=%s pipeline=%s",
             self.generation_interval_sec,
             self.model_manager_class,
+            self.pipeline_enabled,
         )
 
     def close(self) -> None:
@@ -95,7 +114,10 @@ class SpeedLayer(AbstractLayer):
         for c in (self._input_consumer, self._update_consumer):
             if c is not None:
                 c.close()
-        self.join_or_report_leak(self._consume_thread, self._batch_thread)
+        pipeline_threads = self._pipeline.threads if self._pipeline else []
+        self.join_or_report_leak(
+            self._consume_thread, self._batch_thread, *pipeline_threads
+        )
         self.manager.close()
 
     @property
@@ -133,31 +155,61 @@ class SpeedLayer(AbstractLayer):
             metrics.registry.counter("speed.batch.failures").inc()
             raise
 
-    def _run_one_batch(self) -> int:
-        if self._input_consumer is None:
-            self._input_consumer = self.make_input_consumer()
-        # columnar drain: blocks of byte-string arrays, no per-record
-        # object construction — the input side of the 100K events/s path
-        blocks = []
+    def drain_input_blocks(
+        self, limit: int, deadline: float | None = None
+    ) -> tuple[list, int]:
+        """Columnar input drain shared by the monolithic batch and the
+        pipeline's parse stage: blocks of byte-string (or typed int)
+        arrays, no per-record object construction — the input side of the
+        100K events/s path. Without a deadline, the first empty poll ends
+        the batch; with one, polling continues until the accumulation
+        window closes (or ``limit`` is hit), so micro-batches stay large
+        enough to amortize the fold solve."""
+        blocks: list = []
         total = 0
-        limit = self.max_batch_events
-        while total < limit:
-            block = self._input_consumer.poll_block(
-                max_records=min(10_000, limit - total), timeout=0.05
+        consumer = self.input_consumer()
+        while total < limit and not self.is_stopped():
+            timeout = 0.05
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                timeout = min(timeout, remaining)
+            block = consumer.poll_block(
+                max_records=min(10_000, limit - total), timeout=timeout
             )
             if block is None:
-                break
+                if deadline is None:
+                    break
+                continue
             blocks.append(block)
             total += len(block)
-        if total == 0:
-            return 0
-        new_data = BlockRecords(blocks)
-        with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
-            with profiling.maybe_trace(
-                profiling.profile_dir_from_config(self.config, "speed"),
-                "speed-batch",
-            ):
-                updates = self.manager.build_updates(new_data)
+        return blocks, total
+
+    def _run_one_batch(self) -> int:
+        consumer = self.input_consumer()
+        # pin (if the transport supports it): zero-copy blocks must stay
+        # valid across the multi-poll drain until build_updates has parsed
+        # them; release() afterwards lets the transport reclaim
+        pin = getattr(consumer, "pin", None)
+        if pin is not None:
+            pin()
+        try:
+            blocks, total = self.drain_input_blocks(self.max_batch_events)
+            if total == 0:
+                return 0
+            new_data = BlockRecords(blocks)
+            with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
+                with profiling.maybe_trace(
+                    profiling.profile_dir_from_config(self.config, "speed"),
+                    "speed-batch",
+                ):
+                    updates = self.manager.build_updates(new_data)
+        finally:
+            release = getattr(consumer, "release", None)
+            if release is not None:
+                release()
+        with metrics.timed(metrics.registry.histogram("speed.publish.seconds")):
             ub = self.update_broker()
             sent = 0
             if ub is not None:

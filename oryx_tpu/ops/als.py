@@ -915,13 +915,17 @@ def _fold_half_host(ata, vecs_own, own_valid, vecs_other, other_valid, values, i
     # same safety net as the device path: singular/ill-conditioned AtA
     # falls back to a pseudo-inverse solve, and rows that still come out
     # non-finite are dropped instead of published
-    bad = ~np.isfinite(d_vec).all(axis=1)
-    if bad.any():
+    finite = np.isfinite(d_vec).all(axis=1)
+    if not finite.all():
         d_lstsq = (np.linalg.pinv(ata32, rcond=1e-5) @ rhs.T).T
-        d_vec = np.where(bad[:, None], d_lstsq, d_vec)
-    new = np.where(own_valid[:, None], vo, 0.0) + d_vec
-    updated = other_valid & ~np.isnan(target) & np.isfinite(d_vec).all(axis=1)
-    return np.where(updated[:, None], new, 0.0).astype(np.float32, copy=False), updated
+        d_vec = np.where(~finite[:, None], d_lstsq, d_vec)
+        finite = np.isfinite(d_vec).all(axis=1)
+    new = np.where(own_valid[:, None], vo, 0.0)
+    new += d_vec  # in-place: [n,k] temp saved, bits unchanged
+    updated = other_valid & ~np.isnan(target) & finite
+    if not updated.all():  # zero dropped rows in place of a full where-copy
+        new[~updated] = 0.0
+    return new.astype(np.float32, copy=False), updated
 
 
 def _bucket(n: int) -> int:
@@ -1024,3 +1028,119 @@ def fold_in_batch(
     )
     new_xu, x_upd, new_yi, y_upd = (np.asarray(o)[:n] for o in out)
     return new_xu, x_upd, new_yi, y_upd
+
+
+def device_gramian(mat: np.ndarray):
+    """Upload a [k,k] Gramian once as a float32 device array. Callers
+    cache the result on the owning Solver instance: the solver cache is
+    invalidated exactly when the Gramian changes (vector writes, model
+    rotation), so a fresh Solver — not every micro-batch — is the only
+    event that pays the host->device round-trip again."""
+    return jnp.asarray(np.asarray(mat), dtype=jnp.float32)
+
+
+class FoldInSession:
+    """Accumulate fold-in delta blocks and solve them as one micro-batch.
+
+    The pipelined speed layer parses the input stream into several event
+    blocks per micro-batch (one per transport frame). Folding each block
+    separately would pay a Cholesky + dispatch per block; a session
+    accumulates the gathered vector blocks as they arrive — eagerly
+    placed on device when the fold backend is the device, so the
+    host->device copies overlap the parse stage — and issues ONE solve
+    over the concatenation per micro-batch.
+
+    ``yty``/``xtx`` may be numpy arrays or device arrays from
+    :func:`device_gramian`; device-resident Gramians flow into the jitted
+    solve with no per-batch transfer. Results are computed by the exact
+    same code as :func:`fold_in_batch` (the host path literally calls
+    it), so a session is bit-identical to the unbatched fold at f32.
+    """
+
+    def __init__(self, yty, xtx, implicit: bool, backend: str = "auto") -> None:
+        self.yty = yty
+        self.xtx = xtx
+        self.implicit = implicit
+        self.backend = backend
+        self._blocks: list[tuple] = []
+        self._pending = 0
+
+    def _resolved_backend(self, n: int, k: int) -> str:
+        if self.backend != "auto":
+            return self.backend
+        if _auto_fold_choice is not None:
+            return _auto_fold_choice
+        return "host" if n * max(k, 1) < 500_000 else "auto"
+
+    def resolved_backend(self, n: int, k: int) -> str:
+        """The backend this session would pick for an [n,k] micro-batch.
+        Callers use it to decide whether device-resident Gramians are
+        worth handing in: the host path wants the float64 originals (its
+        Cholesky runs in f64), the device path casts to f32 regardless."""
+        return self._resolved_backend(n, k)
+
+    def add_block(self, xu, xu_valid, yi, yi_valid, values) -> None:
+        n, k = xu.shape
+        if self._resolved_backend(max(self._pending + n, n), k) == "device":
+            block = (
+                jnp.asarray(xu, dtype=jnp.float32),
+                jnp.asarray(xu_valid),
+                jnp.asarray(yi, dtype=jnp.float32),
+                jnp.asarray(yi_valid),
+                jnp.asarray(values, dtype=jnp.float32),
+            )
+        else:
+            block = (xu, xu_valid, yi, yi_valid, values)
+        self._blocks.append(block)
+        self._pending += n
+
+    @property
+    def pending(self) -> int:
+        return self._pending
+
+    def solve(self):
+        """One fold over everything accumulated; clears the session.
+        Returns (new_xu, x_updated, new_yi, y_updated) like fold_in_batch,
+        or None when nothing is pending."""
+        if not self._blocks:
+            return None
+        blocks, self._blocks = self._blocks, []
+        n, self._pending = self._pending, 0
+        k = blocks[0][0].shape[1]
+        backend = self._resolved_backend(n, k)
+        if backend == "device" and all(
+            isinstance(b[0], jnp.ndarray) for b in blocks
+        ):
+            # all-device micro-batch: concatenate + pad on device and call
+            # the jitted kernel with the resident Gramians directly — the
+            # only host traffic is the [n,k] results coming back
+            xu, xu_valid, yi, yi_valid, values = (
+                b[0] if len(blocks) == 1 else jnp.concatenate([blk[i] for blk in blocks])
+                for i, b in enumerate(zip(*blocks))
+            )
+            m = _bucket(n)
+            if m != n:
+                pad = m - n
+                xu = jnp.concatenate([xu, jnp.zeros((pad, k), xu.dtype)])
+                yi = jnp.concatenate([yi, jnp.zeros((pad, k), yi.dtype)])
+                xu_valid = jnp.concatenate([xu_valid, jnp.zeros(pad, bool)])
+                yi_valid = jnp.concatenate([yi_valid, jnp.zeros(pad, bool)])
+                values = jnp.concatenate([values, jnp.zeros(pad, values.dtype)])
+            out = _fold_in_batch_jit(
+                jnp.asarray(self.yty, dtype=jnp.float32),
+                jnp.asarray(self.xtx, dtype=jnp.float32),
+                xu, xu_valid, yi, yi_valid, values, self.implicit,
+            )
+            new_xu, x_upd, new_yi, y_upd = (np.asarray(o)[:n] for o in out)
+            return new_xu, x_upd, new_yi, y_upd
+        cat = [
+            b[0] if len(blocks) == 1 else np.concatenate([np.asarray(blk[i]) for blk in blocks])
+            for i, b in enumerate(zip(*blocks))
+        ]
+        return fold_in_batch(
+            np.asarray(self.yty),
+            np.asarray(self.xtx),
+            *cat,
+            self.implicit,
+            backend=backend,
+        )

@@ -8,10 +8,12 @@ import pytest
 from oryx_tpu import bus
 
 
-@pytest.fixture(params=["inproc", "file"])
+@pytest.fixture(params=["inproc", "file", "shm"])
 def locator(request, tmp_path):
     if request.param == "inproc":
         return "inproc://test-broker"
+    if request.param == "shm":
+        return f"shm:{tmp_path}/bus"
     return f"file:{tmp_path}/bus"
 
 

@@ -53,6 +53,24 @@ def test_ann_config_lint_accepts_known_keys(tmp_path):
     assert rc == 0, "\n".join(problems)
 
 
+def test_shm_and_pipeline_config_keys_linted(tmp_path):
+    assert "ring-mb" in lint_config.known_keys("oryx.bus.shm")
+    assert "queue-depth" in lint_config.known_keys("oryx.speed.pipeline")
+    bad = tmp_path / "overlay.conf"
+    # concatenation keeps the typo'd literals out of THIS file's source
+    bad.write_text(
+        "oryx.bus.shm.ring-mb = 128\n"
+        + "oryx.bus.shm." + "rign-mb = 128\n"
+        + "oryx.speed.pipeline." + "queue-detph = 4\n"
+    )
+    rc, problems, _ = lint_config.run_lint([bad])
+    assert rc == 1
+    assert len(problems) == 2
+    joined = "\n".join(problems)
+    assert "rign-mb" in joined
+    assert "queue-detph" in joined
+
+
 def test_deploy_manifests_lint_clean():
     rc, problems, engine = lint_deploy.run_lint()
     assert rc == 0, f"[{engine}] " + "\n".join(problems)

@@ -1,14 +1,20 @@
 #!/usr/bin/env python
-"""Config-key lint for the serving scan's ANN tier, wired into tier-1.
+"""Config-key lint for the repo's silent-failure knob blocks, wired
+into tier-1.
 
-A mistyped `oryx.serving.scan.ann.*` key fails SILENTLY: the HOCON
-overlay accepts any path, the serving layer only reads the keys it
-knows, and the operator ships with the exact scan still on — the worst
-kind of perf regression (nothing breaks, everything is just 10x slower
-than provisioned). Sibling of tools/lint_registry.py: the lint walks the
-repo's Python and conf sources for ANN key references and rejects any
-key that reference.conf's `oryx.serving.scan.ann` block (the single
-source of truth for the knob set) does not declare.
+A mistyped key under these prefixes fails SILENTLY: the HOCON overlay
+accepts any path, the subsystem only reads the keys it knows, and the
+operator ships with the default behavior still on — the worst kind of
+regression (nothing breaks, everything is just slower or less safe than
+provisioned). Sibling of tools/lint_registry.py: the lint walks the
+repo's Python and conf sources for dotted key references and rejects
+any key that reference.conf's matching block (the single source of
+truth for each knob set) does not declare.
+
+Linted prefixes:
+  oryx.serving.scan.ann   — ANN tier of the serving scan
+  oryx.bus.shm            — shared-memory ring transport
+  oryx.speed.pipeline     — three-stage speed-layer pipeline
 
 Usage: python tools/lint_config.py [path ...]   (default: repo sources)
 Exit code 0 = clean.
@@ -22,6 +28,11 @@ from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 ANN_PREFIX = "oryx.serving.scan.ann"
+LINTED_PREFIXES = (
+    ANN_PREFIX,
+    "oryx.bus.shm",
+    "oryx.speed.pipeline",
+)
 DEFAULT_TARGETS = [
     REPO_ROOT / "oryx_tpu",
     REPO_ROOT / "tools",
@@ -29,17 +40,27 @@ DEFAULT_TARGETS = [
     REPO_ROOT / "docs",
 ]
 
-# dotted reference in code/docs/conf: oryx.serving.scan.ann.<key>
-_DOTTED = re.compile(r"oryx\.serving\.scan\.ann\.([A-Za-z0-9][A-Za-z0-9-]*)")
+# dotted reference in code/docs/conf: <prefix>.<key>
+_DOTTED = {
+    prefix: re.compile(
+        re.escape(prefix) + r"\.([A-Za-z0-9][A-Za-z0-9-]*)"
+    )
+    for prefix in LINTED_PREFIXES
+}
 
 
-def known_ann_keys() -> set[str]:
-    """The knob set reference.conf declares under oryx.serving.scan.ann."""
+def known_keys(prefix: str) -> set[str]:
+    """The knob set reference.conf declares under `prefix`."""
     sys.path.insert(0, str(REPO_ROOT))
     from oryx_tpu.common import config as C
 
-    block = C.get_default().get_config(ANN_PREFIX)
+    block = C.get_default().get_config(prefix)
     return set(block.as_dict().keys())
+
+
+def known_ann_keys() -> set[str]:
+    """The ANN knob set (kept for the original single-prefix API)."""
+    return known_keys(ANN_PREFIX)
 
 
 def _iter_source_files(paths: list[Path]):
@@ -51,20 +72,22 @@ def _iter_source_files(paths: list[Path]):
             yield p
 
 
-def _lint_file(path: Path, known: set[str]) -> list[str]:
+def _lint_file(path: Path, known: dict[str, set[str]]) -> list[str]:
     problems: list[str] = []
     try:
         text = path.read_text(encoding="utf-8")
     except OSError as e:  # unreadable file: surface, don't crash the gate
         return [f"{path}: unreadable: {e}"]
     for lineno, line in enumerate(text.splitlines(), 1):
-        for m in _DOTTED.finditer(line):
-            key = m.group(1)
-            if key not in known:
-                problems.append(
-                    f"{path}:{lineno}: unknown ANN config key "
-                    f"{ANN_PREFIX}.{key!r} (declared: {', '.join(sorted(known))})"
-                )
+        for prefix, pattern in _DOTTED.items():
+            for m in pattern.finditer(line):
+                key = m.group(1)
+                if key not in known[prefix]:
+                    problems.append(
+                        f"{path}:{lineno}: unknown config key "
+                        f"{prefix}.{key!r} (declared: "
+                        f"{', '.join(sorted(known[prefix]))})"
+                    )
     return problems
 
 
@@ -72,13 +95,13 @@ def run_lint(paths: list[Path] | None = None) -> tuple[int, list[str], str]:
     """Returns (exit code, problem lines, engine used) — the same shape
     as lint_registry.run_lint so the tier-1 tests share one idiom."""
     paths = paths or DEFAULT_TARGETS
-    known = known_ann_keys()
+    known = {prefix: known_keys(prefix) for prefix in LINTED_PREFIXES}
     problems: list[str] = []
     for f in _iter_source_files(paths):
         if f.resolve() == Path(__file__).resolve():
             continue  # the lint's own docstring/regex isn't a reference
         problems.extend(_lint_file(f, known))
-    return (1 if problems else 0), problems, "ann-config-keys"
+    return (1 if problems else 0), problems, "config-keys"
 
 
 def main(argv: list[str]) -> int:

@@ -1,19 +1,30 @@
 """Full speed-layer benchmark: sustained events/sec through the REAL
-SpeedLayer over the file bus — not the build_updates microbench.
+SpeedLayer over the file or shared-memory bus — not the build_updates
+microbench.
 
 Path measured per event (SpeedLayer.java:56-214 analogue, lambda_/speed.py):
-producer process -> file-bus input topic (4 partitions) -> consumer poll +
-JSON decode -> columnar parse/aggregate -> batched two-sided ALS fold-in ->
-update serialization -> batched publish to the file-bus update topic.
+producer -> bus input topic -> consumer poll (zero-copy columnar frames on
+shm:) -> parse/aggregate (typed int fast path on shm:) -> batched two-sided
+ALS fold-in -> update serialization -> batched publish to the update topic.
 
-A separate OS process produces events continuously (send_many batches)
-while this process runs SpeedLayer.run_one_batch in a loop for --seconds.
-Throughput = events consumed / elapsed, i.e. the sustained rate the layer
-keeps up with, bus I/O included. BASELINE.json target: 100K events/s.
+Two modes:
+
+- backlog (--prefill N): pre-produce N events, then time draining them
+  with run_one_batch in a loop. Producer cost is fully excluded from the
+  timed window — this is layer capacity on its own core.
+- live (default): producer processes race the layer for --seconds.
+  Producers replay PRE-ENCODED columnar payloads (shm: one header pack +
+  memcpy per frame, zero per-event format cost; file: a pre-rendered
+  record list), so the measured split is producer=transport-only,
+  layer=full parse->fold->publish. On a 1-core host all processes share
+  the core.
+
+--trials runs the timed phase N times and reports per-trial rates, the
+median, and the spread ((max-min)/median; >20% is flagged NOISY).
 
 Usage:
-    python tools/speed_layer_benchmark.py --seconds 20 [--out evidence.txt]
-    (spawns its own producer; no setup needed)
+    python tools/speed_layer_benchmark.py --prefill 2000000 --trials 3
+    python tools/speed_layer_benchmark.py --seconds 15 --trials 3 [--pipeline]
 """
 
 from __future__ import annotations
@@ -31,31 +42,100 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+CHUNK = 20_000
+N_CHUNKS = 8  # distinct pre-encoded payloads producers cycle through
+
+
+def build_chunks(seed: int, users: int, items: int):
+    gen = np.random.default_rng(seed)
+    out = []
+    for _ in range(N_CHUNKS):
+        u = gen.integers(0, users, CHUNK).astype(np.int32)
+        i = gen.integers(0, items, CHUNK).astype(np.int32)
+        v = (1.0 + gen.random(CHUNK)).astype(np.float32)
+        out.append((u, i, v))
+    return out
+
 
 def produce(locator: str, users: int, items: int, stop_path: str) -> None:
-    """Producer-process body: pump synthetic rating events until stopped."""
+    """Producer-process body: pump synthetic rating events until stopped.
+
+    Everything format-shaped happens ONCE, before the loop: shm producers
+    replay pre-encoded columnar payloads (send_payload = header pack +
+    memcpy), file producers replay a pre-rendered record list.
+    """
     from oryx_tpu import bus
+    from oryx_tpu.bus import blockcodec
 
     broker = bus.get_broker(locator)
-    gen = np.random.default_rng(os.getpid())
-    t = 0
+    chunks = build_chunks(os.getpid(), users, items)
     with broker.producer("OryxInput") as p:
-        while not os.path.exists(stop_path):
-            n = 20_000
-            u = gen.integers(0, users, n)
-            i = gen.integers(0, items, n)
-            v = 1.0 + gen.random(n)
-            base = t
-            p.send_many(
-                (None, f"u{uu},i{ii},{vv:.3f},{base + j}")
-                for j, (uu, ii, vv) in enumerate(zip(u, i, v))
-            )
-            t += n
+        if hasattr(p, "send_payload"):  # shm: zero per-event cost replay
+            frames = []
+            for u, i, v in chunks:
+                payload, flags, crc = blockcodec.encode_interactions_payload(u, i, v)
+                frames.append((flags, len(v), payload, crc))
+            j = 0
+            while not os.path.exists(stop_path):
+                flags, count, payload, crc = frames[j % len(frames)]
+                try:
+                    p.send_payload(blockcodec.KIND_COLS, flags, count, payload, crc)
+                except BlockingIOError:
+                    time.sleep(0.002)  # ring full: consumer owns the core
+                    continue
+                j += 1
+        else:  # file: pre-rendered lines, send_many re-blobs per call
+            batches = [
+                [
+                    (None, f"u{uu},i{ii},{vv:.3f},{j}")
+                    for j, (uu, ii, vv) in enumerate(zip(u, i, v))
+                ]
+                for u, i, v in chunks
+            ]
+            j = 0
+            while not os.path.exists(stop_path):
+                p.send_many(batches[j % len(batches)])
+                j += 1
+
+
+def prefill_events(broker, typed: bool, n: int, users: int, items: int, seed=7):
+    """Pre-produce n events (typed columnar frames on shm, text on file)."""
+    gen = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    with broker.producer("OryxInput") as p:
+        left = n
+        while left > 0:
+            m = min(200_000, left)
+            u = gen.integers(0, users, m).astype(np.int32)
+            i = gen.integers(0, items, m).astype(np.int32)
+            v = (1.0 + gen.random(m)).astype(np.float32)
+            if typed:
+                p.send_interactions(u, i, v)
+            else:
+                p.send_many(
+                    (None, f"u{uu},i{ii},{vv:.3f},{j}")
+                    for j, (uu, ii, vv) in enumerate(zip(u, i, v))
+                )
+            left -= m
+    return time.perf_counter() - t0
+
+
+def summarize(rates: list[float]) -> tuple[float, float, str]:
+    med = float(np.median(rates))
+    spread = (max(rates) - min(rates)) / med if med else 0.0
+    flag = "NOISY" if spread > 0.20 else "stable"
+    return med, spread, flag
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--bus", default="shm", choices=["file", "shm"])
+    ap.add_argument("--pipeline", action="store_true",
+                    help="run the three-stage parse/fold/publish pipeline "
+                    "(live mode only)")
+    ap.add_argument("--trials", type=int, default=1)
+    ap.add_argument("--seconds", type=float, default=15.0,
+                    help="per-trial window in live mode")
     ap.add_argument("--features", type=int, default=50)
     ap.add_argument("--users", type=int, default=50_000)
     ap.add_argument("--items", type=int, default=10_000)
@@ -64,44 +144,42 @@ def main() -> None:
         "--prefill",
         type=int,
         default=0,
-        help="pre-produce this many events and time draining the backlog "
-        "instead of racing live producers (layer capacity; the honest mode "
-        "on a 1-core host where producers and the layer share the core)",
+        help="backlog mode: pre-produce this many events per trial and "
+        "time draining them (layer capacity; producer cost excluded)",
     )
     ap.add_argument("--backend", default="auto", choices=["auto", "host", "device"])
     ap.add_argument(
-        "--batch-events",
-        type=int,
-        default=400_000,
-        help="micro-batch cap; larger batches amortize per-batch fixed "
-        "costs (poll timeouts, producer open, GIL handoffs)",
+        "--batch-events", type=int, default=400_000,
+        help="micro-batch cap; larger batches amortize per-batch fixed costs",
     )
+    ap.add_argument("--ring-mb", type=int, default=0,
+                    help="shm ring size; 0 = auto-size to the prefill")
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
+    if args.pipeline and args.prefill:
+        ap.error("--pipeline is a live-mode flag (backlog mode times "
+                 "run_one_batch directly)")
 
     root = Path(tempfile.mkdtemp(prefix="oryx-speedbench-"))
-    locator = f"file:{root}/bus"
     stop_path = str(root / "STOP")
+    if args.bus == "shm":
+        # the ring must hold a whole prefill (typed: ~13B/event amortized)
+        ring_mb = args.ring_mb or max(64, args.prefill * 14 // (1 << 20) + 16)
+        locator = f"shm:{root}/bus?ring_mb={ring_mb}"
+    else:
+        locator = f"file:{root}/bus"
 
     from oryx_tpu import bus
     from oryx_tpu.app.pmml import add_extension, add_extension_content
+    from oryx_tpu.bus.core import KeyMessage
     from oryx_tpu.common import config as C
     from oryx_tpu.common import pmml as pmml_io
+    from oryx_tpu.common.metrics import registry
     from oryx_tpu.lambda_.speed import SpeedLayer
 
     broker = bus.get_broker(locator)
-    broker.create_topic("OryxInput", 4)
+    broker.create_topic("OryxInput", 1)
     broker.create_topic("OryxUpdate", 1)
-
-    # a synthetic MODEL on the update topic for the layer to replay
-    gen = np.random.default_rng(42)
-    root_pmml = pmml_io.build_skeleton_pmml()
-    add_extension(root_pmml, "features", args.features)
-    add_extension(root_pmml, "implicit", "true")
-    add_extension_content(root_pmml, "XIDs", [f"u{j}" for j in range(args.users)])
-    add_extension_content(root_pmml, "YIDs", [f"i{j}" for j in range(args.items)])
-    with broker.producer("OryxUpdate") as p:
-        p.send("MODEL", pmml_io.to_string(root_pmml))
 
     cfg = C.get_default().with_overlay(
         f"""
@@ -114,23 +192,23 @@ def main() -> None:
         oryx.update-topic.broker = "{locator}"
         oryx.speed.streaming.generation-interval-sec = 3600
         oryx.speed.streaming.max-batch-events = {args.batch_events}
+        oryx.speed.pipeline.enabled = {str(args.pipeline).lower()}
         """
     )
     layer = SpeedLayer(cfg)
-    layer.start()
 
+    # seed the model directly on the manager (no bus replay of a 60K-id
+    # PMML blob): MODEL sets shape + expected ids, batched setters load
+    # the factors so get_fraction_loaded() reaches 1.0
     t0 = time.perf_counter()
-    while True:
-        m = layer.manager.model
-        if m is not None:
-            break
-        if time.perf_counter() - t0 > 120:
-            sys.exit("model never loaded")
-        time.sleep(0.05)
-    # seed factor vectors so fold-ins solve against a real Gramian — via
-    # the MODEL-level batched setters (not raw store writes) so expected-id
-    # accounting drains and get_fraction_loaded() reaches 1.0; the layer
-    # refuses to fold into a model below min-model-load-fraction
+    gen = np.random.default_rng(42)
+    root_pmml = pmml_io.build_skeleton_pmml()
+    add_extension(root_pmml, "features", args.features)
+    add_extension(root_pmml, "implicit", "true")
+    add_extension_content(root_pmml, "XIDs", [f"u{j}" for j in range(args.users)])
+    add_extension_content(root_pmml, "YIDs", [f"i{j}" for j in range(args.items)])
+    layer.manager.consume(iter([KeyMessage("MODEL", pmml_io.to_string(root_pmml))]))
+    m = layer.manager.model
     x = gen.standard_normal((args.users, args.features)).astype(np.float32)
     y = gen.standard_normal((args.items, args.features)).astype(np.float32)
     m.set_user_vectors([f"u{j}" for j in range(args.users)], x)
@@ -138,92 +216,147 @@ def main() -> None:
     assert m.get_fraction_loaded() >= 1.0, m.get_fraction_loaded()
     print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
 
-    if args.prefill:
-        producers = []
-        t0 = time.perf_counter()
-        with broker.producer("OryxInput") as p:
-            left = args.prefill
-            while left > 0:
-                n = min(200_000, left)
-                u = gen.integers(0, args.users, n)
-                i = gen.integers(0, args.items, n)
-                v = 1.0 + gen.random(n)
-                p.send_many(
-                    (None, f"u{uu},i{ii},{vv:.3f},{j}")
-                    for j, (uu, ii, vv) in enumerate(zip(u, i, v))
-                )
-                left -= n
-        print(f"prefilled {args.prefill} events in {time.perf_counter() - t0:.1f}s", flush=True)
-    else:
-        producers = [
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    os.path.abspath(__file__),
-                    "--produce",
-                    locator,
-                    "--produce-stop",
-                    stop_path,
-                    "--users",
-                    str(args.users),
-                    "--items",
-                    str(args.items),
-                ]
-            )
-            for _ in range(args.producers)
-        ]
-        time.sleep(1.0)  # let the bus fill so the layer never starves
+    # the input consumer must exist BEFORE any produce: its guard pins the
+    # shm ring tail so prefilled frames are never reclaimed underneath us
+    layer.prepare_input()
+    typed = args.bus == "shm"
+    events_counter = registry.counter("speed.events")
+    rates: list[float] = []
+    producers: list[subprocess.Popen] = []
+    total_events = total_updates = total_batches = 0
+
     try:
-        # warm-up batch compiles the device path before timing starts
-        layer.run_one_batch()
-
-        from oryx_tpu.common.metrics import registry
-
-        events_counter = registry.counter("speed.events")
-        events = updates = batches = 0
-        start = time.perf_counter()
-        deadline = start + args.seconds
-        while time.perf_counter() < deadline:
-            before = int(events_counter.value)
-            sent = layer.run_one_batch()
-            got = int(events_counter.value) - before
-            events += got
-            updates += sent
-            batches += 1
-            if args.prefill and got == 0:
-                break  # backlog drained
-        elapsed = time.perf_counter() - start
+        if args.prefill:
+            # warm-up: compile/calibrate the fold path before timing
+            prefill_events(broker, typed, 100_000, args.users, args.items, seed=1)
+            while layer.run_one_batch() or int(events_counter.value) == 0:
+                pass
+            for trial in range(args.trials):
+                dt = prefill_events(
+                    broker, typed, args.prefill, args.users, args.items,
+                    seed=100 + trial,
+                )
+                print(f"trial {trial + 1}: prefilled {args.prefill} events "
+                      f"in {dt:.1f}s", flush=True)
+                events = updates = batches = 0
+                start = time.perf_counter()
+                while True:
+                    before = int(events_counter.value)
+                    sent = layer.run_one_batch()
+                    got = int(events_counter.value) - before
+                    events += got
+                    updates += sent
+                    batches += 1
+                    if got == 0:
+                        break  # backlog drained
+                elapsed = time.perf_counter() - start
+                rates.append(events / elapsed)
+                total_events += events
+                total_updates += updates
+                total_batches += batches
+                print(f"trial {trial + 1}: {events} events in {elapsed:.2f}s "
+                      f"-> {events / elapsed:,.0f} events/s", flush=True)
+        else:
+            producers = [
+                subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--produce", locator,
+                        "--produce-stop", stop_path,
+                        "--users", str(args.users),
+                        "--items", str(args.items),
+                    ]
+                )
+                for _ in range(args.producers)
+            ]
+            time.sleep(1.0)  # let the bus fill so the layer never starves
+            if args.pipeline:
+                layer.start()  # pipeline workers drain continuously
+                time.sleep(2.0)  # warm-up / fold calibration
+                for trial in range(args.trials):
+                    before = int(events_counter.value)
+                    start = time.perf_counter()
+                    time.sleep(args.seconds)
+                    elapsed = time.perf_counter() - start
+                    events = int(events_counter.value) - before
+                    rates.append(events / elapsed)
+                    total_events += events
+                    print(f"trial {trial + 1}: {events} events in "
+                          f"{elapsed:.2f}s -> {events / elapsed:,.0f} events/s",
+                          flush=True)
+                total_batches = layer.batch_count
+            else:
+                layer.run_one_batch()  # warm-up
+                for trial in range(args.trials):
+                    events = updates = batches = 0
+                    start = time.perf_counter()
+                    deadline = start + args.seconds
+                    while time.perf_counter() < deadline:
+                        before = int(events_counter.value)
+                        sent = layer.run_one_batch()
+                        events += int(events_counter.value) - before
+                        updates += sent
+                        batches += 1
+                    elapsed = time.perf_counter() - start
+                    rates.append(events / elapsed)
+                    total_events += events
+                    total_updates += updates
+                    total_batches += batches
+                    print(f"trial {trial + 1}: {events} events in "
+                          f"{elapsed:.2f}s -> {events / elapsed:,.0f} events/s",
+                          flush=True)
     finally:
         Path(stop_path).touch()
         for p in producers:
             p.wait(timeout=30)
         layer.close()
 
-    eps = events / elapsed
-    mode = (
-        f"{args.prefill}-event prefilled backlog"
-        if args.prefill
-        else f"{args.producers} live producer processes"
-    )
+    med, spread, flag = summarize(rates)
+    framing = "typed-columnar frames" if typed else "text lines"
+    if args.prefill:
+        mode = (
+            f"backlog: {args.trials} trial(s) x {args.prefill}-event prefill; "
+            f"producer cost excluded from the timed drain (events were "
+            f"pre-encoded onto the bus before timing)"
+        )
+    else:
+        split = (
+            "producers replay pre-encoded columnar payloads (header pack + "
+            "memcpy per frame, zero per-event format cost)"
+            if typed
+            else "producers replay a pre-rendered record list"
+        )
+        mode = (
+            f"live: {args.producers} producer process(es) racing the layer "
+            f"for {args.seconds:.0f}s windows; {split}; layer core pays the "
+            f"full parse->fold->publish path"
+            + ("; three-stage pipeline on" if args.pipeline else "")
+        )
     lines = [
         f"=== speed_layer_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
-        f"model {args.users}u x {args.items}i x {args.features}f implicit; "
-        f"{mode} over a file: bus; host cores: {os.cpu_count()}",
-        f"{events} events in {elapsed:.2f}s over {batches} micro-batches "
-        f"-> {eps:,.0f} events/sec sustained ({updates} deltas published)",
+        f"bus={args.bus} ({framing}); model {args.users}u x {args.items}i x "
+        f"{args.features}f implicit; host cores: {os.cpu_count()}",
+        mode,
+        f"per-trial events/s: [{', '.join(f'{r:,.0f}' for r in rates)}] -> "
+        f"median {med:,.0f} events/s (spread {spread:.1%}, {flag}); "
+        f"{total_events} events over {total_batches} micro-batches",
     ]
     print("\n".join(lines), flush=True)
     print(
         json.dumps(
             {
                 "metric": (
-                    f"speed layer sustained fold-in over file bus "
+                    f"speed layer sustained fold-in over {args.bus} bus, "
+                    f"{'backlog' if args.prefill else 'live'} mode "
                     f"({args.features} feat, {args.users // 1000}K users, "
                     f"{args.items // 1000}K items)"
                 ),
-                "value": round(eps, 0),
+                "value": round(med, 0),
                 "unit": "events/sec",
-                "vs_baseline": round(eps / 100_000.0, 2),
+                "rates": [round(r, 0) for r in rates],
+                "trials": len(rates),
+                "spread": round(spread, 3),
+                "vs_baseline": round(med / 100_000.0, 2),
             }
         )
     )
