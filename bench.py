@@ -29,6 +29,10 @@ Metrics (vs_baseline frames):
    real HTTP serving path (ServingLayer + endpoints + micro-batcher):
    true per-request p50/p99 next to the pipelined-throughput rows, the
    apples-to-apples view against the reference's 437 qps / 7 ms table.
+6. tracing-overhead — speed backlog events/s and closed-loop serving qps
+   with the distributed tracer on (default 1% sampling) vs off
+   (ORYX_TRACING=0); vs_baseline = on/off median ratio, hard-fails when
+   clearly below the 0.98 envelope (docs/observability.md).
 
 Noise protocol: every metric is measured over >= 3 trials (cheap
 trainers 5) after the discarded compile pass; rows record the MEDIAN as
@@ -51,7 +55,8 @@ Env knobs: ORYX_BENCH_ITEMS/FEATURES/USERS/SECONDS/BATCH/DEPTH/DTYPE
 ORYX_BENCH_ONLY (comma list of metric names); ORYX_BENCH_ATTEMPTS,
 ORYX_BENCH_INIT_TIMEOUT; ORYX_BENCH_TRIALS / ORYX_BENCH_TRIALS_CHEAP
 (noise protocol, default 3/5); ORYX_BENCH_CL_USERS/CL_SECONDS
-(closed-loop serving); ORYX_TB_* (training shapes, see
+(closed-loop serving); ORYX_BENCH_TRACE_PREFILL/ITEMS/SECONDS/ENVELOPE
+(tracing-overhead); ORYX_TB_* (training shapes, see
 tools/train_benchmark.py).
 """
 
@@ -896,6 +901,152 @@ def bench_speed() -> None:
         )
 
 
+def bench_tracing_overhead() -> None:
+    """Tracing-cost acceptance rows: the distributed tracer at its
+    default 1% sample rate must cost <= 2% on both hot paths. Two
+    comparisons, each >= 3-trial medians with tracing ON vs OFF:
+
+    - speed layer backlog events/s — subprocess runs of the real
+      SpeedLayer bench toggled via ORYX_TRACING (the layer process reads
+      the env at import, exactly how an operator would disable tracing);
+    - closed-loop serving qps through the real HTTP path (in-process
+      `tracing.configure` toggle around the same layer + model).
+
+    vs_baseline = on/off median ratio. A row whose median AND best trial
+    both land below the 0.98 envelope hard-fails the bench; median-only
+    misses are flagged `noise-suspect` per the repo's noise protocol."""
+    import threading
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.common import tracing
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    envelope = float(os.environ.get("ORYX_BENCH_TRACE_ENVELOPE", 0.98))
+    failures: list[str] = []
+
+    def ratio_row(
+        kind: str, unit: str, on_rates: list, off_rates: list, order: int
+    ) -> None:
+        med_on = statistics.median(on_rates)
+        med_off = max(statistics.median(off_rates), 1e-9)
+        ratio = med_on / med_off
+        best = max(on_rates) / med_off
+        detail = (
+            f"tracing on {med_on:.0f} vs off {med_off:.0f} {unit} "
+            f"(medians of {len(on_rates)}/{len(off_rates)} trials), "
+            f"overhead {100 * (1 - ratio):.2f}%, envelope <= "
+            f"{100 * (1 - envelope):.0f}%"
+        )
+        print(f"bench[tracing-overhead {kind}]: {detail}", file=sys.stderr)
+        _emit(
+            f"tracing overhead, {kind}, default 1% sampling on vs off "
+            f"(vs_baseline = on/off ratio, floor {envelope})",
+            med_on,
+            unit,
+            ratio,
+            order=order,
+            detail=detail,
+            off_value=round(med_off, 2),
+            overhead_pct=round(100 * (1 - ratio), 3),
+            noise_suspect=ratio < envelope <= best,
+            spread=[round(float(min(on_rates)), 2), round(float(max(on_rates)), 2)],
+            trials=len(on_rates),
+        )
+        if ratio < envelope and best < envelope:
+            failures.append(f"{kind}: on/off {ratio:.4f} < {envelope}")
+
+    # --- speed backlog: subprocess per mode, env toggle ---------------------
+    prefill = int(os.environ.get("ORYX_BENCH_TRACE_PREFILL", 300_000))
+
+    def speed_rates(tracing_on: bool) -> list:
+        env = dict(os.environ)
+        env["ORYX_TRACING"] = "1" if tracing_on else "0"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
+                "--trials",
+                str(_TRIALS),
+                "--prefill",
+                str(prefill),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+        )
+        sys.stderr.write(proc.stderr[-800:])
+        line = None
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("{") and '"metric"' in ln:
+                line = ln
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(
+                f"tracing-overhead speed run (on={tracing_on}) failed "
+                f"rc={proc.returncode}"
+            )
+        d = json.loads(line)
+        return d.get("rates") or [d["value"]]
+
+    ratio_row(
+        "speed backlog fold-in", "events/sec",
+        speed_rates(True), speed_rates(False), order=40,
+    )
+
+    # --- serving closed-loop: in-process toggle around one warm layer ------
+    items = int(os.environ.get("ORYX_BENCH_TRACE_ITEMS", 200_000))
+    users = 10_000
+    seconds = float(os.environ.get("ORYX_BENCH_TRACE_SECONDS", 4.0))
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "BenchTracingOverhead"
+          input-topic.broker = "inproc://benchtrc"
+          update-topic.broker = "inproc://benchtrc"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+    layer = ServingLayer(cfg)
+    layer.start()
+    layer.model_manager.model = build_model(users, items, 50)
+    base = f"http://127.0.0.1:{layer.port}"
+    try:
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+
+        def serving_qps(tracing_on: bool) -> list:
+            tracing.configure(enabled=tracing_on)
+            rates: list = []
+            for _ in range(_TRIALS):
+                lats: list = []
+                stop = threading.Event()
+                deadline = time.perf_counter() + seconds
+                t1 = time.perf_counter()
+                worker(base, "/recommend/u%d", users, deadline, lats, [], stop)
+                if not lats:
+                    raise RuntimeError("tracing-overhead serving: no requests")
+                rates.append(len(lats) / (time.perf_counter() - t1))
+            return rates
+
+        on = serving_qps(True)
+        off = serving_qps(False)
+    finally:
+        tracing.configure(enabled=True)
+        layer.close()
+    ratio_row("serving closed-loop", "queries/sec", on, off, order=41)
+
+    if failures:
+        raise RuntimeError("tracing overhead above envelope: " + "; ".join(failures))
+
+
 def bench_serving_closed_loop() -> None:
     """Closed-loop /recommend latency through the REAL serving stack:
     ServingLayer HTTP server + ALS endpoints + request micro-batcher +
@@ -1119,6 +1270,7 @@ BENCHES = [
     ("als", bench_als),
     ("als-scale", bench_als_scale),
     ("speed", bench_speed),
+    ("tracing-overhead", bench_tracing_overhead),
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),

@@ -32,6 +32,7 @@ from oryx_tpu.app import pmml as app_pmml
 from oryx_tpu.app.als.common import apply_up_lines, consume_blocks_columnar
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common.config import Config
+from oryx_tpu.common import tracing
 from oryx_tpu.common.lang import ReadWriteLock
 from oryx_tpu.common.text import read_json
 from oryx_tpu.common.vectormath import Solver, get_solver
@@ -576,9 +577,8 @@ class ALSServingModel(ServingModel):
         margin = how_many + len(exclude)
         if rescorer is not None:
             margin = max(margin * 4, margin + 32)  # rescorer may filter many
-        while True:
-            k = min(margin, num_candidates)
-            idx, scores = score_fn(k)
+
+        def filter_candidates(idx, scores) -> list[tuple[str, float]]:
             out: list[tuple[str, float]] = []
             for i, s in zip(idx, scores):
                 if int(i) < 0:
@@ -598,6 +598,19 @@ class ALSServingModel(ServingModel):
                 out.append((id_, score))
                 if len(out) == how_many and rescorer is None:
                     break
+            return out
+
+        while True:
+            k = min(margin, num_candidates)
+            idx, scores = score_fn(k)
+            if rescorer is not None:
+                # child of the ambient serving.request span; sibling of
+                # the batcher's serving.scan
+                with tracing.span("serving.rescore", attrs={"k": int(k)}) as sp:
+                    out = filter_candidates(idx, scores)
+                    sp.set("kept", len(out))
+            else:
+                out = filter_candidates(idx, scores)
             if len(out) >= how_many or k >= num_candidates:
                 break
             margin = margin * 4

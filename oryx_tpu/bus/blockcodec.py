@@ -27,6 +27,7 @@ Frame header layout (little-endian, 32 bytes):
     offset  size  field
     0       4     magic   0x4B4C4252 (b"RBLK")
     4       2     kind    0=pad/wrap  1=text lines  2=interaction columns
+                          3=trace context (count=0: occupies no offsets)
     6       2     flags   bit 0: columns carry timestamps
     8       8     seqno   absolute topic offset of the first record
     16      4     count   records in the frame
@@ -51,7 +52,15 @@ MAGIC = 0x4B4C4252  # b"RBLK" little-endian
 KIND_PAD = 0
 KIND_TEXT = 1
 KIND_COLS = 2
+# trace-context carrier for the columnar shm path (count=0, so seqno /
+# offset arithmetic is undisturbed); the text formats carry the same
+# context as a reserved "@trc" record line instead
+KIND_TRACE = 3
 FLAG_TIMESTAMPS = 1
+
+# a trace control record's encoded line starts with this (the "@trc" key
+# needs no escaping); common.tracing owns the key + message format
+TRACE_LINE_PREFIX = b"@trc\t"
 
 HEADER = struct.Struct("<IHHQIII4x")
 HEADER_BYTES = HEADER.size  # 32
@@ -218,29 +227,63 @@ def decode_wire_lines(blob: bytes):
 
 
 def encode_block_lines(block) -> bytes:
-    """A RecordBlock as a tab-framed line blob (poll response transport)."""
+    """A RecordBlock as a tab-framed line blob (poll response transport).
+
+    A block carrying a trace context re-emits it as a leading "@trc"
+    line, so the context survives the netbus poll hop (server strips it
+    into ``block.trace``, the wire re-frames it, the client's
+    ``lines_to_block`` re-attaches it)."""
+    head = b""
+    trace = getattr(block, "trace", None)
+    if trace:
+        if isinstance(trace, str):
+            trace = trace.encode("utf-8")
+        head = TRACE_LINE_PREFIX + trace + b"\n"
     msgs = block.messages.tolist()
     if block.keys is None:
-        return b"".join(b"\x00\t" + enc_field_b(m) + b"\n" for m in msgs)
+        return head + b"".join(b"\x00\t" + enc_field_b(m) + b"\n" for m in msgs)
     keys = block.keys.tolist()
     nones = (
         block.none_keys.tolist()
         if block.none_keys is not None
         else [False] * len(keys)
     )
-    return b"".join(
+    return head + b"".join(
         (b"\x00" if nn else enc_field_b(k)) + b"\t" + enc_field_b(m) + b"\n"
         for k, m, nn in zip(keys, msgs, nones)
     )
 
 
 def lines_to_block(raw: list[bytes], RecordBlock):
+    # trace control records ("@trc" lines): a producer prepends at most
+    # one per batch, so the common shapes are an O(1) head check plus one
+    # memchr-speed scan of the joined blob for the mid-batch case (two
+    # producer batches coalesced into one poll); the per-line Python
+    # filter runs only when that scan hits. The last header wins.
+    trace = None
+    if raw and raw[0].startswith(TRACE_LINE_PREFIX):
+        trace = raw[0][len(TRACE_LINE_PREFIX):]
+        raw = raw[1:]
+    if not raw:
+        return None
     # vectorized fast path: a batch is nearly always escape-free,
     # non-legacy (one memchr over the joined blob) and single-key
     # ("UP" runs, None-keyed input) — verify every line shares line
     # 0's key prefix, then strip it with one C-level memcpy view. No
     # per-line Python: this path carries the 100K+ events/s drain.
     blob = b"\n".join(raw)
+    if b"\n" + TRACE_LINE_PREFIX in blob:
+        kept = []
+        for line in raw:
+            if line.startswith(TRACE_LINE_PREFIX):
+                trace = line[len(TRACE_LINE_PREFIX):]
+            else:
+                kept.append(line)
+        raw = kept
+        if not raw:
+            return None
+        blob = b"\n".join(raw)
+    trace_s = trace.decode("utf-8", "replace") if trace is not None else None
     if b"\\" not in blob and b'{"k":' not in blob:
         tab = raw[0].find(b"\t")
         if tab != -1:
@@ -253,12 +296,15 @@ def lines_to_block(raw: list[bytes], RecordBlock):
                 msgs_a = np.ascontiguousarray(body).view(f"S{m}").ravel()
                 key = pref[:-1]
                 if key == b"\x00":
-                    return RecordBlock(None, msgs_a)  # no key column
-                return RecordBlock(
-                    np.full(len(raw), key, dtype=f"S{max(1, len(key))}"),
-                    msgs_a,
-                    None,
-                )
+                    block = RecordBlock(None, msgs_a)  # no key column
+                else:
+                    block = RecordBlock(
+                        np.full(len(raw), key, dtype=f"S{max(1, len(key))}"),
+                        msgs_a,
+                        None,
+                    )
+                block.trace = trace_s
+                return block
     msgs: list[bytes] = []
     keys: list[bytes] = []
     nones: list[bool] = []
@@ -292,12 +338,15 @@ def lines_to_block(raw: list[bytes], RecordBlock):
         return None
     np_msgs = np.array(msgs, dtype="S")
     if not any_key:
-        return RecordBlock(None, np_msgs)
-    return RecordBlock(
-        np.array(keys, dtype="S"),
-        np_msgs,
-        np.array(nones, dtype=bool) if any(nones) else None,
-    )
+        block = RecordBlock(None, np_msgs)
+    else:
+        block = RecordBlock(
+            np.array(keys, dtype="S"),
+            np_msgs,
+            np.array(nones, dtype=bool) if any(nones) else None,
+        )
+    block.trace = trace_s
+    return block
 
 
 # ---------------------------------------------------------------------------

@@ -77,13 +77,32 @@ class TopicConsumer(abc.ABC):
         """Columnar poll: one RecordBlock of byte-string arrays (None when
         nothing arrived). High-rate consumers (the speed layer at 100K+
         events/s) use this to skip per-record object construction; brokers
-        override it to skip per-record decoding entirely."""
+        override it to skip per-record decoding entirely.
+
+        Trace control records (the reserved "@trc" key a traced producer
+        prepends to its batch) are stripped here and surfaced as
+        ``block.trace``; they still occupy a topic offset on both sides,
+        so seek/commit arithmetic is untouched."""
         from oryx_tpu.common.records import RecordBlock
+        from oryx_tpu.common.tracing import TRACE_KEY
 
         records = self.poll(max_records, timeout)
         if not records:
             return None
-        return RecordBlock.from_key_messages(records)
+        trace = None
+        if any(r.key == TRACE_KEY for r in records):
+            kept = []
+            for r in records:
+                if r.key == TRACE_KEY:
+                    trace = r.message
+                else:
+                    kept.append(r)
+            records = kept
+            if not records:
+                return None
+        block = RecordBlock.from_key_messages(records)
+        block.trace = trace
+        return block
 
     @abc.abstractmethod
     def positions(self) -> dict[int, int]:

@@ -74,7 +74,7 @@ from oryx_tpu.bus.core import (
     partition_for,
 )
 from oryx_tpu.bus.filebus import FileBroker, _Flock
-from oryx_tpu.common import metrics
+from oryx_tpu.common import metrics, tracing
 
 RING_FILE_MAGIC = 0x31676E5278797230  # b"0ryxRng1" little-endian
 
@@ -564,6 +564,14 @@ class _ShmProducer(TopicProducer):
         ring = self._broker._ring(self._topic, partition)
         step = max(1, min(self._broker.frame_records, ring.ring_bytes // 4 // rec_bytes))
         frames = []
+        # sampled ambient trace context rides as a zero-count trace frame
+        # (columnar payloads have nowhere to put a text record); untraced
+        # publishes — the 100K events/s bench path — emit nothing
+        hdr = tracing.header_record()
+        if hdr is not None:
+            frames.append(
+                (blockcodec.KIND_TRACE, 0, 0, hdr[1].encode("utf-8"), None)
+            )
         for a in range(0, len(values), step):
             b = min(len(values), a + step)
             payload, flags, crc = blockcodec.encode_interactions_payload(
@@ -622,6 +630,9 @@ class _ShmConsumer(TopicConsumer):
         self._slot: dict[int, int] = {}
         self._pos: dict[int, int] = {}
         self._cursor: dict[int, int] = {}
+        # per-partition trace context captured from a KIND_TRACE frame,
+        # attached to the next delivered block
+        self._pending_trace: dict[int, str] = {}
         for i, ring in self._rings.items():
             slot, head, tail, nseq, bseq = ring.claim_slot_and_snapshot(broker.slots)
             self._slot[i] = slot
@@ -692,6 +703,20 @@ class _ShmConsumer(TopicConsumer):
                 cur += 8
                 continue
             wire = blockcodec.HEADER_BYTES + blockcodec.pad8(length)
+            if kind == blockcodec.KIND_TRACE:
+                # zero-count control frame: capture the context for the
+                # next delivered block (count=0 keeps seqnos untouched,
+                # so the pos/seqno arithmetic below must not see it)
+                body = off + blockcodec.HEADER_BYTES
+                payload = memoryview(mm)[body : body + length]
+                if zlib.crc32(payload) == crc:
+                    self._pending_trace[i] = bytes(payload).decode(
+                        "utf-8", "replace"
+                    )
+                else:
+                    metrics.registry.counter("bus.shm.crc-resyncs").inc()
+                cur += wire
+                continue
             if kind == blockcodec.KIND_PAD or pos >= seqno + count:
                 cur += wire  # pad, or a frame we already consumed
                 continue
@@ -738,11 +763,15 @@ class _ShmConsumer(TopicConsumer):
                 cur += wire
             self._pos[i] = pos
             self._cursor[i] = cur
+            block.trace = self._pending_trace.pop(i, None)
             return block
         self._pos[i] = pos
         self._cursor[i] = cur
         if lines:
-            return blockcodec.lines_to_block(lines, RecordBlock)
+            block = blockcodec.lines_to_block(lines, RecordBlock)
+            if block is not None and block.trace is None:
+                block.trace = self._pending_trace.pop(i, None)
+            return block
         return None
 
     # -- TopicConsumer ------------------------------------------------------

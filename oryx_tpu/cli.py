@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
-    "bus-input", "config", "health", "models",
+    "bus-input", "config", "health", "models", "trace",
 )
 
 MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
@@ -50,7 +50,8 @@ def _build_parser() -> argparse.ArgumentParser:
         "subcommand",
         nargs="?",
         default=None,
-        help="models: list | show <generation> | rollback <generation> | gc",
+        help="models: list | show <generation> | rollback <generation> | gc; "
+        "trace: optional trace id to filter by",
     )
     p.add_argument(
         "generation",
@@ -381,6 +382,34 @@ def run_models(cfg: Config, subcommand: str | None, generation: str | None, out=
     return 0
 
 
+def run_trace(cfg: Config, trace_id: str | None = None, out=None) -> int:
+    """Dump the serving layer's recorded spans as Chrome-trace JSON
+    (docs/observability.md): fetch GET /trace from the configured serving
+    port — optionally filtered to one trace id via ``trace <trace-id>`` —
+    and print it. Pipe to a file and load in chrome://tracing or
+    ui.perfetto.dev."""
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    out = out or sys.stdout
+    scheme = "https" if cfg.get_optional_string("oryx.serving.api.keystore-file") else "http"
+    port = cfg.get_int(
+        "oryx.serving.api.secure-port" if scheme == "https" else "oryx.serving.api.port"
+    )
+    ctx_path = cfg.get_string("oryx.serving.api.context-path").rstrip("/")
+    url = f"{scheme}://localhost:{port}{ctx_path}/trace"
+    if trace_id:
+        url += f"?trace={trace_id}"
+    try:
+        with urlopen(url, timeout=10) as resp:
+            body = resp.read().decode("utf-8", "replace")
+    except URLError as e:
+        print(f"/trace: unreachable ({e})", file=out)
+        return 1
+    print(body, file=out)
+    return 0
+
+
 def run_config_dump(cfg: Config, out=None) -> None:
     """ConfigToProperties analogue: dump the resolved oryx.* tree as
     key=value lines for shell consumption (used at oryx-run.sh:87)."""
@@ -450,6 +479,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_health(cfg)
     elif args.command == "models":
         return run_models(cfg, args.subcommand, args.generation)
+    elif args.command == "trace":
+        return run_trace(cfg, args.subcommand)
     return 0
 
 

@@ -21,8 +21,10 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PROMETHEUS_CONTENT_TYPE",
     "SLOWindow",
     "registry",
+    "render_prometheus",
     "timed",
 ]
 
@@ -46,17 +48,24 @@ class Counter:
 
 class Gauge:
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self._value: float | None = None
 
     def set(self, value: float) -> None:
-        self._value = value
+        # a bare float store is atomic in CPython, but `set` must stay
+        # safe if a gauge ever grows read-modify-write semantics; the
+        # uncontended lock costs ~100ns on a path that is never hot
+        with self._lock:
+            self._value = value
 
     @property
     def value(self) -> float | None:
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self) -> dict[str, Any]:
-        return {"type": "gauge", "value": self._value}
+        with self._lock:
+            return {"type": "gauge", "value": self._value}
 
 
 class Histogram:
@@ -95,33 +104,50 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
+    def _quantile_locked(self, q: float) -> float:
+        target = q * self._count
+        seen = 0
+        for i, c in enumerate(self._buckets):
+            seen += c
+            if seen >= target:
+                return self._bounds[i] if i < len(self._bounds) else self._max
+        return self._max
+
     def quantile(self, q: float) -> float:
         """Upper bound of the bucket holding the q-quantile observation."""
         with self._lock:
             if not self._count:
                 return 0.0
-            target = q * self._count
-            seen = 0
-            for i, c in enumerate(self._buckets):
-                seen += c
-                if seen >= target:
-                    return self._bounds[i] if i < len(self._bounds) else self._max
-            return self._max
+            return self._quantile_locked(q)
 
     def snapshot(self) -> dict[str, Any]:
+        # the whole snapshot is taken under ONE lock acquisition so a
+        # concurrent observe() can never yield a torn view (e.g. a count
+        # that doesn't match the bucket sum, or a min/max from a later
+        # observation than the count reflects)
         with self._lock:
             if not self._count:
                 return {"type": "histogram", "count": 0}
-        return {
-            "type": "histogram",
-            "count": self._count,
-            "mean": self.mean,
-            "min": self._min,
-            "max": self._max,
-            "p50": self.quantile(0.50),
-            "p90": self.quantile(0.90),
-            "p99": self.quantile(0.99),
-        }
+            cumulative = []
+            seen = 0
+            for i, c in enumerate(self._buckets):
+                seen += c
+                le = self._bounds[i] if i < len(self._bounds) else math.inf
+                cumulative.append((le, seen))
+            return {
+                "type": "histogram",
+                "count": self._count,
+                "mean": self._sum / self._count,
+                "min": self._min,
+                "max": self._max,
+                "p50": self._quantile_locked(0.50),
+                "p90": self._quantile_locked(0.90),
+                "p99": self._quantile_locked(0.99),
+                "sum": self._sum,
+                # cumulative (le, count) pairs, Prometheus-style, ending
+                # with the +Inf bucket == count
+                "buckets": cumulative,
+            }
 
 
 class MetricsRegistry:
@@ -242,6 +268,67 @@ class SLOWindow:
 
 registry = MetricsRegistry()
 """Process-global default registry (each layer is its own process)."""
+
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PROM_BAD_CHARS = None  # compiled lazily; most processes never render
+
+
+def _prom_name(name: str) -> str:
+    global _PROM_BAD_CHARS
+    if _PROM_BAD_CHARS is None:
+        import re
+
+        _PROM_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+    out = _PROM_BAD_CHARS.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_num(v) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(v)
+
+
+def render_prometheus(snapshot: dict[str, dict[str, Any]]) -> str:
+    """A registry snapshot as Prometheus text exposition format 0.0.4,
+    for standard scrapers (`/metrics` content-negotiates this alongside
+    the JSON form). Dotted names map to underscored ones; histograms emit
+    cumulative `_bucket{le=...}` series plus `_sum` / `_count`; unset
+    gauges are omitted. Unknown entry shapes are skipped, so callers can
+    merge extra JSON-only context into the dict without breaking
+    scrapers."""
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        entry = snapshot[name]
+        if not isinstance(entry, dict):
+            continue
+        kind = entry.get("type")
+        pname = _prom_name(name)
+        if kind == "counter":
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_prom_num(entry.get('value') or 0.0)}")
+        elif kind == "gauge":
+            value = entry.get("value")
+            if value is None:
+                continue
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_prom_num(value)}")
+        elif kind == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            buckets = entry.get("buckets") or []
+            for le, cum in buckets:
+                le_s = "+Inf" if math.isinf(float(le)) else _prom_num(le)
+                lines.append(f'{pname}_bucket{{le="{le_s}"}} {cum}')
+            if not buckets:  # empty histogram still needs its +Inf bucket
+                lines.append(f'{pname}_bucket{{le="+Inf"}} 0')
+            lines.append(f"{pname}_sum {_prom_num(entry.get('sum') or 0.0)}")
+            lines.append(f"{pname}_count {entry.get('count') or 0}")
+    return "\n".join(lines) + "\n"
 
 
 class timed:

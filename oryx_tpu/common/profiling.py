@@ -53,3 +53,26 @@ def maybe_trace(profile_dir: str | None, name: str):
 def profile_dir_from_config(config, layer: str) -> str | None:
     """Configured trace directory for a layer, or None (off)."""
     return config.get(f"oryx.{layer}.compute.profile-dir", None)
+
+
+def capture(profile_dir: str, name: str, seconds: float) -> str:
+    """On-demand wall-clock profiler capture (the serving layer's
+    ``POST /debug/profile``): trace whatever the process's devices do for
+    ``seconds``, write under ``profile_dir``, return the trace path.
+    Raises RuntimeError when the profiler cannot start (caller maps it to
+    an HTTP error)."""
+    import jax
+
+    target = f"{profile_dir.rstrip('/')}/{name}-{int(time.time() * 1000)}"
+    try:
+        jax.profiler.start_trace(target)
+    except Exception as e:
+        raise RuntimeError(f"could not start profiler trace: {e}") from e
+    try:
+        time.sleep(max(0.0, seconds))
+    finally:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            log.exception("could not stop profiler trace %s", target)
+    return target

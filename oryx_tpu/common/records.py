@@ -34,7 +34,7 @@ class RecordBlock:
     ending in "\\x00" are not representable columnar.)
     """
 
-    __slots__ = ("keys", "messages", "none_keys")
+    __slots__ = ("keys", "messages", "none_keys", "trace")
 
     def __init__(
         self,
@@ -45,6 +45,9 @@ class RecordBlock:
         self.keys = keys  # S-dtype array, or None when every key is None
         self.messages = messages  # S-dtype array
         self.none_keys = none_keys  # bool array (True = key is None), or None
+        # raw "@trc" control-record message (str) stripped by the
+        # transport, or None; parse with common.tracing.parse_header
+        self.trace = None
 
     def __len__(self) -> int:
         return len(self.messages)
@@ -93,7 +96,7 @@ class InteractionBlock:
     """
 
     __slots__ = ("users", "items", "values", "timestamps",
-                 "user_prefix", "item_prefix", "_messages")
+                 "user_prefix", "item_prefix", "_messages", "trace")
 
     keys = None  # input events are None-keyed, like the text path
     none_keys = None
@@ -114,6 +117,7 @@ class InteractionBlock:
         self.user_prefix = user_prefix
         self.item_prefix = item_prefix
         self._messages = None
+        self.trace = None  # raw "@trc" message carried by the transport
 
     def __len__(self) -> int:
         return len(self.values)
@@ -121,11 +125,13 @@ class InteractionBlock:
     def materialize(self) -> "InteractionBlock":
         """Copy the columns out of transport memory (for holders that
         outlive the poll window, e.g. a chaos-dup stash)."""
-        return InteractionBlock(
+        out = InteractionBlock(
             np.array(self.users), np.array(self.items), np.array(self.values),
             None if self.timestamps is None else np.array(self.timestamps),
             self.user_prefix, self.item_prefix,
         )
+        out.trace = self.trace
+        return out
 
     @property
     def messages(self) -> np.ndarray:

@@ -40,7 +40,7 @@ import logging
 import threading
 import time
 
-from oryx_tpu.common import metrics
+from oryx_tpu.common import metrics, tracing
 from oryx_tpu.common.records import BlockRecords
 
 log = logging.getLogger(__name__)
@@ -157,10 +157,25 @@ class SpeedPipeline:
         pin = getattr(consumer, "pin", None)
         if pin is not None:
             pin()
+        t0 = time.time()
         try:
             blocks, total = layer.drain_input_blocks(limit, deadline=deadline)
             if total == 0:
                 return
+            # trace/freshness metadata rides the hand-off tuples so the
+            # fold and publish stages (different threads, no ambient
+            # context) can record their spans against the same trace
+            from oryx_tpu.lambda_.speed import batch_origin
+
+            incoming_ctx, origin_ms = batch_origin(blocks)
+            ingest_ms = origin_ms if origin_ms is not None else int(t0 * 1000)
+            ctx = tracing.continue_from(incoming_ctx) or tracing.sample_root()
+            meta = (
+                ctx,
+                incoming_ctx.span_id if incoming_ctx is not None else None,
+                ingest_ms,
+                t0,
+            )
             positions = dict(consumer.positions())
             if self._staged:
                 payload = layer.manager.parse_batch(BlockRecords(blocks))
@@ -175,7 +190,12 @@ class SpeedPipeline:
             release = getattr(consumer, "release", None)
             if release is not None:
                 release()
-        self._parsed.put((payload, total, positions, 0), layer._stop_event)
+        if ctx is not None:
+            tracing.record_span(
+                "speed.parse", ctx.child(), ctx.span_id, t0,
+                time.time() - t0, {"events": total, "blocks": len(blocks)},
+            )
+        self._parsed.put((payload, total, positions, 0, meta), layer._stop_event)
 
     # -- stage 2: fold -------------------------------------------------------
 
@@ -183,7 +203,9 @@ class SpeedPipeline:
         item = self._parsed.get(timeout=0.2)
         if item is None:
             return
-        payload, total, positions, attempts = item
+        payload, total, positions, attempts, meta = item
+        ctx = meta[0]
+        t1 = time.time()
         try:
             with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
                 if self._staged:
@@ -201,9 +223,16 @@ class SpeedPipeline:
                 )
                 return
             metrics.registry.counter("speed.pipeline.fold-retries").inc()
-            self._parsed.unget((payload, total, positions, attempts))
+            self._parsed.unget((payload, total, positions, attempts, meta))
             raise  # the supervisor logs, counts and backs off
-        self._folded.put((updates, total, positions), self._layer._stop_event)
+        if ctx is not None:
+            tracing.record_span(
+                "speed.fold", ctx.child(), ctx.span_id, t1,
+                time.time() - t1, {"events": total},
+            )
+        self._folded.put(
+            (updates, total, positions, meta), self._layer._stop_event
+        )
 
     # -- stage 3: publish + commit -------------------------------------------
 
@@ -211,24 +240,43 @@ class SpeedPipeline:
         item = self._folded.get(timeout=0.2)
         if item is None:
             return
-        updates, total, positions = item
+        updates, total, positions, meta = item
+        ctx, parent_span_id, ingest_ms, t0 = meta
         layer = self._layer
         ub = layer.update_broker()
         sent = 0
+        t2 = time.time()
         if ub is not None and updates:
             records = [("UP", update) for update in updates]
+            # the "@trc" header carries this trace + the batch's origin
+            # timestamp onto the update topic (freshness chain)
+            pub_ctx = ctx.child() if ctx is not None else None
+            records, extra = tracing.with_header(records, pub_ctx, ingest_ms)
             with ub.producer(layer.update_topic) as producer:
                 sent = layer.retry_policy.call(
                     lambda: producer.send_many(records),
                     retry_on=(ConnectionError, OSError),
                     metrics_prefix="speed.publish",
                     stop_event=layer._stop_event,
+                ) - extra
+            if ctx is not None:
+                tracing.record_span(
+                    "speed.publish", pub_ctx, ctx.span_id, t2,
+                    time.time() - t2, {"updates": len(updates)},
                 )
         # the at-least-once commit point: updates are on the bus, so the
         # drained range may now be marked consumed
         if layer.id and positions:
             layer.input_broker().set_offsets(
                 layer.group_id, layer.input_topic, positions
+            )
+        metrics.registry.histogram("speed.freshness.seconds").observe(
+            max(0.0, time.time() - ingest_ms / 1000.0)
+        )
+        if ctx is not None:
+            tracing.record_span(
+                "speed.batch", ctx, parent_span_id, t0,
+                time.time() - t0, {"events": total, "updates": sent},
             )
         metrics.registry.counter("speed.events").inc(total)
         metrics.registry.counter("speed.updates").inc(sent)

@@ -20,16 +20,41 @@ retried under the same policy.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 
 from oryx_tpu.common.records import BlockRecords
-from oryx_tpu.common import metrics, profiling
+from oryx_tpu.common import metrics, profiling, tracing
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_.base import AbstractLayer, GuardedBlockFeed
 
 log = logging.getLogger(__name__)
+
+
+def batch_origin(blocks) -> tuple[tracing.TraceContext | None, int | None]:
+    """(incoming sampled trace context, earliest origin ingest ms) merged
+    across a drained micro-batch's transport-carried ``@trc`` headers: the
+    first sampled context continues that trace through the batch's
+    parse/fold/publish spans; the earliest stamped ``ts`` becomes the
+    batch's origin for the freshness chain (re-stamped on the UP publish,
+    so serving can observe event-ingest -> servable-visibility)."""
+    ctx = None
+    earliest = None
+    for b in blocks:
+        info = tracing.parse_header(getattr(b, "trace", None))
+        if info is None:
+            continue
+        if ctx is None and info.ctx is not None and info.ctx.sampled:
+            ctx = info.ctx
+        if info.ingest_ms is not None:
+            earliest = (
+                info.ingest_ms
+                if earliest is None
+                else min(earliest, info.ingest_ms)
+            )
+    return ctx, earliest
 
 
 def dead_letter_topic_for(config: Config) -> str:
@@ -194,40 +219,82 @@ class SpeedLayer(AbstractLayer):
         pin = getattr(consumer, "pin", None)
         if pin is not None:
             pin()
+        t0 = time.time()
         try:
             blocks, total = self.drain_input_blocks(self.max_batch_events)
             if total == 0:
                 return 0
+            # continue a sampled trace carried in on the input blocks, or
+            # roll the sampling dice for a fresh per-micro-batch root; the
+            # origin timestamp flows through to the UP publish regardless
+            # of sampling (freshness is always-on)
+            incoming_ctx, origin_ms = batch_origin(blocks)
+            ingest_ms = origin_ms if origin_ms is not None else int(t0 * 1000)
+            ctx = tracing.continue_from(incoming_ctx) or tracing.sample_root()
+            if ctx is not None:
+                tracing.record_span(
+                    "speed.parse", ctx.child(), ctx.span_id, t0,
+                    time.time() - t0,
+                    {"events": total, "blocks": len(blocks)},
+                )
             new_data = BlockRecords(blocks)
-            with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
-                with profiling.maybe_trace(
-                    profiling.profile_dir_from_config(self.config, "speed"),
-                    "speed-batch",
-                ):
-                    updates = self.manager.build_updates(new_data)
+            with tracing.use(ctx) if ctx is not None else contextlib.nullcontext():
+                with tracing.span("speed.fold", attrs={"events": total}):
+                    with metrics.timed(
+                        metrics.registry.histogram("speed.batch.seconds")
+                    ):
+                        with profiling.maybe_trace(
+                            profiling.profile_dir_from_config(self.config, "speed"),
+                            "speed-batch",
+                        ):
+                            updates = self.manager.build_updates(new_data)
         finally:
             release = getattr(consumer, "release", None)
             if release is not None:
                 release()
-        with metrics.timed(metrics.registry.histogram("speed.publish.seconds")):
-            ub = self.update_broker()
-            sent = 0
-            if ub is not None:
-                # each delta goes out with key "UP" (SpeedLayerUpdate.java:
-                # 58-60); one batched publish per micro-batch so the bus
-                # pays one lock/write cycle, not one per delta. The publish
-                # retries under the layer policy (transient bus faults);
-                # materialized so a retry resends the same records.
-                records = [("UP", update) for update in updates]
-                with ub.producer(self.update_topic) as producer:
-                    sent = self.retry_policy.call(
-                        lambda: producer.send_many(records),
-                        retry_on=(ConnectionError, OSError),
-                        metrics_prefix="speed.publish",
-                        stop_event=self._stop_event,
-                    )
-            if self.id:
-                self._input_consumer.commit()
+        with tracing.use(ctx) if ctx is not None else contextlib.nullcontext():
+            with metrics.timed(metrics.registry.histogram("speed.publish.seconds")):
+                ub = self.update_broker()
+                sent = 0
+                if ub is not None:
+                    with tracing.span(
+                        "speed.publish", attrs={"updates": len(updates)}
+                    ):
+                        # each delta goes out with key "UP"
+                        # (SpeedLayerUpdate.java:58-60); one batched publish
+                        # per micro-batch so the bus pays one lock/write
+                        # cycle, not one per delta. The publish retries
+                        # under the layer policy (transient bus faults);
+                        # materialized so a retry resends the same records
+                        # (including the prepended "@trc" header carrying
+                        # this trace + the batch's origin timestamp).
+                        records = [("UP", update) for update in updates]
+                        extra = 0
+                        if records:
+                            records, extra = tracing.with_header(
+                                records, ingest_ms=ingest_ms
+                            )
+                        with ub.producer(self.update_topic) as producer:
+                            sent = self.retry_policy.call(
+                                lambda: producer.send_many(records),
+                                retry_on=(ConnectionError, OSError),
+                                metrics_prefix="speed.publish",
+                                stop_event=self._stop_event,
+                            ) - extra
+                if self.id:
+                    self._input_consumer.commit()
+        # the micro-batch's deltas are now servable-visible to any replica
+        # that polls: event-ingest -> published, the speed half of the
+        # freshness chain (serving closes it with serving.freshness.seconds)
+        metrics.registry.histogram("speed.freshness.seconds").observe(
+            max(0.0, time.time() - ingest_ms / 1000.0)
+        )
+        if ctx is not None:
+            tracing.record_span(
+                "speed.batch", ctx,
+                incoming_ctx.span_id if incoming_ctx is not None else None,
+                t0, time.time() - t0, {"events": total, "updates": sent},
+            )
         metrics.registry.counter("speed.events").inc(total)
         metrics.registry.counter("speed.updates").inc(sent)
         self._batch_count += 1

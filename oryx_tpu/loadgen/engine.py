@@ -38,6 +38,7 @@ from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
+from oryx_tpu.common import tracing
 from oryx_tpu.common.metrics import SLOWindow
 
 __all__ = ["LoadResult", "OpenLoopEngine", "RequestRecord", "Target", "classify_error"]
@@ -83,6 +84,9 @@ class RequestRecord:
     target: str
     ok: bool
     kind: str  # "ok" or an error kind
+    # sampled requests carry a traceparent header, so the client-side
+    # record can be joined against the server's spans in GET /trace
+    trace_id: str | None = None
 
 
 @dataclass
@@ -197,17 +201,23 @@ class OpenLoopEngine:
 
     def _execute(self, t_run0: float, t_sched: float, user: int, sink: list) -> None:
         t_send = time.perf_counter()
+        t_wall0 = time.time()
         target = self._pick_target()
         ok = False
         kind = "ok"
+        # client root span: sampled requests ship their context as a
+        # traceparent header, so the server's serving.request (and the
+        # queue-wait/scan/rescore spans under it) land in the same trace
+        ctx = tracing.sample_root()
         if target is None:
             kind = "no-ready-replica"
         else:
             path = self.template % user if "%d" in self.template else self.template
             try:
-                with urllib.request.urlopen(
-                    target.base_url + path, timeout=self.timeout_s
-                ) as resp:
+                req = urllib.request.Request(target.base_url + path)
+                if ctx is not None:
+                    req.add_header("traceparent", ctx.traceparent())
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     resp.read()
                     ok = 200 <= resp.status < 300
                     if not ok:  # non-2xx that didn't raise (3xx)
@@ -215,6 +225,12 @@ class OpenLoopEngine:
             except Exception as e:  # noqa: BLE001 - classified, not swallowed
                 kind = classify_error(e)
         t_end = time.perf_counter()
+        if ctx is not None:
+            tracing.record_span(
+                "client.request", ctx, None, t_wall0, t_end - t_send,
+                {"target": target.name if target is not None else "-",
+                 "kind": kind},
+            )
         rec = RequestRecord(
             t_sched=t_sched,
             latency=(t_end - t_run0) - t_sched,
@@ -222,6 +238,7 @@ class OpenLoopEngine:
             target=target.name if target is not None else "-",
             ok=ok,
             kind=kind,
+            trace_id=ctx.trace_id if ctx is not None else None,
         )
         with self._lock:
             sink.append(rec)

@@ -39,7 +39,7 @@ from xml.etree.ElementTree import Element
 
 from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
-from oryx_tpu.common import pmml as pmml_io, rng, storage
+from oryx_tpu.common import pmml as pmml_io, rng, storage, tracing
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import collect_in_parallel
 from oryx_tpu.common.records import ChainRecords, ListRecords, as_records
@@ -306,25 +306,37 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             if model_update_topic is None:
                 log.info("not publishing model to update topic since none is configured")
             else:
-                if pmml_text is not None:
+                # publish under a (sampled-root) trace span, with a "@trc"
+                # header stamped with publish time: every replica that
+                # applies this generation records a serving.model.apply
+                # span in the same trace and derives its propagation skew
+                # from the timestamp
+                publish_ms = int(time.time() * 1000)
+                with tracing.span(
+                    "batch.publish-model",
+                    attrs={"generation": generation_id},
+                    root=True,
+                ):
+                    if pmml_text is not None:
+                        records, _ = tracing.with_header(
+                            [("MODEL", pmml_text)], ingest_ms=publish_ms
+                        )
+                    else:
+                        # a MODEL-REF names the *generation dir* — registry-
+                        # resolvable (manifest + side artifacts travel with
+                        # the document), never a bare file path
+                        ref = store.generation_dir(generation_id)
+                        records, _ = tracing.with_header(
+                            [("MODEL-REF", ref)], ingest_ms=publish_ms
+                        )
                     self.publish_retry.call(
-                        lambda: model_update_topic.send("MODEL", pmml_text),
+                        lambda: model_update_topic.send_many(records),
                         retry_on=(ConnectionError, OSError),
                         metrics_prefix="batch.publish",
                     )
-                else:
-                    # a MODEL-REF names the *generation dir* — registry-
-                    # resolvable (manifest + side artifacts travel with the
-                    # document), never a bare file path
-                    ref = store.generation_dir(generation_id)
-                    self.publish_retry.call(
-                        lambda: model_update_topic.send("MODEL-REF", ref),
-                        retry_on=(ConnectionError, OSError),
-                        metrics_prefix="batch.publish",
+                    self.publish_additional_model_data(
+                        best_pmml, new_data, past_records, final_dir, model_update_topic
                     )
-                self.publish_additional_model_data(
-                    best_pmml, new_data, past_records, final_dir, model_update_topic
-                )
         finally:
             shutil.rmtree(candidates_root, ignore_errors=True)
         store.gc(self.retention_max_generations, never_delete={generation_id})

@@ -57,6 +57,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from oryx_tpu.common import tracing
 from oryx_tpu.common.metrics import registry as _metrics
 from oryx_tpu.ops import topn as topn_ops
 
@@ -90,6 +91,13 @@ class _Entry:
     idx: np.ndarray | None = None
     vals: np.ndarray | None = None
     error: BaseException | None = None
+    # tracing: the request's sampled context captured at enqueue, plus the
+    # wall-clock phase stamps the completer turns into queue-wait /
+    # assemble / scan spans. None/0.0 (unsampled) costs nothing.
+    trace_ctx: object | None = None
+    t_enqueue: float = 0.0
+    t_dispatch: float = 0.0
+    t_submit: float = 0.0
 
 
 def _k_bucket(k: int) -> int:
@@ -101,6 +109,40 @@ def _b_bucket(n: int) -> int:
     pad coalesced batches to power-of-two row counts (zero queries) to keep
     the number of distinct compiled programs logarithmic in max_batch."""
     return max(8, 1 << (int(n) - 1).bit_length())
+
+
+def _record_entry_spans(e: _Entry, t_done: float) -> None:
+    """One request's batching lifecycle as three sibling spans under the
+    request span — explicit timestamps because the phases were measured by
+    three different threads, none of which carries the ambient context:
+
+        serving.queue-wait   enqueue -> dispatcher picks it up (incl. the
+                             inflight-slot wait: backpressure is queueing)
+        serving.assemble     grouping / padding / device submit
+        serving.scan         device scan (submit -> results back); carries
+                             the IVF probe count when the scanned matrix
+                             is an IVF index
+    """
+    ctx = e.trace_ctx
+    attrs = None
+    resolve_nprobe = getattr(e.uploaded, "resolve_nprobe", None)
+    if resolve_nprobe is not None:
+        try:
+            attrs = {"nprobe": int(resolve_nprobe())}
+        except Exception:
+            attrs = None
+    tracing.record_span(
+        "serving.queue-wait", ctx.child(), ctx.span_id,
+        e.t_enqueue, e.t_dispatch - e.t_enqueue,
+    )
+    tracing.record_span(
+        "serving.assemble", ctx.child(), ctx.span_id,
+        e.t_dispatch, e.t_submit - e.t_dispatch,
+    )
+    tracing.record_span(
+        "serving.scan", ctx.child(), ctx.span_id,
+        e.t_submit, t_done - e.t_submit, attrs,
+    )
 
 
 class TopNBatcher:
@@ -168,6 +210,11 @@ class TopNBatcher:
         return self._enqueue(e)
 
     def _enqueue(self, e: _Entry) -> tuple[np.ndarray, np.ndarray]:
+        if tracing.enabled():
+            ctx = tracing.current()
+            if ctx is not None and ctx.sampled:
+                e.trace_ctx = ctx
+                e.t_enqueue = time.time()
         with self._state_lock:  # an entry can never land after the sentinel
             if self._closed:
                 raise BatcherClosedError("batcher is closed")
@@ -272,6 +319,11 @@ class TopNBatcher:
 
     def _submit_group(self, entries: list[_Entry], cosine: bool) -> None:
         self._acquire_slot()
+        # queue-wait ends here: the entry has a dispatcher AND an inflight
+        # slot (slot contention is backpressure, i.e. still queueing)
+        for e in entries:
+            if e.trace_ctx is not None:
+                e.t_dispatch = time.time()
         try:
             if entries[0].row is not None:
                 self._submit_indexed(entries, cosine)
@@ -297,6 +349,9 @@ class TopNBatcher:
                 handle = topn_ops.submit_top_k(
                     entries[0].uploaded, queries, kk, cosine=cosine
                 )
+            for e in entries:
+                if e.trace_ctx is not None:
+                    e.t_submit = time.time()
             self._pending.put((handle, entries, time.perf_counter()))
         except BaseException as exc:  # deliver the failure to the waiters
             self._release_slot()
@@ -322,6 +377,9 @@ class TopNBatcher:
                 cosine=cosine,
                 scan_batch=self.MULTI_THRESHOLD,
             )
+            for e in entries:
+                if e.trace_ctx is not None:
+                    e.t_submit = time.time()
             self._pending.put((handle, entries, time.perf_counter()))
         except BaseException as exc:  # deliver the failure to the waiters
             self._release_slot()
@@ -349,7 +407,10 @@ class TopNBatcher:
                     e.error = exc
             finally:
                 self._release_slot(latency)
+                t_done = time.time()
                 for e in entries:
+                    if e.trace_ctx is not None:
+                        _record_entry_spans(e, t_done)
                     e.done.set()
 
     # -- lifecycle -----------------------------------------------------------

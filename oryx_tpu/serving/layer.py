@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, HTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from oryx_tpu.bus.core import get_broker
-from oryx_tpu.common import metrics
+from oryx_tpu.common import metrics, profiling, tracing
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
@@ -263,7 +263,75 @@ def _metrics(ctx: ServingContext, req: Request) -> Response:
             "type": "gauge",
             "value": ctx.health.live_generation,
         }
+    accept = next(
+        (v for k, v in req.headers.items() if k.lower() == "accept"), ""
+    )
+    if (
+        req.q1("format") == "prometheus"
+        or "text/plain" in accept
+        or "openmetrics" in accept
+    ) and req.q1("format") != "json":
+        # standard-scraper exposition (Prometheus sends
+        # `Accept: text/plain;version=0.0.4`); live_generation may be a
+        # non-numeric id, which the renderer would choke on — drop it
+        # from the text form (scrapers read the per-generation request
+        # counters instead)
+        prom = {
+            k: v
+            for k, v in snap.items()
+            if not (k == "serving.model.live_generation" and _non_numeric(v))
+        }
+        return Response(
+            200,
+            metrics.render_prometheus(prom),
+            content_type=metrics.PROMETHEUS_CONTENT_TYPE,
+        )
     return Response(200, snap, content_type="application/json")
+
+
+def _non_numeric(entry) -> bool:
+    try:
+        float(entry.get("value"))
+        return False
+    except (TypeError, ValueError):
+        return True
+
+
+@resource("GET", "/trace")
+def _trace(ctx: ServingContext, req: Request) -> Response:
+    """This process's recorded spans: Chrome-trace/Perfetto JSON by
+    default (load in chrome://tracing or ui.perfetto.dev), or the raw
+    span list with parent links under ?format=spans. ?trace=<32hex>
+    filters to one trace id — the loadgen client records the ids it
+    sent, so a request's server-side breakdown is one GET away."""
+    trace_id = req.q1("trace")
+    if req.q1("format") == "spans":
+        body = {"spans": tracing.spans(trace_id), **tracing.stats()}
+    else:
+        body = tracing.export_chrome(trace_id)
+    return Response(200, body, content_type="application/json")
+
+
+@resource("POST", "/debug/profile")
+def _debug_profile(ctx: ServingContext, req: Request) -> Response:
+    """On-demand JAX profiler capture: trace this process's devices for
+    ?seconds=N (default 1, capped at 30), write the xprof trace under
+    oryx.serving.compute.profile-dir, return the path. 503 when no
+    profile dir is configured or the profiler cannot start."""
+    profile_dir = profiling.profile_dir_from_config(ctx.config, "serving")
+    if not profile_dir:
+        raise OryxServingException(
+            503, "oryx.serving.compute.profile-dir is not configured"
+        )
+    seconds = min(30.0, max(0.0, req.q_float("seconds", 1.0)))
+    try:
+        target = profiling.capture(profile_dir, "serving-ondemand", seconds)
+    except RuntimeError as e:
+        raise OryxServingException(503, str(e))
+    metrics.registry.counter("serving.debug.profiles").inc()
+    return Response(
+        200, {"path": target, "seconds": seconds}, content_type="application/json"
+    )
 
 
 @resource("GET", "/model/generations")
@@ -338,6 +406,33 @@ def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
     im.counter(f"serving.requests.generation.{generation}").inc()
 
 
+def observe_block_freshness(raw_trace, instance_metrics=None):
+    """Parse an update block's transport-carried ``@trc`` header and feed
+    the freshness histogram: seconds from the origin timestamp the
+    publisher stamped (earliest event-ingest time for speed updates,
+    publish time for model publishes) to visibility on this replica.
+    Returns the parsed :class:`tracing.BlockTrace` (or None) so the
+    caller can continue the publisher's trace."""
+    info = tracing.parse_header(raw_trace)
+    if info is None:
+        return None
+    if info.ingest_ms is not None:
+        age_s = max(0.0, time.time() - info.ingest_ms / 1000.0)
+        metrics.registry.histogram("serving.freshness.seconds").observe(age_s)
+        if instance_metrics is not None:
+            instance_metrics.histogram("serving.freshness.seconds").observe(
+                age_s
+            )
+    return info
+
+
+def _block_has_model(block) -> bool:
+    keys = getattr(block, "keys", None)
+    if keys is None:
+        return False
+    return bool((keys == b"MODEL").any() or (keys == b"MODEL-REF").any())
+
+
 def _model_ready(ctx: ServingContext) -> bool:
     manager = ctx.model_manager
     if manager is None:
@@ -356,6 +451,7 @@ class ServingLayer:
         from oryx_tpu.parallel.distributed import maybe_enable_compile_cache
 
         maybe_enable_compile_cache(config)  # device scans cache like training
+        tracing.configure_from(config)
         self.port = config.get_int("oryx.serving.api.port")
         self.context_path = config.get_string("oryx.serving.api.context-path").rstrip("/")
         self.read_only = config.get_bool("oryx.serving.api.read-only")
@@ -584,7 +680,15 @@ class ServingLayer:
         """blocking_block_iterator with a health reporter: every poll that
         returns marks the update stream healthy, a poll that raises marks
         it down (degraded mode) and propagates to the supervisor, and each
-        applied block timestamps the staleness clock."""
+        applied block timestamps the staleness clock.
+
+        Observability rides here too: a block carrying a ``@trc`` header
+        feeds the freshness histogram (origin timestamp -> visible on
+        this replica) and, when the publisher's trace was sampled, the
+        apply is recorded as a span of that trace — the consumer side of
+        the publish->apply propagation pair. A redelivered duplicate
+        carries the same header, so it shows up as the same trace id with
+        a fresh span id per delivery."""
         consumer = self._update_consumer
         while not self._stop_event.is_set() and not consumer.closed():
             try:
@@ -593,11 +697,50 @@ class ServingLayer:
                 self.health.mark_stream_down()
                 raise
             self.health.mark_stream_ok()
+            raw_trace = getattr(block, "trace", None)
             # track live generation + suppress duplicate deliveries of the
             # live generation's MODEL before the manager sees the block
             block = self.generation_tracker.filter_block(block)
             if block is not None and len(block) > 0:
-                yield block
+                info = observe_block_freshness(
+                    raw_trace, self.instance_metrics
+                )
+                apply_ctx = (
+                    tracing.continue_from(info.ctx)
+                    if info is not None and info.ctx is not None
+                    else None
+                )
+                if apply_ctx is None:
+                    yield block
+                else:
+                    name = (
+                        "serving.model.apply"
+                        if _block_has_model(block)
+                        else "serving.apply"
+                    )
+                    # parent = the publisher's span (info.ctx); the span
+                    # covers the manager's processing of the block (the
+                    # time between yield and resume)
+                    with tracing.use(info.ctx):
+                        with tracing.span(
+                            name,
+                            attrs={
+                                "instance": self.port,
+                                "records": len(block),
+                            },
+                        ) as sp:
+                            if info.ingest_ms is not None:
+                                sp.set(
+                                    "skew_ms",
+                                    round(
+                                        time.time() * 1000 - info.ingest_ms, 3
+                                    ),
+                                )
+                            yield block
+                            if self.health.live_generation is not None:
+                                sp.set(
+                                    "generation", self.health.live_generation
+                                )
                 self.health.mark_update()
 
     def await_termination(self, timeout: float | None = None) -> None:
@@ -758,7 +901,27 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 headers={k: v for k, v in self.headers.items()},
                 body=body,
             )
-            response = layer.router.dispatch(ctx, req)
+            # request-lifecycle span: a sampled incoming traceparent is
+            # honored (the loadgen client's span becomes this span's
+            # parent, joined by trace id); header-less requests roll the
+            # root sampling dice. Untraced requests skip all of it.
+            incoming = tracing.parse_traceparent(self.headers.get("traceparent"))
+            if incoming is not None and incoming.sampled:
+                with tracing.use(incoming):
+                    with tracing.span(
+                        "serving.request",
+                        attrs={"path": path, "method": req.method},
+                    ) as sp:
+                        response = layer.router.dispatch(ctx, req)
+                        sp.set("status", getattr(response, "status", 200))
+            else:
+                with tracing.span(
+                    "serving.request",
+                    attrs={"path": path, "method": req.method},
+                    root=True,
+                ) as sp:
+                    response = layer.router.dispatch(ctx, req)
+                    sp.set("status", getattr(response, "status", 200))
             return render(response, self.headers.get("Accept", "application/json"))
 
         def _authorized(self) -> bool:

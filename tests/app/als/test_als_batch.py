@@ -66,7 +66,15 @@ def test_full_run_update_publishes_model_and_factors(tmp_path):
     tail = broker.consumer("OryxUpdate", from_beginning=True)
     with broker.producer("OryxUpdate") as producer:
         update.run_update(1000, data, [], str(tmp_path / "model"), producer)
-    msgs = tail.poll(max_records=10_000, timeout=2.0)
+    from oryx_tpu.common import tracing
+
+    # skip the `@trc` trace/freshness control record (stripped by block
+    # consumers; a raw poll sees it)
+    msgs = [
+        m
+        for m in tail.poll(max_records=10_000, timeout=2.0)
+        if m.key != tracing.TRACE_KEY
+    ]
     assert msgs[0].key == "MODEL"
     ups = [m for m in msgs if m.key == "UP"]
     # Y rows come before X rows (ALSUpdate.java:194-230 ordering)

@@ -1,0 +1,186 @@
+"""Trace-context carriage through every bus transport, and under chaos.
+
+The `@trc` control record a traced publisher prepends must (a) reach the
+consumer as `block.trace` on every transport, (b) never leak into the
+delivered payload records, and (c) survive the chaos bus's drop / delay
+/ dup levers with at-least-once semantics — a duplicated delivery shows
+the SAME trace id, and `continue_from` mints a fresh span id per
+delivery so redeliveries are distinguishable in the span ring."""
+
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.common import metrics, tracing
+from oryx_tpu.common.tracing import TraceContext
+
+CTX = TraceContext("ab" * 16, "cd" * 8, True)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    tracing.reset()
+    tracing.configure(sample_rate=1.0)
+    yield
+    tracing.reset()
+
+
+@pytest.fixture(params=["inproc", "file", "shm"])
+def locator(request, tmp_path):
+    if request.param == "inproc":
+        return "inproc://trace-prop"
+    return f"{request.param}:{tmp_path}/bus"
+
+
+def _produce_all(producer, records, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return producer.send_many(records)
+        except ConnectionError:
+            if time.monotonic() >= deadline:
+                raise
+
+
+def test_header_round_trips_and_is_stripped(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", 1)
+    records, extra = tracing.with_header(
+        [("k1", "v1"), (None, "v2")], CTX, ingest_ms=4242
+    )
+    assert extra == 1
+    with broker.producer("T") as p:
+        assert p.send_many(records) == 3  # header occupies a topic offset
+    c = broker.consumer("T", from_beginning=True)
+    block = c.poll_block(max_records=10, timeout=1.0)
+    # the control record is stripped from the delivered payload...
+    assert len(block) == 2
+    assert [m for m in block.messages] == [b"v1", b"v2"]
+    # ...and surfaced, raw, as block.trace
+    info = tracing.parse_header(block.trace)
+    assert info.ctx == CTX and info.ingest_ms == 4242
+    c.close()
+
+
+def test_untraced_batch_has_no_header(locator):
+    broker = bus.get_broker(locator)
+    broker.create_topic("T", 1)
+    records, extra = tracing.with_header([(None, "plain")])
+    assert extra == 0  # nothing to carry: hot path stays header-free
+    with broker.producer("T") as p:
+        assert p.send_many(records) == 1
+    c = broker.consumer("T", from_beginning=True)
+    block = c.poll_block(timeout=1.0)
+    assert len(block) == 1 and block.trace is None
+    c.close()
+
+
+def test_columnar_frames_carry_ambient_trace(tmp_path):
+    """The shm columnar path (send_interactions -> KIND_TRACE frame):
+    the producer's ambient context rides next to the typed columns."""
+    broker = bus.get_broker(f"shm:{tmp_path}/bus")
+    broker.create_topic("T", 1)
+    users = np.arange(50, dtype=np.int32)
+    with broker.producer("T") as p, tracing.use(CTX):
+        assert p.send_interactions(users, users, users.astype(np.float32)) == 50
+    c = broker.consumer("T", from_beginning=True)
+    block = c.poll_block(max_records=100, timeout=1.0)
+    assert len(block) == 50
+    info = tracing.parse_header(block.trace)
+    assert info is not None and info.ctx is not None
+    assert info.ctx.trace_id == CTX.trace_id
+    # materialize() must not lose the trace
+    assert block.materialize().trace == block.trace
+    c.close()
+
+
+def test_trace_survives_shm_crc_resync(tmp_path):
+    """A torn columnar frame is CRC-rejected and resynced past; the trace
+    frame of the NEXT batch still parses."""
+    from oryx_tpu.bus import shmbus
+
+    broker = bus.get_broker(f"shm:{tmp_path}/bus")
+    broker.create_topic("T", 1)
+    u1 = np.arange(10, dtype=np.int32)
+    u2 = np.arange(10, 15, dtype=np.int32)
+    with broker.producer("T") as p:
+        p.send_interactions(u1, u1, u1.astype(np.float32))
+        with tracing.use(CTX):
+            p.send_interactions(u2, u2, u2.astype(np.float32))
+    ring_path = tmp_path / "bus" / "T" / "partition-0.ring"
+    with open(ring_path, "r+b") as f:
+        f.seek(shmbus._HEADER_PAGE + shmbus.blockcodec.HEADER_BYTES + 8)
+        f.write(b"\xff\xff\xff\xff")
+    resyncs0 = metrics.registry.counter("bus.shm.crc-resyncs").value
+    c = broker.consumer("T", from_beginning=True)
+    block = c.poll_block(max_records=100, timeout=1.0)
+    assert block is not None and len(block) == 5
+    np.testing.assert_array_equal(block.users, u2)
+    info = tracing.parse_header(block.trace)
+    assert info is not None and info.ctx.trace_id == CTX.trace_id
+    assert metrics.registry.counter("bus.shm.crc-resyncs").value > resyncs0
+    c.close()
+
+
+def test_trace_header_at_least_once_under_chaos(tmp_path):
+    """drop + delay levers on: every payload AND every batch's trace id
+    eventually arrives (at-least-once holds for control records too)."""
+    loc = f"fault+file:{tmp_path}/bus?drop=0.4&delay_ms=2&seed=11"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    want_traces = set()
+    with broker.producer("T") as p:
+        for i in range(8):
+            ctx = TraceContext(f"{i + 1:032x}", f"{i + 1:016x}", True)
+            want_traces.add(ctx.trace_id)
+            records, _ = tracing.with_header([(None, f"m{i}")], ctx, ingest_ms=i)
+            _produce_all(p, records)
+    c = broker.consumer("T", from_beginning=True)
+    got_msgs: set = set()
+    got_traces: set = set()
+    deadline = time.monotonic() + 20.0
+    while (
+        len(got_msgs) < 8 or not want_traces.issubset(got_traces)
+    ) and time.monotonic() < deadline:
+        # raw poll: a wide poll_block would coalesce batches and keep only
+        # the last header, so inspect every control record individually
+        for km in c.poll(100, timeout=0.05):
+            if km.key in (tracing.TRACE_KEY, tracing.TRACE_KEY.encode()):
+                info = tracing.parse_header(km.message)
+                if info is not None and info.ctx is not None:
+                    got_traces.add(info.ctx.trace_id)
+            else:
+                m = km.message
+                got_msgs.add(m.decode() if isinstance(m, bytes) else m)
+    assert got_msgs == {f"m{i}" for i in range(8)}
+    assert want_traces.issubset(got_traces)
+    c.close()
+
+
+def test_duplicate_delivery_same_trace_fresh_span(tmp_path):
+    """dup lever at 1.0: the batch (header included) is delivered more
+    than once. Both deliveries carry the SAME trace id — and
+    `continue_from` mints a distinct span id per delivery, so each
+    delivery's spans are separable in the ring."""
+    loc = f"fault+file:{tmp_path}/bus?dup=1.0&seed=5"
+    broker = bus.get_broker(loc)
+    broker.create_topic("T", 1)
+    records, _ = tracing.with_header([(None, "payload")], CTX, ingest_ms=7)
+    with broker.producer("T") as p:
+        _produce_all(p, records)
+    c = broker.consumer("T", from_beginning=True)
+    headers: list = []
+    deadline = time.monotonic() + 10.0
+    while len(headers) < 2 and time.monotonic() < deadline:
+        for km in c.poll(100, timeout=0.05):
+            if km.key in (tracing.TRACE_KEY, tracing.TRACE_KEY.encode()):
+                headers.append(km.message)
+    assert len(headers) >= 2, "dup lever never duplicated the delivery"
+    infos = [tracing.parse_header(h) for h in headers]
+    assert {i.ctx.trace_id for i in infos} == {CTX.trace_id}
+    kids = [tracing.continue_from(i.ctx) for i in infos]
+    assert len({k.span_id for k in kids}) == len(kids)
+    assert {k.trace_id for k in kids} == {CTX.trace_id}
+    c.close()

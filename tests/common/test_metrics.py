@@ -2,6 +2,8 @@
 
 import threading
 
+import pytest
+
 from oryx_tpu.common.metrics import Counter, Histogram, MetricsRegistry, registry, timed
 
 
@@ -63,6 +65,78 @@ def test_thread_safety():
         t.join()
     assert r.counter("n").value == 40_000
     assert r.histogram("h").count == 40_000
+
+
+def test_histogram_snapshot_never_torn_under_concurrent_observe():
+    """The whole snapshot is taken under one lock: bucket totals, count
+    and sum must always agree with each other, even while observers are
+    mid-flight on other threads."""
+    h = Histogram()
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            h.observe(0.003)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = h.snapshot()
+            if snap["count"] == 0:
+                continue
+            # cumulative buckets end at exactly `count`, and the sum is
+            # consistent with `count` identical observations
+            assert snap["buckets"][-1][1] == snap["count"]
+            assert snap["sum"] == pytest.approx(0.003 * snap["count"])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_render_prometheus_exposition():
+    from oryx_tpu.common.metrics import render_prometheus
+
+    r = MetricsRegistry()
+    r.counter("speed.events").inc(3)
+    r.gauge("serving.draining").set(1.0)
+    r.histogram("serving.request.seconds").observe(0.004)
+    r.gauge("unset.gauge")  # never set: must be omitted
+    text = render_prometheus(r.snapshot())
+    assert "# TYPE speed_events counter" in text
+    assert "speed_events 3" in text
+    assert "serving_draining 1" in text
+    assert "# TYPE serving_request_seconds histogram" in text
+    assert 'serving_request_seconds_bucket{le="+Inf"} 1' in text
+    assert "serving_request_seconds_count 1" in text
+    assert "serving_request_seconds_sum 0.004" in text
+    assert "unset_gauge" not in text
+    # cumulative `le` buckets: monotone non-decreasing up to count
+    cums = [
+        int(ln.rsplit(" ", 1)[1])
+        for ln in text.splitlines()
+        if ln.startswith("serving_request_seconds_bucket")
+    ]
+    assert cums == sorted(cums) and cums[-1] == 1
+
+
+def test_render_prometheus_empty_histogram_and_junk_entries():
+    from oryx_tpu.common.metrics import render_prometheus
+
+    r = MetricsRegistry()
+    r.histogram("empty.h")
+    snap = r.snapshot()
+    snap["serving.model.live_generation"] = {"type": "info", "value": "12345"}
+    snap["not-a-dict"] = 7
+    text = render_prometheus(snap)
+    # an empty histogram still exposes its +Inf bucket (scrapers choke on
+    # TYPE lines with no samples)
+    assert 'empty_h_bucket{le="+Inf"} 0' in text
+    assert "empty_h_count 0" in text
+    # unknown shapes are skipped, not rendered or crashed on
+    assert "live_generation" not in text
 
 
 def test_serving_metrics_endpoint(tmp_path):

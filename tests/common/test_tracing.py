@@ -1,0 +1,204 @@
+"""Unit tests for the distributed tracer (common/tracing.py): context
+parsing, parent-based sampling, span recording, the bounded ring,
+Chrome-trace export, and the `@trc` bus-header carriage."""
+
+import pytest
+
+from oryx_tpu.common import tracing
+from oryx_tpu.common.tracing import TraceContext
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Every test starts from defaults with sampling forced on (the
+    default 1% rate would make span assertions flaky) and leaves no
+    ambient context or ring contents behind."""
+    tracing.reset()
+    tracing.configure(sample_rate=1.0)
+    yield
+    tracing.reset()
+
+
+TRACE_ID = "ab" * 16
+SPAN_ID = "cd" * 8
+
+
+def test_traceparent_round_trip():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    assert ctx.traceparent() == f"00-{TRACE_ID}-{SPAN_ID}-01"
+    back = tracing.parse_traceparent(ctx.traceparent())
+    assert back == ctx
+    unsampled = TraceContext(TRACE_ID, SPAN_ID, False)
+    assert tracing.parse_traceparent(unsampled.traceparent()) == unsampled
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "00-deadbeef-cd-01",  # short ids
+        f"00-{TRACE_ID}-{SPAN_ID}",  # 3 parts
+        f"00-{'0' * 32}-{SPAN_ID}-01",  # all-zero trace id
+        f"00-{TRACE_ID}-{'0' * 16}-01",  # all-zero span id
+        f"ff-{TRACE_ID}-{SPAN_ID}-01",  # reserved version
+        f"00-{'zz' * 16}-{SPAN_ID}-01",  # non-hex
+    ],
+)
+def test_parse_traceparent_rejects_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_child_keeps_trace_id_fresh_span_id():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    kid = ctx.child()
+    assert kid.trace_id == TRACE_ID
+    assert kid.span_id != SPAN_ID
+    assert kid.sampled
+
+
+def test_sample_root_honors_rate_and_enabled():
+    assert tracing.sample_root() is not None  # rate 1.0
+    tracing.configure(sample_rate=0.0)
+    assert tracing.sample_root() is None
+    tracing.configure(enabled=False, sample_rate=1.0)
+    assert tracing.sample_root() is None
+
+
+def test_continue_from_parent_based_sampling():
+    parent = TraceContext(TRACE_ID, SPAN_ID, True)
+    kid = tracing.continue_from(parent)
+    assert kid is not None and kid.trace_id == TRACE_ID
+    assert kid.span_id != SPAN_ID  # a redelivery gets a fresh span id
+    # string form (as carried in a traceparent header / @trc record)
+    kid2 = tracing.continue_from(parent.traceparent())
+    assert kid2 is not None and kid2.trace_id == TRACE_ID
+    # an unsampled parent is never resurrected; disabled drops everything
+    assert tracing.continue_from(TraceContext(TRACE_ID, SPAN_ID, False)) is None
+    tracing.configure(enabled=False)
+    assert tracing.continue_from(parent) is None
+
+
+def test_span_nesting_links_parents():
+    with tracing.span("outer", root=True) as outer:
+        assert outer.ctx is not None
+        with tracing.span("inner", attrs={"k": 1}):
+            pass
+    recorded = tracing.spans()
+    assert [s["name"] for s in recorded] == ["inner", "outer"]
+    inner, outer_s = recorded
+    assert inner["trace"] == outer_s["trace"]
+    assert inner["parent"] == outer_s["span"]
+    assert outer_s["parent"] is None  # root
+    assert inner["attrs"] == {"k": 1}
+
+
+def test_span_is_null_when_untraced():
+    tracing.configure(sample_rate=0.0)
+    with tracing.span("x", root=True) as sp:
+        sp.set("ignored", 1)  # must not raise on the null span
+        assert sp.ctx is None
+    assert tracing.spans() == []
+
+
+def test_ambient_context_via_use():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    assert tracing.current() is None
+    with tracing.use(ctx):
+        assert tracing.current() == ctx
+        # span() parents off the ambient context
+        with tracing.span("work"):
+            pass
+    assert tracing.current() is None
+    (s,) = tracing.spans()
+    assert s["trace"] == TRACE_ID and s["parent"] == SPAN_ID
+
+
+def test_record_span_explicit_form_clamps_duration():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    tracing.record_span("q", ctx, None, 123.0, -0.5)
+    (s,) = tracing.spans()
+    assert s["dur"] == 0.0 and s["ts"] == 123.0
+    # unsampled contexts record nothing
+    tracing.record_span("q", TraceContext(TRACE_ID, SPAN_ID, False), None, 0.0, 1.0)
+    assert len(tracing.spans()) == 1
+
+
+def test_ring_capacity_bounds_and_stats():
+    tracing.configure(ring_capacity=4)
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    for i in range(6):
+        tracing.record_span(f"s{i}", ctx.child(), None, float(i), 0.0)
+    kept = tracing.spans()
+    assert [s["name"] for s in kept] == ["s2", "s3", "s4", "s5"]
+    st = tracing.stats()
+    assert st["recorded"] == 6 and st["buffered"] == 4
+    assert st["ring_capacity"] == 4
+
+
+def test_spans_filters_by_trace_id():
+    a = TraceContext("aa" * 16, SPAN_ID, True)
+    b = TraceContext("bb" * 16, SPAN_ID, True)
+    tracing.record_span("x", a, None, 0.0, 1.0)
+    tracing.record_span("y", b, None, 0.0, 1.0)
+    assert [s["name"] for s in tracing.spans("aa" * 16)] == ["x"]
+
+
+def test_export_chrome_shape():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    tracing.record_span("scan", ctx, "ee" * 8, 10.0, 0.25, {"nprobe": 7})
+    doc = tracing.export_chrome(TRACE_ID)
+    (ev,) = doc["traceEvents"]
+    assert ev["ph"] == "X"
+    assert ev["ts"] == pytest.approx(10.0 * 1e6)
+    assert ev["dur"] == pytest.approx(0.25 * 1e6)
+    assert ev["args"]["trace"] == TRACE_ID
+    assert ev["args"]["parent"] == "ee" * 8
+    assert ev["args"]["nprobe"] == 7
+    assert doc["enabled"] is True and doc["buffered"] == 1
+
+
+def test_header_record_and_parse_round_trip():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    key, msg = tracing.header_record(ctx, ingest_ms=1234)
+    assert key == tracing.TRACE_KEY
+    info = tracing.parse_header(msg)
+    assert info.ctx == ctx and info.ingest_ms == 1234
+    # timestamp-only header (unsampled traffic still drives freshness)
+    _, msg2 = tracing.header_record(None, ingest_ms=99)
+    info2 = tracing.parse_header(msg2)
+    assert info2.ctx is None and info2.ingest_ms == 99
+    # bytes form (the bus delivers bytes)
+    assert tracing.parse_header(msg.encode()) == info
+    assert tracing.parse_header(None) is None
+
+
+def test_header_record_suppressed_when_nothing_to_carry():
+    # untraced and no origin timestamp: the hot path stays header-free
+    assert tracing.header_record(None, ingest_ms=None) is None
+    tracing.configure(enabled=False)
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    assert tracing.header_record(ctx, ingest_ms=5) is None
+
+
+def test_with_header_reports_extra_count():
+    ctx = TraceContext(TRACE_ID, SPAN_ID, True)
+    out, extra = tracing.with_header([("k", "v")], ctx)
+    assert extra == 1 and out[0][0] == tracing.TRACE_KEY and out[1] == ("k", "v")
+    tracing.configure(enabled=False)
+    out2, extra2 = tracing.with_header([("k", "v")], ctx)
+    assert extra2 == 0 and out2 == [("k", "v")]
+
+
+def test_env_override_wins(monkeypatch):
+    monkeypatch.setenv("ORYX_TRACING", "0")
+    monkeypatch.setenv("ORYX_TRACING_SAMPLE_RATE", "1.0")
+    tracing.reset()
+    assert not tracing.enabled()
+    from oryx_tpu.common import config as C
+
+    tracing.configure_from(C.get_default())  # conf says enabled=true; env wins
+    assert not tracing.enabled()
+    monkeypatch.setenv("ORYX_TRACING", "1")
+    tracing.configure_from(C.get_default())
+    assert tracing.enabled() and tracing.sample_rate() == 1.0

@@ -52,7 +52,11 @@ def test_speed_layer_consumes_model_and_emits_updates():
     tail = broker.consumer("OryxUpdate")  # latest: skip the seeded model
     sent = layer.run_one_batch()
     assert sent == 2
-    ups = tail.poll(timeout=2.0)
+    # the batch rides with a `@trc` freshness/trace control record that
+    # block consumers strip; a raw poll sees it and must skip it
+    from oryx_tpu.common import tracing
+
+    ups = [m for m in tail.poll(timeout=2.0) if m.key != tracing.TRACE_KEY]
     assert all(m.key == "UP" for m in ups)
     got = dict(u.message.split(",") for u in ups)
     assert got == {"a": "2", "c": "1"}
@@ -102,4 +106,60 @@ def test_layer_ui_port_serves_metrics(tmp_path):
         assert body["layer"]["name"] == "speed"
         assert body["layer"]["stopped"] is False
     finally:
+        layer.close()
+
+
+def test_speed_batch_continues_input_trace_and_feeds_freshness():
+    """End-to-end speed-side tracing: an input batch published with a
+    `@trc` header (trace + origin timestamp) yields parse/fold/publish
+    spans in the SAME trace, the UP publish re-stamps the origin onto the
+    update topic (so serving can close the freshness chain), and
+    speed.freshness.seconds observes the event's true age."""
+    from oryx_tpu.common import metrics, tracing
+    from oryx_tpu.common.tracing import TraceContext
+
+    broker_loc = "inproc://speed-trace"
+    broker = bus.get_broker(broker_loc)
+    layer = SpeedLayer(make_config(broker_loc))
+    layer.init_topics()
+    tracing.reset()
+    tracing.configure(sample_rate=1.0)
+    try:
+        with broker.producer("OryxUpdate") as p:
+            p.send("MODEL", json.dumps({"a": 1, "b": 1}))
+        layer.start()
+        assert wait_until(lambda: layer.manager._counts.get("a") == 1)
+
+        ctx = TraceContext("ab" * 16, "cd" * 8, True)
+        origin_ms = int(time.time() * 1000) - 3000  # ingested 3s ago
+        records, extra = tracing.with_header([(None, "a c")], ctx, origin_ms)
+        assert extra == 1
+        with broker.producer("OryxInput") as p:
+            p.send_many(records)
+        tail = broker.consumer("OryxUpdate")  # latest: skip the seeded model
+        fresh = metrics.registry.histogram("speed.freshness.seconds")
+        fresh0 = fresh.count
+        sent = layer.run_one_batch()
+        assert sent == 2  # the header never counts toward caller-visible sends
+
+        # the UP batch re-stamps trace + ORIGINAL origin onto the update topic
+        block = tail.poll_block(max_records=10, timeout=2.0)
+        assert len(block) == 2
+        info = tracing.parse_header(block.trace)
+        assert info is not None and info.ingest_ms == origin_ms
+        assert info.ctx is not None and info.ctx.trace_id == ctx.trace_id
+
+        names = {s["name"] for s in tracing.spans(ctx.trace_id)}
+        assert {"speed.parse", "speed.fold", "speed.publish", "speed.batch"} <= names
+        (batch_span,) = [
+            s for s in tracing.spans(ctx.trace_id) if s["name"] == "speed.batch"
+        ]
+        assert batch_span["parent"] == ctx.span_id  # continued, not re-rooted
+        assert batch_span["attrs"] == {"events": 1, "updates": 2}
+
+        # freshness observed against the carried origin, not receipt time
+        assert fresh.count > fresh0
+        assert fresh.snapshot()["max"] >= 2.0
+    finally:
+        tracing.reset()
         layer.close()

@@ -103,7 +103,11 @@ def test_pipeline_end_to_end_example_manager():
     with broker.producer("OryxInput") as p:
         p.send(None, "a c")
     assert wait_until(lambda: layer.batch_count >= 1)
-    ups = tail.poll(timeout=2.0)
+    from oryx_tpu.common import tracing
+
+    # skip the `@trc` trace/freshness control record (stripped by block
+    # consumers; a raw poll sees it)
+    ups = [m for m in tail.poll(timeout=2.0) if m.key != tracing.TRACE_KEY]
     assert sorted(m.message for m in ups) == ["a,1", "c,1"]
     assert all(m.key == "UP" for m in ups)
     # offsets were committed for the consumer group AFTER the publish

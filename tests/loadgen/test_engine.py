@@ -31,6 +31,7 @@ class ControlServer:
         self.status = 200
         self.ready = True
         self.hits = 0
+        self.traceparents: list[str | None] = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -44,6 +45,7 @@ class ControlServer:
                     self.wfile.write(b"{}")
                     return
                 outer.hits += 1
+                outer.traceparents.append(self.headers.get("traceparent"))
                 if outer.latency_s:
                     time.sleep(outer.latency_s)
                 self.send_response(outer.status)
@@ -169,3 +171,35 @@ def test_no_ready_replica_is_a_recorded_failure(server):
 def test_engine_requires_targets():
     with pytest.raises(ValueError):
         OpenLoopEngine([])
+
+
+def test_traced_requests_send_traceparent_and_record_client_spans(server):
+    """At sample rate 1.0 every request carries a traceparent header, a
+    client.request root span lands in the ring, and RequestRecord.trace_id
+    exposes the id so operators can pull the server-side breakdown from
+    GET /trace on the replica that answered."""
+    from oryx_tpu.common import tracing
+
+    tracing.reset()
+    tracing.configure(sample_rate=1.0)
+    try:
+        engine = OpenLoopEngine(
+            [Target("t0", server.base)], template="/r/u%d", readiness_poll_s=0.05
+        )
+        result = _run(engine, rate=40.0, seconds=0.6)
+        assert result.ok > 0
+        traced = [r for r in result.records if r.trace_id]
+        assert len(traced) == len(result.records)  # rate 1.0: all sampled
+        roots = {
+            s["trace"]: s for s in tracing.spans() if s["name"] == "client.request"
+        }
+        for r in traced:
+            assert r.trace_id in roots
+            assert roots[r.trace_id]["parent"] is None  # client is the root
+        sent = [h for h in server.traceparents if h]
+        assert sent, "no traceparent header reached the server"
+        assert {tracing.parse_traceparent(h).trace_id for h in sent} == {
+            r.trace_id for r in traced
+        }
+    finally:
+        tracing.reset()

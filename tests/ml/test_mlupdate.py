@@ -6,7 +6,7 @@ from pathlib import Path
 
 from oryx_tpu import bus
 from oryx_tpu.app import pmml as app_pmml
-from oryx_tpu.common import config as C, pmml as pmml_io
+from oryx_tpu.common import config as C, pmml as pmml_io, tracing
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.ml.update import MLUpdate
 
@@ -82,7 +82,9 @@ def test_split_build_promote_publish(tmp_path):
     assert pmml_io.find(promoted, "Extension").get("value") == "3"
 
     # MODEL published inline
-    msgs = tail.poll(timeout=1.0)
+    # the publish rides with a `@trc` trace/freshness control record that
+    # block consumers strip; a raw poll sees it and must skip it
+    msgs = [m for m in tail.poll(timeout=1.0) if m.key != tracing.TRACE_KEY]
     assert [m.key for m in msgs] == ["MODEL"]
     assert 'value="3"' in msgs[0].message
 
@@ -95,7 +97,7 @@ def test_model_ref_when_too_large(tmp_path):
     tail = broker.consumer("OryxUpdate", from_beginning=True)
     with broker.producer("OryxUpdate") as producer:
         update.run_update(777, data(20), [], str(tmp_path / "model"), producer)
-    msgs = tail.poll(timeout=1.0)
+    msgs = [m for m in tail.poll(timeout=1.0) if m.key != tracing.TRACE_KEY]
     assert [m.key for m in msgs] == ["MODEL-REF"]
     # the ref is the registry-resolvable *generation dir*, not a bare
     # file path: model.pmml and manifest.json live under it

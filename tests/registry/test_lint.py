@@ -13,6 +13,7 @@ sys.path.insert(0, str(REPO_ROOT / "tools"))
 
 import lint_config  # noqa: E402
 import lint_deploy  # noqa: E402
+import lint_metrics  # noqa: E402
 import lint_registry  # noqa: E402
 
 
@@ -110,6 +111,41 @@ def test_deploy_lint_accepts_real_manifest_shapes(tmp_path):
     )
     rc, problems, _ = lint_deploy.run_lint([good])
     assert rc == 0, "\n".join(problems)
+
+
+def test_metrics_catalog_lints_clean():
+    rc, problems, engine = lint_metrics.run_lint()
+    assert rc == 0, f"[{engine}] " + "\n".join(problems)
+
+
+def test_metrics_lint_collects_known_names():
+    """The collector regexes must actually see the code's registration
+    sites — an empty collection would make the both-direction check
+    vacuous."""
+    metrics, spans = lint_metrics.code_names()
+    assert "serving.freshness.seconds" in metrics
+    assert "speed.freshness.seconds" in metrics
+    assert "bus.shm.crc-resyncs" in metrics
+    assert "serving.scan" in spans
+    assert "speed.publish" in spans
+    doc_metrics, doc_spans, doc_knobs = lint_metrics.doc_names()
+    assert "serving.apply" in doc_spans  # name built conditionally in code
+    assert "serving.model.apply" in doc_spans
+    assert "oryx.tracing.sample-rate" in doc_knobs
+
+
+def test_metrics_lint_rejects_uncataloged_name(monkeypatch):
+    orig = lint_metrics.code_names
+
+    def with_phantom():
+        metrics, spans = orig()
+        metrics["phantom.metric.nobody-documented"] = lint_metrics.DOC
+        return metrics, spans
+
+    monkeypatch.setattr(lint_metrics, "code_names", with_phantom)
+    rc, problems, _ = lint_metrics.run_lint()
+    assert rc == 1
+    assert any("phantom.metric.nobody-documented" in p for p in problems)
 
 
 def test_fallback_catches_real_problems(tmp_path):

@@ -235,3 +235,55 @@ def test_drain_aware_shutdown(tmp_path):
 
         layer.close(drain_seconds=2.0)  # full drain-then-stop path
         fleet.replicas = []  # already closed; stop() must not double-close
+
+
+def test_model_publish_to_apply_spans_across_fleet(tmp_path, monkeypatch):
+    """The publish->apply half of the tracing story at fleet scale: one
+    traced publish fans out through the chaos-wrapped update topic and
+    every replica records a serving.model.apply span in the SAME trace,
+    with a non-negative propagation skew and the freshness histogram fed
+    once per replica."""
+    from oryx_tpu.common import metrics, tracing
+
+    monkeypatch.setenv("ORYX_TRACING_SAMPLE_RATE", "1.0")
+    tracing.reset()
+    try:
+        fresh0 = metrics.registry.histogram("serving.freshness.seconds").count
+        with FleetHarness(3, str(tmp_path), bus_name="fleet-trace") as fleet:
+            gen = fleet.publish(metric=0.90)
+            assert fleet.wait_converged(gen, timeout=15.0)
+
+            (pub,) = [
+                s for s in tracing.spans() if s["name"] == "batch.publish-model"
+            ]
+            assert pub["parent"] is None  # the publish is the trace root
+            trace_id = pub["trace"]
+
+            want = {layer.port for layer in fleet.replicas}
+
+            def applied():
+                return {
+                    s["attrs"]["instance"]
+                    for s in tracing.spans(trace_id)
+                    if s["name"] == "serving.model.apply"
+                }
+
+            deadline = time.monotonic() + 10.0
+            while applied() != want and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert applied() == want, "not every replica recorded an apply span"
+
+            applies = [
+                s
+                for s in tracing.spans(trace_id)
+                if s["name"] == "serving.model.apply"
+            ]
+            for s in applies:
+                assert s["parent"] == pub["span"]  # joined, not re-rooted
+                assert s["attrs"]["skew_ms"] >= 0
+                assert s["attrs"]["generation"] == gen
+            # one freshness observation per replica landed globally
+            fresh = metrics.registry.histogram("serving.freshness.seconds")
+            assert fresh.count >= fresh0 + 3
+    finally:
+        tracing.reset()

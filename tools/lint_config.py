@@ -15,6 +15,7 @@ Linted prefixes:
   oryx.serving.scan.ann   — ANN tier of the serving scan
   oryx.bus.shm            — shared-memory ring transport
   oryx.speed.pipeline     — three-stage speed-layer pipeline
+  oryx.tracing            — distributed tracer (common/tracing.py)
 
 Usage: python tools/lint_config.py [path ...]   (default: repo sources)
 Exit code 0 = clean.
@@ -32,6 +33,7 @@ LINTED_PREFIXES = (
     ANN_PREFIX,
     "oryx.bus.shm",
     "oryx.speed.pipeline",
+    "oryx.tracing",
 )
 DEFAULT_TARGETS = [
     REPO_ROOT / "oryx_tpu",
