@@ -1047,6 +1047,179 @@ def bench_tracing_overhead() -> None:
         raise RuntimeError("tracing overhead above envelope: " + "; ".join(failures))
 
 
+def bench_lock_watchdog_overhead() -> None:
+    """OrderedLock watchdog cost acceptance rows (docs/static-analysis.md):
+    the runtime lock-order/timeout instrumentation the chaos, fleet and
+    pipeline suites run under must cost <= 2% on both hot paths. Two
+    comparisons, each >= 3-trial medians instrumented vs plain locks:
+
+    - speed layer backlog events/s — subprocess runs of the real
+      SpeedLayer bench toggled via ORYX_LOCK_WATCHDOG (patched before
+      the broker/layer allocate their locks, like the test fixture);
+    - closed-loop serving qps through the real HTTP path, one layer
+      built under instrument() vs one built with raw locks.
+
+    Trials are INTERLEAVED on/off in alternating order (on-off,
+    off-on, ...): the instrumented hot paths take O(10) lock acquires
+    per drain, so any minutes-apart block comparison measures host
+    drift, not the watchdog — pairing adjacent trials cancels it.
+
+    vs_baseline = instrumented/plain median ratio. A row whose median
+    AND best trial both land below the 0.98 envelope hard-fails; a
+    median-only miss is flagged `noise-suspect`. Strict mode stays on,
+    so an observed lock-order cycle under load also fails the bench."""
+    import threading
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.common import locks
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    envelope = float(os.environ.get("ORYX_BENCH_LOCK_ENVELOPE", 0.98))
+    failures: list[str] = []
+
+    def ratio_row(
+        kind: str, unit: str, on_rates: list, off_rates: list, order: int
+    ) -> None:
+        med_on = statistics.median(on_rates)
+        med_off = max(statistics.median(off_rates), 1e-9)
+        ratio = med_on / med_off
+        best = max(on_rates) / med_off
+        detail = (
+            f"watchdog on {med_on:.0f} vs plain {med_off:.0f} {unit} "
+            f"(medians of {len(on_rates)}/{len(off_rates)} trials), "
+            f"overhead {100 * (1 - ratio):.2f}%, envelope <= "
+            f"{100 * (1 - envelope):.0f}%"
+        )
+        print(f"bench[lock-watchdog {kind}]: {detail}", file=sys.stderr)
+        _emit(
+            f"OrderedLock watchdog overhead, {kind}, instrumented vs plain "
+            f"locks (vs_baseline = on/off ratio, floor {envelope})",
+            med_on,
+            unit,
+            ratio,
+            order=order,
+            detail=detail,
+            off_value=round(med_off, 2),
+            overhead_pct=round(100 * (1 - ratio), 3),
+            noise_suspect=ratio < envelope <= best,
+            spread=[round(float(min(on_rates)), 2), round(float(max(on_rates)), 2)],
+            trials=len(on_rates),
+        )
+        if ratio < envelope and best < envelope:
+            failures.append(f"{kind}: on/off {ratio:.4f} < {envelope}")
+
+    # --- speed backlog: one single-trial subprocess per mode, interleaved ---
+    prefill = int(os.environ.get("ORYX_BENCH_LOCK_PREFILL", 300_000))
+
+    def speed_rate(watchdog_on: bool) -> float:
+        env = dict(os.environ)
+        env["ORYX_LOCK_WATCHDOG"] = "1" if watchdog_on else "0"
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
+                "--trials",
+                "1",
+                "--prefill",
+                str(prefill),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+        )
+        sys.stderr.write(proc.stderr[-800:])
+        line = None
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("{") and '"metric"' in ln:
+                line = ln
+        if proc.returncode != 0 or line is None:
+            raise RuntimeError(
+                f"lock-watchdog speed run (on={watchdog_on}) failed "
+                f"rc={proc.returncode}"
+            )
+        return float(json.loads(line)["value"])
+
+    speed_on: list = []
+    speed_off: list = []
+    for pair in range(_TRIALS):
+        for mode_on in (True, False) if pair % 2 == 0 else (False, True):
+            (speed_on if mode_on else speed_off).append(speed_rate(mode_on))
+    ratio_row("speed backlog fold-in", "events/sec", speed_on, speed_off, order=42)
+
+    # --- serving closed-loop: two live layers (one per lock flavor), --------
+    # --- trials interleaved between them ------------------------------------
+    items = int(os.environ.get("ORYX_BENCH_LOCK_ITEMS", 200_000))
+    users = 10_000
+    seconds = float(os.environ.get("ORYX_BENCH_LOCK_SECONDS", 4.0))
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "BenchLockWatchdog"
+          input-topic.broker = "inproc://benchlock"
+          update-topic.broker = "inproc://benchlock"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+
+    def make_layer() -> tuple:
+        layer = ServingLayer(cfg)
+        layer.start()
+        layer.model_manager.model = build_model(users, items, 50)
+        base = f"http://127.0.0.1:{layer.port}"
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+        return layer, base
+
+    def serving_trial(base: str) -> float:
+        lats: list = []
+        stop = threading.Event()
+        deadline = time.perf_counter() + seconds
+        t1 = time.perf_counter()
+        worker(base, "/recommend/u%d", users, deadline, lats, [], stop)
+        if not lats:
+            raise RuntimeError("lock-watchdog serving: no requests")
+        return len(lats) / (time.perf_counter() - t1)
+
+    plain_layer, plain_base = make_layer()
+    try:
+        locks.instrument(strict=True)
+        try:
+            # built under instrument(): every lock this layer (and its
+            # batcher/server/model) constructs is a tracked OrderedLock
+            inst_layer, inst_base = make_layer()
+            try:
+                srv_on: list = []
+                srv_off: list = []
+                for pair in range(_TRIALS):
+                    for mode_on in (True, False) if pair % 2 == 0 else (False, True):
+                        r = serving_trial(inst_base if mode_on else plain_base)
+                        (srv_on if mode_on else srv_off).append(r)
+                if locks.violations():
+                    raise RuntimeError(
+                        f"lock watchdog violations under load: {locks.violations()}"
+                    )
+            finally:
+                inst_layer.close()
+        finally:
+            locks.deinstrument()
+            locks.reset()
+    finally:
+        plain_layer.close()
+    ratio_row("serving closed-loop", "queries/sec", srv_on, srv_off, order=43)
+
+    if failures:
+        raise RuntimeError("lock watchdog overhead above envelope: " + "; ".join(failures))
+
+
 def bench_serving_closed_loop() -> None:
     """Closed-loop /recommend latency through the REAL serving stack:
     ServingLayer HTTP server + ALS endpoints + request micro-batcher +
@@ -1271,6 +1444,7 @@ BENCHES = [
     ("als-scale", bench_als_scale),
     ("speed", bench_speed),
     ("tracing-overhead", bench_tracing_overhead),
+    ("lock-watchdog", bench_lock_watchdog_overhead),
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),

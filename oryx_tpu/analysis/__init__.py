@@ -1,0 +1,21 @@
+"""oryxlint: the repo's unified static-analysis subsystem.
+
+Run it as ``python -m oryx_tpu.analysis`` (or ``tools/oryxlint.py`` /
+``oryx-tpu lint``). Passes: the lockset race detector, the lock-order
+analyzer (static half of the common/locks.py runtime watchdog), the
+JAX hot-path hygiene pass, and the four migrated repo lints
+(config-keys, registry, deploy, metrics). See docs/static-analysis.md.
+"""
+
+from oryx_tpu.analysis.core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    AnalysisPass,
+    Finding,
+    RunResult,
+    all_passes,
+    load_baseline,
+    main,
+    register,
+    run_passes,
+    write_baseline,
+)

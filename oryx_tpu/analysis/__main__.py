@@ -1,0 +1,8 @@
+"""``python -m oryx_tpu.analysis`` — run oryxlint over the tree."""
+
+import sys
+
+from oryx_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

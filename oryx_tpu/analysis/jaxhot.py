@@ -1,0 +1,297 @@
+"""JAX hot-path hygiene pass: recompile hazards and host syncs in loops.
+
+The PR-4 trainers earned their zero-recompile regression tests by
+caching jitted callables (``functools.lru_cache`` around the builder,
+or a module-level memo dict keyed by mesh/shape signature). This pass
+keeps the tree honest about that idiom:
+
+- ORX301 jit-in-loop: a jitted callable is *constructed* (``jax.jit(
+  ...)`` / ``functools.partial(jax.jit, ...)``) inside a ``for`` /
+  ``while`` body — every iteration retraces and recompiles.
+- ORX302 host-sync-in-loop: inside a loop, ``.block_until_ready()``,
+  ``jax.device_get(...)``, or ``np.asarray(x)`` / ``float(x)`` where
+  ``x`` was produced by a jitted callable in the same function — the
+  loop serializes on device->host transfers (the scan/fold hot-path
+  antipattern). Deliberate host orchestration points (the level-by-
+  level forest grower) are baselined with a justification, not
+  exempted by rule.
+- ORX303 uncached-jit: a jitted callable is constructed inside a
+  function with *no* caching idiom in sight: the enclosing function is
+  not ``lru_cache``-decorated, no module function memoizes its result
+  into a module-level dict, and the result is not stored on ``self``
+  (instance-lifetime cache). Such call sites recompile on every
+  invocation once shapes vary.
+
+Only loops spelled ``for``/``while`` count; comprehensions over small
+static tuples are the repo's unpacking idiom, not hot loops.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from oryx_tpu.analysis.core import AnalysisPass, Finding, Module, register
+
+_SYNC_WRAPPERS = {"asarray", "array", "float"}
+
+
+def _tail_name(expr: ast.AST) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_jit_construction(call: ast.Call) -> bool:
+    """Does this call expression produce a fresh jitted callable?"""
+    fn = call.func
+    name = _tail_name(fn)
+    if name == "jit":
+        return True
+    if name == "partial" and call.args and _tail_name(call.args[0]) == "jit":
+        return True
+    if isinstance(fn, ast.Call) and _is_jit_construction(fn):
+        return True  # functools.partial(jax.jit, ...)(impl)
+    return False
+
+
+def _module_jitted_names(tree: ast.Module) -> set:
+    """Module-level names that are jitted callables."""
+    out = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _tail_name(dec) == "jit" or (
+                    isinstance(dec, ast.Call) and _is_jit_construction(dec)
+                ):
+                    out.add(node.name)
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call) and _is_jit_construction(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+    return out
+
+
+def _module_memo_dicts(tree: ast.Module) -> set:
+    out = set()
+    for node in tree.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            if isinstance(node.value, (ast.Dict,)) or (
+                isinstance(node.value, ast.Call) and _tail_name(node.value.func) == "dict"
+            ):
+                out.add(node.target.id)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and (
+                isinstance(node.value, ast.Dict)
+                or (isinstance(node.value, ast.Call) and _tail_name(node.value.func) == "dict")
+            ):
+                out.add(tgt.id)
+    return out
+
+
+def _cached_functions(tree: ast.Module) -> set:
+    """Functions whose jit constructions are amortized: lru_cache-
+    decorated, or memoized into a module dict by some caller."""
+    cached = set()
+    memo_dicts = _module_memo_dicts(tree)
+    fns = [
+        n for n in tree.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in fns:
+        for dec in fn.decorator_list:
+            base = dec.func if isinstance(dec, ast.Call) else dec
+            if _tail_name(base) in ("lru_cache", "cache"):
+                cached.add(fn.name)
+    for fn in fns:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Call)
+                and _tail_name(node.value.func) is not None
+            ):
+                continue
+            into_memo = any(
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in memo_dicts
+                for t in node.targets
+            )
+            if into_memo:
+                cached.add(_tail_name(node.value.func))
+    return cached
+
+
+def _loop_nodes(fn: ast.AST) -> set:
+    """ids of every node lexically inside a for/while body of fn."""
+    inside = set()
+
+    def mark(node):
+        for child in ast.walk(node):
+            inside.add(id(child))
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for stmt in node.body + node.orelse:
+                mark(stmt)
+    return inside
+
+
+def _tainted_names(fn: ast.AST, jitted: set) -> set:
+    """Local names bound from a call to a jitted callable (device
+    values), including tuple-unpack targets; locally-constructed jitted
+    callables taint what they return too."""
+    local_jits = set(jitted)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_construction(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_jits.add(t.id)
+    tainted = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        callee = _tail_name(node.value.func)
+        if callee not in local_jits:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                tainted.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for elt in t.elts:
+                    if isinstance(elt, ast.Name):
+                        tainted.add(elt.id)
+    return tainted
+
+
+def _assigned_to_self(fn: ast.AST) -> set:
+    """ids of Call nodes whose result lands on a self attribute."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if any(
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                for t in node.targets
+            ):
+                out.add(id(node.value))
+    return out
+
+
+@register
+class JaxHotPathPass(AnalysisPass):
+    pass_id = "jaxhot"
+    description = (
+        "JAX hot-path hygiene: jit construction in loops / uncached jit "
+        "(recompile hazards), host syncs inside scan/fold loops "
+        "(ORX301/302/303)"
+    )
+
+    def run(self, modules: list[Module], targets: list[Path]) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in modules:
+            if mod.tree is None or "jax" not in mod.text:
+                continue
+            findings.extend(self._check_module(mod))
+        return findings
+
+    def _check_module(self, mod: Module) -> list[Finding]:
+        tree = mod.tree
+        jitted = _module_jitted_names(tree)
+        cached_fns = _cached_functions(tree)
+        findings: list[Finding] = []
+
+        def check_fn(fn, qualname, in_cached):
+            loops = _loop_nodes(fn)
+            tainted = _tainted_names(fn, jitted)
+            self_cached = _assigned_to_self(fn)
+            # the function's own decorators (@functools.partial(jax.jit,
+            # ...)) define a module-level jitted callable — jit's own
+            # trace cache covers it, that's the idiom not the hazard
+            own_decorators = {
+                id(sub)
+                for dec in fn.decorator_list
+                for sub in ast.walk(dec)
+            }
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in own_decorators:
+                    continue
+                in_loop = id(node) in loops
+                if _is_jit_construction(node):
+                    if in_loop:
+                        findings.append(
+                            Finding(
+                                "jaxhot",
+                                "ORX301",
+                                mod.path,
+                                node.lineno,
+                                qualname,
+                                f"jitted callable constructed inside a loop "
+                                f"in {qualname}(): recompiles every iteration",
+                            )
+                        )
+                    elif not in_cached and id(node) not in self_cached:
+                        findings.append(
+                            Finding(
+                                "jaxhot",
+                                "ORX303",
+                                mod.path,
+                                node.lineno,
+                                qualname,
+                                f"jax.jit result in {qualname}() is not "
+                                f"cached (no lru_cache, module memo, or "
+                                f"self attribute): recompiles per call",
+                            )
+                        )
+                    continue
+                if not in_loop:
+                    continue
+                callee = _tail_name(node.func)
+                if callee == "block_until_ready" or callee == "device_get":
+                    findings.append(
+                        Finding(
+                            "jaxhot",
+                            "ORX302",
+                            mod.path,
+                            node.lineno,
+                            f"{qualname}:{callee}",
+                            f"host sync {callee}() inside a loop in "
+                            f"{qualname}(): serializes the device pipeline",
+                        )
+                    )
+                elif (
+                    callee in _SYNC_WRAPPERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in tainted
+                ):
+                    findings.append(
+                        Finding(
+                            "jaxhot",
+                            "ORX302",
+                            mod.path,
+                            node.lineno,
+                            f"{qualname}:{node.args[0].id}",
+                            f"{callee}({node.args[0].id}) inside a loop in "
+                            f"{qualname}() forces a device->host sync per "
+                            f"iteration ({node.args[0].id} comes from a "
+                            f"jitted call)",
+                        )
+                    )
+
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                check_fn(node, node.name, node.name in cached_fns)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        check_fn(
+                            sub, f"{node.name}.{sub.name}", sub.name in cached_fns
+                        )
+        return findings

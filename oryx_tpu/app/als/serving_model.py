@@ -432,7 +432,12 @@ class ALSServingModel(ServingModel):
                 self._x_dirty = bool(self._x_dirty_ids)
                 self._x_built_at = time.monotonic()
         finally:
-            self._x_building = False
+            # under the cache lock: _user_scan_row reads this flag under
+            # the lock to decide whether a scatter is safe, and a
+            # lock-free flip can let a scatter land mid-swap
+            # (oryxlint lockset ORX101 caught the bare write)
+            with self._cache_lock:
+                self._x_building = False
 
     def _user_scan_row(self, user: str):
         """(x_matrix, row) for index submit, or (None, None) when the

@@ -365,7 +365,14 @@ class _NetConsumer(TopicConsumer):
             consumer=self,
         )
         if "positions" in resp:
-            self._last_positions = {int(k): int(v) for k, v in resp["positions"].items()}
+            # _invoke released the channel lock on return; retake it for
+            # the cache write — the broker's reopen path reads
+            # _last_positions to replay the seek (audit alongside the
+            # baselined lockset ORX103 on _cid)
+            with self._lock:
+                self._last_positions = {
+                    int(k): int(v) for k, v in resp["positions"].items()
+                }
         if not blob:
             return None
         return lines_to_block(blob.split(b"\n")[:-1], RecordBlock)
@@ -375,7 +382,8 @@ class _NetConsumer(TopicConsumer):
             lambda: {"op": "positions", "cid": self._cid}, consumer=self
         )
         pos = {int(k): int(v) for k, v in resp["positions"].items()}
-        self._last_positions = dict(pos)
+        with self._lock:
+            self._last_positions = dict(pos)
         return pos
 
     def seek(self, positions: dict[int, int]) -> None:
@@ -387,27 +395,30 @@ class _NetConsumer(TopicConsumer):
             },
             consumer=self,
         )
-        merged = dict(self._last_positions or {})
-        merged.update({int(k): int(v) for k, v in positions.items()})
-        self._last_positions = merged
+        with self._lock:
+            merged = dict(self._last_positions or {})
+            merged.update({int(k): int(v) for k, v in positions.items()})
+            self._last_positions = merged
 
     def commit(self) -> None:
         self._broker._invoke(lambda: {"op": "commit", "cid": self._cid}, consumer=self)
 
     def close(self) -> None:
-        if not self._closed:
+        with self._lock:  # check-then-set must be one atomic step
+            if self._closed:
+                return
             self._closed = True
-            with self._lock:
-                try:
-                    # best-effort, no reconnect dance just to close
-                    if self._conn.connected:
-                        self._conn.call({"op": "consumer_close", "cid": self._cid})
-                except (RuntimeError, ConnectionError, OSError):
-                    pass
-                self._conn.close()
+            try:
+                # best-effort, no reconnect dance just to close
+                if self._conn.connected:
+                    self._conn.call({"op": "consumer_close", "cid": self._cid})
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+            self._conn.close()
 
     def closed(self) -> bool:
-        return self._closed
+        with self._lock:
+            return self._closed
 
 
 class NetBroker(Broker):

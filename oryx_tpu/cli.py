@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
-    "bus-input", "config", "health", "models", "trace",
+    "bus-input", "config", "health", "models", "trace", "lint",
 )
 
 MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
@@ -312,6 +312,28 @@ def run_health(cfg: Config, out=None) -> int:
     return 0 if ok else 1
 
 
+def run_lint(cfg: Config, out=None) -> int:
+    """Run the unified static-analysis suite (docs/static-analysis.md)
+    over the default targets with the checked-in baseline — the same
+    gate tier-1 runs, as an operator command next to ``health``. Exit 0
+    only when the tree is clean."""
+    from oryx_tpu.analysis import run_passes
+
+    out = out or sys.stdout
+    res = run_passes()
+    for f in res.findings:
+        print(f.render(), file=out)
+    for key in sorted(res.stale_baseline):
+        print(f"note: stale baseline entry (no longer fires): {key}", file=out)
+    verdict = (
+        "clean"
+        if res.rc == 0
+        else f"{len(res.findings)} finding(s)"
+    )
+    print(f"oryxlint: {verdict} ({len(res.suppressed)} baselined)", file=out)
+    return res.rc
+
+
 def run_models(cfg: Config, subcommand: str | None, generation: str | None, out=None) -> int:
     """Registry operator surface (docs/model-registry.md):
 
@@ -481,6 +503,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_models(cfg, args.subcommand, args.generation)
     elif args.command == "trace":
         return run_trace(cfg, args.subcommand)
+    elif args.command == "lint":
+        return run_lint(cfg)
     return 0
 
 

@@ -339,6 +339,9 @@ class SupervisedThread:
         self._metrics_prefix = metrics_prefix or f"supervised.{name}"
         self._on_failure = on_failure
         self._min_uptime_sec = min_uptime_sec
+        # guards _gave_up/restarts: written on the supervisor thread,
+        # read by health probes on request threads (oryxlint ORX102)
+        self._state_lock = threading.Lock()
         self._gave_up = False
         self.restarts = 0
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
@@ -357,11 +360,13 @@ class SupervisedThread:
 
     @property
     def healthy(self) -> bool:
-        return not self._gave_up
+        with self._state_lock:
+            return not self._gave_up
 
     @property
     def gave_up(self) -> bool:
-        return self._gave_up
+        with self._state_lock:
+            return self._gave_up
 
     # -- supervisor loop -----------------------------------------------------
 
@@ -388,10 +393,12 @@ class SupervisedThread:
                 if not self._loop and time.monotonic() - started >= self._min_uptime_sec:
                     failures = 0
                 failures += 1
-                self.restarts += 1
+                with self._state_lock:
+                    self.restarts += 1
                 delay = self._policy.backoff_or_none(failures)
                 if delay is None:
-                    self._gave_up = True
+                    with self._state_lock:
+                        self._gave_up = True
                     metrics.registry.counter(f"{self._metrics_prefix}.giveups").inc()
                     metrics.registry.gauge(f"{self._metrics_prefix}.healthy").set(0)
                     log.error(

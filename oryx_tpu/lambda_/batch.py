@@ -20,6 +20,7 @@ disjoint, matching the reference's foreachRDD registration order
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 from oryx_tpu.bus.core import KeyMessage
@@ -48,6 +49,11 @@ class BatchLayer(AbstractLayer):
             config.get_optional_int("oryx.batch.storage.max-age-model-hours") or -1
         )
         self._update = load_instance_of(self.update_class, config)
+        # guards _consumer/_generation_count: the supervised generation
+        # thread lazily attaches the consumer and bumps the counter while
+        # close()/generation_count read them from the caller's thread
+        # (oryxlint lockset ORX102)
+        self._state_lock = threading.Lock()
         self._consumer = None
         self._thread = None
         self._generation_count = 0
@@ -60,8 +66,9 @@ class BatchLayer(AbstractLayer):
         driving generations explicitly (tests, one-shot CLI runs)."""
         self.init_topics()
         self.maybe_start_ui()
-        if self._consumer is None:
-            self._consumer = self.make_input_consumer()
+        with self._state_lock:
+            if self._consumer is None:
+                self._consumer = self.make_input_consumer()
 
     def start(self) -> None:
         self.prepare()
@@ -75,13 +82,16 @@ class BatchLayer(AbstractLayer):
 
     def close(self) -> None:
         super().close()
-        if self._consumer is not None:
-            self._consumer.close()
+        with self._state_lock:
+            consumer = self._consumer
+        if consumer is not None:
+            consumer.close()
         self.join_or_report_leak(self._thread)
 
     @property
     def generation_count(self) -> int:
-        return self._generation_count
+        with self._state_lock:
+            return self._generation_count
 
     # -- generation loop ----------------------------------------------------
 
@@ -106,8 +116,10 @@ class BatchLayer(AbstractLayer):
         metrics.registry.counter("batch.generations").inc()
 
     def _run_one_generation(self, timestamp_ms: int | None = None) -> None:
-        if self._consumer is None:
-            self._consumer = self.make_input_consumer()
+        with self._state_lock:
+            if self._consumer is None:
+                self._consumer = self.make_input_consumer()
+            consumer = self._consumer
         timestamp_ms = int(time.time() * 1000) if timestamp_ms is None else timestamp_ms
 
         def phase(name):
@@ -119,7 +131,7 @@ class BatchLayer(AbstractLayer):
         new_data: list[KeyMessage] = []
         with phase("drain"):
             while True:
-                batch = self._consumer.poll(max_records=10_000, timeout=0.05)
+                batch = consumer.poll(max_records=10_000, timeout=0.05)
                 if not batch:
                     break
                 new_data.extend(batch)
@@ -150,11 +162,12 @@ class BatchLayer(AbstractLayer):
 
         # 5. commit offsets (UpdateOffsetsFn.java:57-65)
         if self.id:
-            self._consumer.commit()
+            consumer.commit()
 
         # 6. age-based GC
         with phase("gc"):
             data_store.delete_old_data(self.data_dir, self.max_data_age_hours)
             data_store.delete_old_models(self.model_dir, self.max_model_age_hours)
 
-        self._generation_count += 1
+        with self._state_lock:
+            self._generation_count += 1

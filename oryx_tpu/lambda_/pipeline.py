@@ -280,4 +280,4 @@ class SpeedPipeline:
             )
         metrics.registry.counter("speed.events").inc(total)
         metrics.registry.counter("speed.updates").inc(sent)
-        layer._batch_count += 1
+        layer.note_batch_published()

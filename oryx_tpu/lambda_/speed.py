@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 
 from oryx_tpu.common.records import BlockRecords
@@ -79,6 +80,11 @@ class SpeedLayer(AbstractLayer):
             config.get("oryx.speed.pipeline.enabled", None)
         )
         self.manager = load_instance_of(self.model_manager_class, config)
+        # guards _input_consumer/_batch_count: the supervised batch (or
+        # pipeline publish) worker attaches the consumer and bumps the
+        # counter while close()/batch_count read them from the caller's
+        # thread (oryxlint lockset ORX102)
+        self._state_lock = threading.Lock()
         self._input_consumer = None
         self._update_consumer = None
         self._consume_thread = None
@@ -88,13 +94,15 @@ class SpeedLayer(AbstractLayer):
 
     def prepare_input(self) -> None:
         """Attach the input consumer; from this point input is observed."""
-        if self._input_consumer is None:
-            self._input_consumer = self.make_input_consumer()
+        with self._state_lock:
+            if self._input_consumer is None:
+                self._input_consumer = self.make_input_consumer()
 
     def input_consumer(self):
         """The layer's input consumer, attaching it on first use."""
         self.prepare_input()
-        return self._input_consumer
+        with self._state_lock:
+            return self._input_consumer
 
     def start(self) -> None:
         self.init_topics()
@@ -136,7 +144,9 @@ class SpeedLayer(AbstractLayer):
 
     def close(self) -> None:
         super().close()
-        for c in (self._input_consumer, self._update_consumer):
+        with self._state_lock:
+            input_consumer = self._input_consumer
+        for c in (input_consumer, self._update_consumer):
             if c is not None:
                 c.close()
         pipeline_threads = self._pipeline.threads if self._pipeline else []
@@ -147,7 +157,17 @@ class SpeedLayer(AbstractLayer):
 
     @property
     def batch_count(self) -> int:
-        return self._batch_count
+        with self._state_lock:
+            return self._batch_count
+
+    def note_batch_published(self) -> None:
+        """One micro-batch's updates are on the bus. Called by whichever
+        worker owns the publish step — the fold loop here or the
+        pipeline's publish stage — so the counter write stays under the
+        layer's own lock (oryxlint caught the cross-object bare
+        increment in pipeline.py as ORX103 once the attr was guarded)."""
+        with self._state_lock:
+            self._batch_count += 1
 
     # -- internals ----------------------------------------------------------
 
@@ -282,7 +302,7 @@ class SpeedLayer(AbstractLayer):
                                 stop_event=self._stop_event,
                             ) - extra
                 if self.id:
-                    self._input_consumer.commit()
+                    self.input_consumer().commit()
         # the micro-batch's deltas are now servable-visible to any replica
         # that polls: event-ingest -> published, the speed half of the
         # freshness chain (serving closes it with serving.freshness.seconds)
@@ -297,5 +317,5 @@ class SpeedLayer(AbstractLayer):
             )
         metrics.registry.counter("speed.events").inc(total)
         metrics.registry.counter("speed.updates").inc(sent)
-        self._batch_count += 1
+        self.note_batch_published()
         return sent

@@ -236,7 +236,12 @@ class TopNBatcher:
             return None
         batch = [first]
         coalesced = 0
-        while len(batch) < self.max_batch:
+        # snapshot the adaptive ceiling under _flight_cv: the completer
+        # resizes it in _observe_latency while this dispatcher loop reads
+        # it (oryxlint lockset ORX104); one stable cap per batch-take
+        with self._flight_cv:
+            max_batch = self.max_batch
+        while len(batch) < max_batch:
             try:
                 e = self._queue.get_nowait()
             except queue.Empty:

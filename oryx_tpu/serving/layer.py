@@ -151,35 +151,77 @@ class ServingHealth:
 
     def __init__(self, clock=time.time) -> None:
         self._clock = clock
-        self.stream_healthy: bool | None = None
-        self.last_update_time: float | None = None
+        # one lock over every flag: the update-consume thread writes the
+        # stream marks and generation id, the shutdown path flips
+        # draining, and HTTP handler threads read all of them from
+        # /ready, /healthz and /readyz (manual lockset audit riding the
+        # oryxlint PR — the pass can't see this class because its thread
+        # entry lives in ServingLayer)
+        self._mu = threading.Lock()
+        self._stream_healthy: bool | None = None
+        self._last_update_time: float | None = None
         self.consume_thread: SupervisedThread | None = None
-        # drain-aware shutdown: once True, /ready and /readyz answer 503 so
-        # load balancers stop routing here, while in-flight requests (and
-        # any still arriving from stale routing tables) complete normally
-        self.draining: bool = False
-        # generation id of the live model (set by the GenerationTracker as
-        # MODEL/MODEL-REF records flow past); None until one arrives or
-        # when models carry no generation identity
-        self.live_generation: str | None = None
+        self._draining: bool = False
+        self._live_generation: str | None = None
+
+    @property
+    def stream_healthy(self) -> bool | None:
+        with self._mu:
+            return self._stream_healthy
+
+    @property
+    def last_update_time(self) -> float | None:
+        with self._mu:
+            return self._last_update_time
+
+    # drain-aware shutdown: once True, /ready and /readyz answer 503 so
+    # load balancers stop routing here, while in-flight requests (and
+    # any still arriving from stale routing tables) complete normally
+    @property
+    def draining(self) -> bool:
+        with self._mu:
+            return self._draining
+
+    @draining.setter
+    def draining(self, value: bool) -> None:
+        with self._mu:
+            self._draining = bool(value)
+
+    # generation id of the live model (set by the GenerationTracker as
+    # MODEL/MODEL-REF records flow past); None until one arrives or
+    # when models carry no generation identity
+    @property
+    def live_generation(self) -> str | None:
+        with self._mu:
+            return self._live_generation
+
+    @live_generation.setter
+    def live_generation(self, value: str | None) -> None:
+        with self._mu:
+            self._live_generation = value
 
     def mark_stream_ok(self) -> None:
-        self.stream_healthy = True
+        with self._mu:
+            self._stream_healthy = True
         metrics.registry.gauge("serving.update-stream.healthy").set(1)
 
     def mark_stream_down(self) -> None:
-        self.stream_healthy = False
+        with self._mu:
+            self._stream_healthy = False
         metrics.registry.gauge("serving.update-stream.healthy").set(0)
 
     def mark_update(self) -> None:
-        self.last_update_time = self._clock()
+        with self._mu:
+            self._last_update_time = self._clock()
 
     def staleness(self) -> float | None:
         """Seconds since the last model update was applied, or None if no
         update has ever arrived. Also published as a gauge."""
-        if self.last_update_time is None:
+        with self._mu:
+            last = self._last_update_time
+        if last is None:
             return None
-        s = self._clock() - self.last_update_time
+        s = self._clock() - last
         metrics.registry.gauge("serving.model.staleness-seconds").set(s)
         return s
 
@@ -543,6 +585,10 @@ class ServingLayer:
         self.instance_metrics = metrics.MetricsRegistry()
         self._inflight = 0
         self._inflight_cond = threading.Condition()
+        # close() can race between the fleet driver and atexit/signal
+        # paths; the flag flip must be one atomic check-then-set
+        self._close_lock = threading.Lock()
+        self._close_done = False
 
         # model registry over the batch model dir: /model/generations +
         # rollback, and live-generation tracking with duplicate-MODEL
@@ -791,8 +837,10 @@ class ServingLayer:
         return True
 
     def close(self, drain_seconds: float = 0.0) -> None:
-        if getattr(self, "_close_done", False):
-            return
+        with self._close_lock:
+            if self._close_done:
+                return
+            self._close_done = True
         if drain_seconds > 0:
             self.begin_drain()
             if not self.drain(drain_seconds):
@@ -801,7 +849,6 @@ class ServingLayer:
                     self.inflight_requests,
                     drain_seconds,
                 )
-        self._close_done = True
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

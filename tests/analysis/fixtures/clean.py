@@ -1,0 +1,48 @@
+"""A clean fixture: threaded state consistently guarded, locks nested
+in one global order, jit cached module-level. No pass should flag it."""
+
+import functools
+import threading
+
+import jax
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        with self._lock:
+            self._count += 1
+
+    def count(self):
+        with self._lock:
+            return self._count
+
+
+class OneOrder:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+
+    def both(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+    def also_both(self):
+        with self._outer:
+            with self._inner:
+                pass
+
+
+@functools.lru_cache(maxsize=None)
+def compiled(n):
+    return jax.jit(lambda v: v * n)
+
+
+def run(xs):
+    f = compiled(3)
+    return [f(x) for x in xs]

@@ -1,0 +1,32 @@
+"""Seeded JAX hot-path bugs: jit constructed inside a loop (ORX301),
+uncached jit construction (ORX303), and host syncs inside a fold loop
+(ORX302)."""
+
+import jax
+import numpy as np
+
+
+def retrace_per_iteration(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # ORX301: recompiles every pass
+        out.append(f(x))
+    return out
+
+
+def uncached_jit(x):
+    f = jax.jit(lambda v: v + 1)  # ORX303: no memo anywhere
+    return f(x)
+
+
+step = jax.jit(lambda v: v + 1)
+
+
+def fold_with_host_sync(xs):
+    acc = step(xs)
+    total = 0.0
+    for _ in range(8):
+        acc = step(acc)
+        acc.block_until_ready()  # ORX302: per-iteration device sync
+        total += float(np.asarray(acc)[0])  # ORX302: host pull of a jitted value
+    return total

@@ -1,0 +1,20 @@
+"""Seeded lock-order cycle: take_ab nests A then B, take_ba nests B
+then A — the static pass should report an ORX201 cycle."""
+
+import threading
+
+
+class TwoLocks:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def take_ab(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def take_ba(self):
+        with self._lock_b:
+            with self._lock_a:
+                pass

@@ -1,0 +1,75 @@
+"""Seeded lockset bugs: every class here should be flagged. The
+fixtures/ directory is excluded from real scans (core.iter_py_files),
+so these stay out of the tree baseline."""
+
+import threading
+
+
+class MixedGuard:
+    """ORX101: _count is written under the lock in one method and bare
+    in another."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def start(self):
+        t = threading.Thread(target=self._work)
+        t.start()
+
+    def _work(self):
+        with self._lock:
+            self._count += 1
+
+    def bump_unsafely(self):
+        self._count += 1  # naked write, lock exists and guards it elsewhere
+
+
+class NoGuard:
+    """ORX102: _done written from the thread entry, read elsewhere, and
+    the class owns no lock at all."""
+
+    def __init__(self):
+        self._done = False
+        threading.Thread(target=self._run).start()
+
+    def _run(self):
+        self._done = True
+
+    def is_done(self):
+        return self._done
+
+
+class GuardedWriteBareRead:
+    """ORX104: every write is under the lock, but a thread-reachable
+    method reads without it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+        threading.Thread(target=self._loop).start()
+
+    def _loop(self):
+        with self._lock:
+            self._value += 1
+        self._peek()
+
+    def _peek(self):
+        return self._value  # lock-free read on the entry-reachable path
+
+
+_GLOBAL_STATE = 0
+_global_lock = threading.Lock()
+
+
+def guarded_bump():
+    global _GLOBAL_STATE
+    with _global_lock:
+        _GLOBAL_STATE += 1
+
+
+def bare_bump():
+    """ORX105: the same module global written both under and outside the
+    module lock."""
+    global _GLOBAL_STATE
+    _GLOBAL_STATE += 1

@@ -1,0 +1,165 @@
+"""OrderedLock runtime watchdog tests. The headline property: an AB/BA
+deadlock is *detected* — LockOrderViolation raised in the acquiring
+thread before it blocks — so the test fails fast instead of hanging the
+suite. Every test tears instrumentation down in finally; none carries
+the chaos/fleet/pipeline markers, so the conftest autouse watchdog stays
+out of the way."""
+
+import threading
+import time
+
+import pytest
+
+from oryx_tpu.common import locks
+
+
+@pytest.fixture()
+def watchdog():
+    """instrument() for one test, with guaranteed teardown."""
+
+    def arm(**kw):
+        kw.setdefault("strict", True)
+        kw.setdefault("acquire_timeout", 5.0)
+        locks.instrument(**kw)
+
+    yield arm
+    locks.deinstrument()
+    locks.reset()
+    assert threading.Lock is locks._real_lock
+
+
+def test_ab_ba_cycle_detected_without_hanging(watchdog):
+    watchdog()
+    a = threading.Lock()
+    b = threading.Lock()
+    assert isinstance(a, locks.OrderedLock)
+    with a:
+        with b:
+            pass  # establishes the A -> B order
+    t0 = time.monotonic()
+    with pytest.raises(locks.LockOrderViolation):
+        with b:
+            with a:  # reverse order: refused before blocking
+                pass
+    assert time.monotonic() - t0 < 1.0  # detected, not timed out
+    assert any("cycle" in v for v in locks.violations())
+    assert not a.locked() and not b.locked()  # everything released
+
+
+def test_cross_thread_ab_ba_detected(watchdog):
+    watchdog()
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=order_ab)
+    t.start()
+    t.join()
+    with pytest.raises(locks.LockOrderViolation):
+        with b:
+            with a:
+                pass
+
+
+def test_non_strict_records_but_does_not_raise(watchdog):
+    watchdog(strict=False)
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert any("cycle" in v for v in locks.violations())
+    locks.reset()
+    assert locks.violations() == []
+
+
+def test_acquire_timeout_turns_deadlock_into_failure(watchdog):
+    watchdog(acquire_timeout=0.3)
+    lk = threading.Lock()
+    lk.acquire()
+    try:
+        stole = threading.Event()
+
+        def contender():
+            try:
+                lk.acquire()
+            except locks.LockWatchdogTimeout:
+                stole.set()
+
+        t = threading.Thread(target=contender)
+        t.start()
+        t.join(timeout=5)
+        assert stole.is_set()
+        assert any("acquire-timeout" in v for v in locks.violations())
+    finally:
+        lk.release()
+
+
+def test_held_too_long_is_recorded(watchdog):
+    watchdog(hold_warn=0.01)
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.05)
+    assert any("held-too-long" in v for v in locks.violations())
+
+
+def test_condition_round_trip_under_instrumentation(watchdog):
+    watchdog()
+    cv = threading.Condition()  # allocates a patched RLock internally
+    state = []
+
+    def producer():
+        with cv:
+            state.append("ready")
+            cv.notify()
+
+    with cv:
+        t = threading.Thread(target=producer)
+        t.start()
+        assert cv.wait_for(lambda: state, timeout=5)
+    t.join()
+    assert state == ["ready"]
+    assert locks.violations() == []
+
+
+def test_rlock_reentrancy(watchdog):
+    watchdog()
+    rl = threading.RLock()
+    assert isinstance(rl, locks.OrderedRLock)
+    with rl:
+        with rl:  # reentrant: no edges, no violation
+            assert rl._is_owned()
+    assert not rl._is_owned()
+    assert locks.violations() == []
+
+
+def test_non_blocking_acquire_records_no_edges(watchdog):
+    watchdog()
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        assert b.acquire(blocking=False)
+        b.release()
+    with b:
+        with a:  # would be a cycle if try-locks recorded edges
+            pass
+    assert locks.violations() == []
+
+
+def test_deinstrument_restores_plain_locks(watchdog):
+    watchdog()
+    wrapped = threading.Lock()
+    locks.deinstrument()
+    raw = threading.Lock()
+    assert not isinstance(raw, locks.OrderedLock)
+    # surviving wrappers degrade to passthrough delegation
+    with wrapped:
+        assert wrapped.locked()
+    assert locks.violations() == []

@@ -1,0 +1,159 @@
+"""Seeded-bug coverage for the oryxlint passes: every fixture bug must
+be flagged by the intended pass with the intended code, the clean
+fixture must stay quiet, and the baseline must round-trip (suppress
+exactly what it lists, report what went stale).
+
+Fixtures live in tests/analysis/fixtures/, which iter_py_files skips on
+real scans — each test copies the file it needs into tmp_path so the
+full runner path (parse -> passes -> baseline) is exercised."""
+
+import shutil
+from pathlib import Path
+
+from oryx_tpu.analysis import load_baseline, run_passes, write_baseline
+from oryx_tpu.analysis.core import iter_py_files
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _scan(tmp_path, name, select=None):
+    dst = tmp_path / name
+    shutil.copyfile(FIXTURES / name, dst)
+    res = run_passes([dst], select=select, baseline=None)
+    return res.findings
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+def test_fixtures_dir_is_never_scanned():
+    assert iter_py_files([FIXTURES]) == []
+    assert iter_py_files([FIXTURES / "races.py"]) == []
+
+
+# -- lockset -------------------------------------------------------------------
+
+
+def test_lockset_flags_mixed_guard_write(tmp_path):
+    found = _scan(tmp_path, "races.py", select={"lockset"})
+    by_code = {f.code: f for f in found}
+    assert "ORX101" in by_code and "_count" in by_code["ORX101"].symbol
+    assert "ORX102" in by_code and "_done" in by_code["ORX102"].symbol
+    assert "ORX104" in by_code and "_value" in by_code["ORX104"].symbol
+    assert "ORX105" in by_code and "_GLOBAL_STATE" in by_code["ORX105"].symbol
+
+
+# -- lockorder -----------------------------------------------------------------
+
+
+def test_lockorder_flags_ab_ba_cycle(tmp_path):
+    found = _scan(tmp_path, "lockcycle.py", select={"lockorder"})
+    assert _codes(found) == {"ORX201"}
+    assert any("_lock_a" in f.symbol and "_lock_b" in f.symbol for f in found)
+
+
+# -- jaxhot --------------------------------------------------------------------
+
+
+def test_jaxhot_flags_recompile_and_host_sync(tmp_path):
+    found = _scan(tmp_path, "jaxbad.py", select={"jaxhot"})
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f)
+    assert "ORX301" in by_code  # jit constructed in a loop
+    assert "ORX303" in by_code  # uncached jit construction
+    syncs = by_code.get("ORX302", [])
+    # both flavors: an explicit block_until_ready and a tainted asarray
+    assert any("block_until_ready" in f.symbol for f in syncs)
+    assert any(f.symbol.endswith(":acc") for f in syncs)
+
+
+# -- clean fixture -------------------------------------------------------------
+
+
+def test_clean_fixture_is_quiet(tmp_path):
+    found = _scan(tmp_path, "clean.py", select={"lockset", "lockorder", "jaxhot"})
+    assert found == []
+
+
+# -- baseline round-trip -------------------------------------------------------
+
+
+def test_baseline_round_trip(tmp_path):
+    dst = tmp_path / "races.py"
+    shutil.copyfile(FIXTURES / "races.py", dst)
+    first = run_passes([dst], select={"lockset"}, baseline=None)
+    assert first.findings
+
+    bl = tmp_path / "baseline.txt"
+    write_baseline(bl, first.findings)
+    keys = load_baseline(bl)
+    assert keys == {f.key() for f in first.findings}
+
+    second = run_passes([dst], select={"lockset"}, baseline=bl)
+    assert second.findings == []
+    assert len(second.suppressed) == len(first.findings)
+    assert second.rc == 0
+
+    # a stale entry (bug got fixed, baseline not pruned) is reported
+    bl.write_text(
+        bl.read_text() + "lockset:gone.py:ORX102:Ghost._attr  # fixed\n",
+        encoding="utf-8",
+    )
+    third = run_passes([dst], select={"lockset"}, baseline=bl)
+    assert third.stale_baseline == {"lockset:gone.py:ORX102:Ghost._attr"}
+
+
+def test_stale_is_scoped_to_what_the_run_judged(tmp_path):
+    dst = tmp_path / "races.py"
+    shutil.copyfile(FIXTURES / "races.py", dst)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        # out of scope two ways: a pass that won't run, and a file that
+        # exists in the repo but isn't among the scanned targets
+        "jaxhot:oryx_tpu/ops/als.py:ORX303:_train_als_sharded  # kept\n"
+        "lockset:oryx_tpu/bus/netbus.py:ORX103:_NetConsumer._cid  # kept\n",
+        encoding="utf-8",
+    )
+    res = run_passes([dst], select={"lockset"}, baseline=bl)
+    assert res.stale_baseline == set()
+
+
+def test_update_baseline_merges_instead_of_clobbering(tmp_path, capsys):
+    from oryx_tpu.analysis import main
+
+    dst = tmp_path / "races.py"
+    shutil.copyfile(FIXTURES / "races.py", dst)
+    bl = tmp_path / "baseline.txt"
+    kept = "jaxhot:oryx_tpu/ops/als.py:ORX303:_train_als_sharded  # why: by design\n"
+    bl.write_text(kept, encoding="utf-8")
+
+    rc = main(
+        ["--select", "lockset", "--baseline", str(bl), "--update-baseline", str(dst)]
+    )
+    assert rc == 0
+    text = bl.read_text(encoding="utf-8")
+    # the out-of-scope entry survives, justification comment intact
+    assert kept.strip() in text
+    # the scoped run's findings landed as fresh keys
+    assert any(":ORX101:" in ln for ln in text.splitlines())
+    # and the merged file now suppresses the scoped findings
+    again = run_passes([dst], select={"lockset"}, baseline=bl)
+    assert again.findings == [] and again.rc == 0
+
+
+def test_select_and_ignore_scope_passes(tmp_path):
+    dst = tmp_path / "jaxbad.py"
+    shutil.copyfile(FIXTURES / "jaxbad.py", dst)
+    only = run_passes([dst], select={"lockset"}, baseline=None)
+    assert only.findings == []  # jax bugs invisible to the lockset pass
+    skipped = run_passes([dst], ignore={"jaxhot"}, baseline=None)
+    assert all(f.pass_id != "jaxhot" for f in skipped.findings)
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def oops(:\n", encoding="utf-8")
+    res = run_passes([bad], baseline=None)
+    assert [f.code for f in res.findings] == ["ORX000"]

@@ -1,0 +1,29 @@
+"""The tier-1 oryxlint gate: the whole tree (oryx_tpu/ + tools/) must be
+clean under every registered pass modulo the checked-in baseline, and
+the baseline itself must not have gone stale. One test replaces the four
+per-lint hooks that used to live in tests/registry/test_lint.py."""
+
+from oryx_tpu.analysis import all_passes, run_passes
+
+
+def test_all_passes_registered():
+    ids = set(all_passes())
+    assert {
+        "lockset",
+        "lockorder",
+        "jaxhot",
+        "config-keys",
+        "registry",
+        "deploy",
+        "metrics",
+    } <= ids
+
+
+def test_tree_is_clean():
+    res = run_passes()
+    rendered = "\n".join(f.render() for f in res.findings)
+    assert not res.findings, f"oryxlint found new problems:\n{rendered}"
+    assert not res.stale_baseline, (
+        "baseline entries no longer fire — prune oryx_tpu/analysis/"
+        f"baseline.txt: {sorted(res.stale_baseline)}"
+    )

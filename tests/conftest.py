@@ -50,6 +50,32 @@ def tmp_bus(tmp_path):
     return f"file:{tmp_path}/bus"
 
 
+@pytest.fixture(autouse=True)
+def _lock_watchdog(request):
+    """TSan-lite for the concurrency-heavy suites: chaos/fleet/pipeline
+    tests run with threading.Lock/RLock swapped for OrderedLock wrappers
+    (oryx_tpu/common/locks.py). A lock-order cycle raises in the
+    acquiring thread before it blocks, and an over-budget acquire raises
+    instead of hanging CI — so a reintroduced AB/BA deadlock fails the
+    test with a named lock pair. Disable with ORYX_LOCK_WATCHDOG=0."""
+    wanted = {"chaos", "fleet", "pipeline"}
+    if not (wanted & {m.name for m in request.node.iter_markers()}) or (
+        os.environ.get("ORYX_LOCK_WATCHDOG", "1") == "0"
+    ):
+        yield
+        return
+    from oryx_tpu.common import locks
+
+    locks.instrument(strict=True, acquire_timeout=120.0)
+    try:
+        yield
+        found = locks.violations()
+    finally:
+        locks.deinstrument()
+        locks.reset()
+    assert not found, f"lock watchdog violations: {found}"
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -84,4 +110,10 @@ def pytest_configure(config):
         "k-means device init / mini-batch, ALS compiled-run cache + "
         "zero-recompile regression); fast and tier-1-safe, select with "
         "-m trainers",
+    )
+    config.addinivalue_line(
+        "markers",
+        "pipeline: pipelined speed-layer micro-batching tests (parse/fold/"
+        "publish hand-off); runs under the OrderedLock watchdog, select "
+        "with -m pipeline",
     )

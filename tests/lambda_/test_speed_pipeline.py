@@ -15,6 +15,8 @@ from oryx_tpu.common.records import BlockRecords, InteractionBlock
 from oryx_tpu.lambda_.pipeline import HandoffQueue, SpeedPipeline
 from oryx_tpu.lambda_.speed import SpeedLayer
 
+pytestmark = pytest.mark.pipeline
+
 
 def wait_until(pred, timeout=10.0):
     deadline = time.monotonic() + timeout

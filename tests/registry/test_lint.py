@@ -1,5 +1,8 @@
-"""Wires tools/lint_registry into tier-1: the registry subsystem must
-lint clean (ruff when available, stdlib AST fallback otherwise)."""
+"""Behavioral tests for the legacy lint CLIs, now thin shims over
+oryx_tpu/analysis. The tree-wide clean gates moved to a single entry:
+tests/analysis/test_tree_clean.py runs every pass (including these
+four) through the unified runner. What stays here is the per-lint
+behavior — rejection of seeded problems and the shims' public API."""
 
 import sys
 from pathlib import Path
@@ -15,16 +18,6 @@ import lint_config  # noqa: E402
 import lint_deploy  # noqa: E402
 import lint_metrics  # noqa: E402
 import lint_registry  # noqa: E402
-
-
-def test_registry_package_lints_clean():
-    rc, problems, engine = lint_registry.run_lint()
-    assert rc == 0, f"[{engine}] " + "\n".join(problems)
-
-
-def test_ann_config_keys_lint_clean():
-    rc, problems, engine = lint_config.run_lint()
-    assert rc == 0, f"[{engine}] " + "\n".join(problems)
 
 
 def test_ann_config_lint_rejects_unknown_key(tmp_path):
@@ -72,11 +65,6 @@ def test_shm_and_pipeline_config_keys_linted(tmp_path):
     assert "queue-detph" in joined
 
 
-def test_deploy_manifests_lint_clean():
-    rc, problems, engine = lint_deploy.run_lint()
-    assert rc == 0, f"[{engine}] " + "\n".join(problems)
-
-
 def test_deploy_lint_rejects_bad_manifest(tmp_path):
     bad = tmp_path / "bad.yaml"
     # concatenation keeps the typo'd literals out of THIS file's source
@@ -111,11 +99,6 @@ def test_deploy_lint_accepts_real_manifest_shapes(tmp_path):
     )
     rc, problems, _ = lint_deploy.run_lint([good])
     assert rc == 0, "\n".join(problems)
-
-
-def test_metrics_catalog_lints_clean():
-    rc, problems, engine = lint_metrics.run_lint()
-    assert rc == 0, f"[{engine}] " + "\n".join(problems)
 
 
 def test_metrics_lint_collects_known_names():

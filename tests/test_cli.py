@@ -53,6 +53,16 @@ def test_bad_set_errors(tmp_path):
         cli.load_config(conf, ["oryx.no-equals-sign"])
 
 
+def test_lint_command_runs_clean(tmp_path):
+    """`oryx_tpu lint` mirrors `health`: the checked-in tree must pass
+    the full analyzer suite with the committed baseline, exit 0."""
+    cfg = cli.load_config(None, [])
+    out = io.StringIO()
+    rc = cli.run_lint(cfg, out=out)
+    assert rc == 0, out.getvalue()
+    assert "oryxlint: clean" in out.getvalue()
+
+
 def test_bus_setup_creates_topics(tmp_path, capsys):
     conf = _write_conf(tmp_path)
     cfg = cli.load_config(conf, [])

@@ -1,109 +1,26 @@
 #!/usr/bin/env python
-"""Config-key lint for the repo's silent-failure knob blocks, wired
-into tier-1.
-
-A mistyped key under these prefixes fails SILENTLY: the HOCON overlay
-accepts any path, the subsystem only reads the keys it knows, and the
-operator ships with the default behavior still on — the worst kind of
-regression (nothing breaks, everything is just slower or less safe than
-provisioned). Sibling of tools/lint_registry.py: the lint walks the
-repo's Python and conf sources for dotted key references and rejects
-any key that reference.conf's matching block (the single source of
-truth for each knob set) does not declare.
-
-Linted prefixes:
-  oryx.serving.scan.ann   — ANN tier of the serving scan
-  oryx.bus.shm            — shared-memory ring transport
-  oryx.speed.pipeline     — three-stage speed-layer pipeline
-  oryx.tracing            — distributed tracer (common/tracing.py)
-
-Usage: python tools/lint_config.py [path ...]   (default: repo sources)
-Exit code 0 = clean.
+"""Back-compat shim: the config-key lint moved into the unified
+analyzer (oryx_tpu/analysis/configkeys.py, pass id ``config-keys``).
+This file keeps the original import surface and CLI alive for existing
+invocations; run the full suite with ``python -m oryx_tpu.analysis``.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-ANN_PREFIX = "oryx.serving.scan.ann"
-LINTED_PREFIXES = (
+sys.path.insert(0, str(REPO_ROOT))
+
+from oryx_tpu.analysis.configkeys import (  # noqa: E402,F401
     ANN_PREFIX,
-    "oryx.bus.shm",
-    "oryx.speed.pipeline",
-    "oryx.tracing",
+    DEFAULT_TARGETS,
+    LINTED_PREFIXES,
+    known_ann_keys,
+    known_keys,
+    run_lint,
 )
-DEFAULT_TARGETS = [
-    REPO_ROOT / "oryx_tpu",
-    REPO_ROOT / "tools",
-    REPO_ROOT / "tests",
-    REPO_ROOT / "docs",
-]
-
-# dotted reference in code/docs/conf: <prefix>.<key>
-_DOTTED = {
-    prefix: re.compile(
-        re.escape(prefix) + r"\.([A-Za-z0-9][A-Za-z0-9-]*)"
-    )
-    for prefix in LINTED_PREFIXES
-}
-
-
-def known_keys(prefix: str) -> set[str]:
-    """The knob set reference.conf declares under `prefix`."""
-    sys.path.insert(0, str(REPO_ROOT))
-    from oryx_tpu.common import config as C
-
-    block = C.get_default().get_config(prefix)
-    return set(block.as_dict().keys())
-
-
-def known_ann_keys() -> set[str]:
-    """The ANN knob set (kept for the original single-prefix API)."""
-    return known_keys(ANN_PREFIX)
-
-
-def _iter_source_files(paths: list[Path]):
-    for p in paths:
-        if p.is_dir():
-            for ext in ("*.py", "*.conf", "*.md"):
-                yield from sorted(p.rglob(ext))
-        elif p.suffix in (".py", ".conf", ".md"):
-            yield p
-
-
-def _lint_file(path: Path, known: dict[str, set[str]]) -> list[str]:
-    problems: list[str] = []
-    try:
-        text = path.read_text(encoding="utf-8")
-    except OSError as e:  # unreadable file: surface, don't crash the gate
-        return [f"{path}: unreadable: {e}"]
-    for lineno, line in enumerate(text.splitlines(), 1):
-        for prefix, pattern in _DOTTED.items():
-            for m in pattern.finditer(line):
-                key = m.group(1)
-                if key not in known[prefix]:
-                    problems.append(
-                        f"{path}:{lineno}: unknown config key "
-                        f"{prefix}.{key!r} (declared: "
-                        f"{', '.join(sorted(known[prefix]))})"
-                    )
-    return problems
-
-
-def run_lint(paths: list[Path] | None = None) -> tuple[int, list[str], str]:
-    """Returns (exit code, problem lines, engine used) — the same shape
-    as lint_registry.run_lint so the tier-1 tests share one idiom."""
-    paths = paths or DEFAULT_TARGETS
-    known = {prefix: known_keys(prefix) for prefix in LINTED_PREFIXES}
-    problems: list[str] = []
-    for f in _iter_source_files(paths):
-        if f.resolve() == Path(__file__).resolve():
-            continue  # the lint's own docstring/regex isn't a reference
-        problems.extend(_lint_file(f, known))
-    return (1 if problems else 0), problems, "config-keys"
 
 
 def main(argv: list[str]) -> int:

@@ -1,156 +1,36 @@
 #!/usr/bin/env python
-"""Metric/span catalog lint: docs/observability.md vs the code, both
-directions. Wired into tier-1 next to lint_config / lint_deploy.
+"""Back-compat shim: the metric/span catalog lint moved into the
+unified analyzer (oryx_tpu/analysis/metricscatalog.py, pass id
+``metrics``). This file keeps the original import surface and CLI
+alive; run the full suite with ``python -m oryx_tpu.analysis``.
 
-A metric that exists in code but not in the catalog is invisible to
-operators (nobody alerts on a name they don't know exists); a cataloged
-name that no longer exists in code is worse — a dashboard or alert
-silently watching nothing. So:
-
-- every literal name registered through the metrics registry
-  (``registry.counter("...")`` / ``gauge`` / ``histogram``) and every
-  literal span name (``tracing.span("...")`` / ``record_span("...")``)
-  must appear in the catalog;
-- every cataloged name must still appear in the code. Dynamic name
-  families are cataloged with ``<...>`` placeholders; the lint checks
-  that each literal fragment around the placeholders still occurs in
-  the sources.
-- the ``oryx.tracing.*`` knob table must match reference.conf's
-  ``oryx.tracing`` block exactly, both directions.
-
-Usage: python tools/lint_metrics.py      Exit code 0 = clean.
+``run_lint`` routes the code-name collection through THIS module's
+``code_names`` attribute so callers (and tests) that monkeypatch it
+keep working.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-DOC = REPO_ROOT / "docs" / "observability.md"
-SOURCE_ROOT = REPO_ROOT / "oryx_tpu"
+sys.path.insert(0, str(REPO_ROOT))
 
-# literal registration sites; f-strings deliberately don't match (their
-# families are cataloged with <...> placeholders instead)
-_METRIC_CALL = re.compile(r'\.(?:counter|gauge|histogram)\(\s*"([^"]+)"\s*\)')
-_SPAN_CALL = re.compile(r'(?:tracing\.span|record_span)\(\s*\n?\s*"([^"]+)"')
-_DOC_ROW = re.compile(r"^\|\s*`([^`]+)`")
-
-
-def _sources() -> list[tuple[Path, str]]:
-    return [
-        (f, f.read_text(encoding="utf-8"))
-        for f in sorted(SOURCE_ROOT.rglob("*.py"))
-    ]
-
-
-def code_names() -> tuple[dict[str, Path], dict[str, Path]]:
-    """(metric name -> file, span name -> file) from literal call sites."""
-    metrics: dict[str, Path] = {}
-    spans: dict[str, Path] = {}
-    for f, text in _sources():
-        for name in _METRIC_CALL.findall(text):
-            metrics.setdefault(name, f)
-        for name in _SPAN_CALL.findall(text):
-            spans.setdefault(name, f)
-    return metrics, spans
-
-
-def doc_names() -> tuple[set[str], set[str], set[str]]:
-    """(metric, span, oryx.tracing knob) names cataloged in the doc.
-
-    Section-driven: the knob table lives under '## Tracing', the span
-    table under '### Span catalog', metric tables under
-    '## Metric catalog'."""
-    metrics: set[str] = set()
-    spans: set[str] = set()
-    knobs: set[str] = set()
-    mode = None
-    for line in DOC.read_text(encoding="utf-8").splitlines():
-        if line.startswith("#"):
-            if "Span catalog" in line:
-                mode = "spans"
-            elif "Metric catalog" in line:
-                mode = "metrics"
-            elif line.startswith("## Tracing"):
-                mode = "knobs"
-            elif line.startswith("## "):
-                mode = None
-            continue
-        m = _DOC_ROW.match(line)
-        if not m or mode is None:
-            continue
-        name = m.group(1)
-        if name in ("metric", "span", "knob"):  # header rows
-            continue
-        if mode == "spans":
-            spans.add(name)
-        elif mode == "metrics":
-            metrics.add(name)
-        elif mode == "knobs":
-            knobs.add(name)
-    return metrics, spans, knobs
-
-
-def _fragments(pattern: str) -> list[str]:
-    """Literal fragments of a catalog entry around <...> placeholders."""
-    return [frag for frag in re.split(r"<[^>]*>", pattern) if frag]
-
-
-def _exists_in_code(pattern: str, blob: str) -> bool:
-    if "<" in pattern:
-        return all(frag in blob for frag in _fragments(pattern))
-    return f'"{pattern}"' in blob or f"'{pattern}'" in blob
-
-
-def tracing_knob_keys() -> set[str]:
-    """reference.conf's oryx.tracing block (the knob source of truth)."""
-    sys.path.insert(0, str(REPO_ROOT))
-    from oryx_tpu.common import config as C
-
-    return set(C.get_default().get_config("oryx.tracing").as_dict().keys())
+from oryx_tpu.analysis import metricscatalog as _impl  # noqa: E402
+from oryx_tpu.analysis.metricscatalog import (  # noqa: E402,F401
+    DOC,
+    SOURCE_ROOT,
+    code_names,
+    doc_names,
+    tracing_knob_keys,
+)
 
 
 def run_lint() -> tuple[int, list[str], str]:
-    problems: list[str] = []
-    if not DOC.exists():
-        return 1, [f"{DOC}: missing"], "lint_metrics"
-    code_metrics, code_spans = code_names()
-    doc_metrics, doc_spans, doc_knobs = doc_names()
-    blob = "\n".join(text for _, text in _sources())
-
-    for name, f in sorted(code_metrics.items()):
-        if name not in doc_metrics:
-            problems.append(
-                f"{f}: metric {name!r} is not cataloged in {DOC.name}"
-            )
-    for name, f in sorted(code_spans.items()):
-        if name not in doc_spans:
-            problems.append(
-                f"{f}: span {name!r} is not cataloged in {DOC.name}"
-            )
-    for name in sorted(doc_metrics):
-        if not _exists_in_code(name, blob):
-            problems.append(
-                f"{DOC.name}: cataloged metric {name!r} does not appear in "
-                f"the code"
-            )
-    for name in sorted(doc_spans):
-        if not _exists_in_code(name, blob):
-            problems.append(
-                f"{DOC.name}: cataloged span {name!r} does not appear in "
-                f"the code"
-            )
-
-    knobs = {f"oryx.tracing.{k}" for k in tracing_knob_keys()}
-    for knob in sorted(knobs - doc_knobs):
-        problems.append(f"{DOC.name}: tracing knob {knob!r} is not cataloged")
-    for knob in sorted(doc_knobs - knobs):
-        problems.append(
-            f"{DOC.name}: cataloged knob {knob!r} is not in reference.conf"
-        )
-    return (1 if problems else 0), problems, "lint_metrics"
+    # late-bound module-global lookup: monkeypatching this module's
+    # code_names (tests/registry/test_lint.py does) must take effect
+    return _impl.run_lint(code_names_fn=lambda: code_names())
 
 
 def main(argv: list[str] | None = None) -> int:  # noqa: ARG001

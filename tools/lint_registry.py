@@ -1,102 +1,25 @@
 #!/usr/bin/env python
-"""Lint gate for the model-registry subsystem, wired into tier-1.
-
-Runs `ruff check` over oryx_tpu/registry/ when ruff is on PATH; in
-environments without ruff (the CI image bakes no extra tools) it degrades
-to a stdlib AST pass that still catches the high-signal problems a
-subsystem boundary cares about: syntax errors, unused imports, wildcard
-imports, and mutable default arguments. Either way the check is
-milliseconds — tests/registry/test_lint.py invokes `run_lint` in-process
-so the tier-1 pytest run carries it without a separate CI step.
-
-Usage: python tools/lint_registry.py [path ...]   (default: oryx_tpu/registry)
-Exit code 0 = clean.
+"""Back-compat shim: the registry lint moved into the unified analyzer
+(oryx_tpu/analysis/registryhygiene.py, pass id ``registry``). This file
+keeps the original import surface and CLI alive; run the full suite
+with ``python -m oryx_tpu.analysis``.
 """
 
 from __future__ import annotations
 
-import ast
-import shutil
-import subprocess
 import sys
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-DEFAULT_TARGET = REPO_ROOT / "oryx_tpu" / "registry"
+sys.path.insert(0, str(REPO_ROOT))
 
-
-def _ruff_lint(paths: list[Path]) -> tuple[int, list[str]]:
-    proc = subprocess.run(
-        ["ruff", "check", *[str(p) for p in paths]],
-        capture_output=True,
-        text=True,
-        cwd=REPO_ROOT,
-    )
-    out = (proc.stdout + proc.stderr).strip()
-    return proc.returncode, out.splitlines() if out else []
-
-
-def _iter_py_files(paths: list[Path]):
-    for p in paths:
-        if p.is_dir():
-            yield from sorted(p.rglob("*.py"))
-        elif p.suffix == ".py":
-            yield p
-
-
-def _fallback_lint_file(path: Path) -> list[str]:
-    problems: list[str] = []
-    try:
-        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-
-    imported: dict[str, int] = {}  # local name -> lineno
-    used: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                imported[(a.asname or a.name).split(".")[0]] = node.lineno
-        elif isinstance(node, ast.ImportFrom):
-            for a in node.names:
-                if a.name == "*":
-                    problems.append(f"{path}:{node.lineno}: wildcard import")
-                else:
-                    imported[a.asname or a.name] = node.lineno
-        elif isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for default in [*node.args.defaults, *node.args.kw_defaults]:
-                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
-                    problems.append(
-                        f"{path}:{default.lineno}: mutable default argument"
-                    )
-    # names re-exported via __all__ count as used (registry/__init__.py)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            used.add(node.value)
-    for name, lineno in sorted(imported.items(), key=lambda kv: kv[1]):
-        if name not in used and name != "annotations":
-            problems.append(f"{path}:{lineno}: unused import {name!r}")
-    return problems
-
-
-def run_lint(paths: list[Path] | None = None) -> tuple[int, list[str], str]:
-    """Returns (exit code, problem lines, engine used)."""
-    paths = paths or [DEFAULT_TARGET]
-    if shutil.which("ruff"):
-        rc, lines = _ruff_lint(paths)
-        return rc, lines, "ruff"
-    problems: list[str] = []
-    for f in _iter_py_files(paths):
-        problems.extend(_fallback_lint_file(f))
-    return (1 if problems else 0), problems, "ast-fallback"
+from oryx_tpu.analysis.registryhygiene import (  # noqa: E402,F401
+    DEFAULT_TARGET,
+    _fallback_lint_file,
+    _iter_py_files,
+    _ruff_lint,
+    run_lint,
+)
 
 
 def main(argv: list[str]) -> int:

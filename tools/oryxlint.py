@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""oryxlint CLI wrapper — the analysis subsystem lives in
+oryx_tpu/analysis/; this script only makes it reachable without an
+installed package (`python tools/oryxlint.py [args...]`).
+
+Usage mirrors ``python -m oryx_tpu.analysis``:
+  tools/oryxlint.py                    # all passes, whole tree
+  tools/oryxlint.py --select lockset   # one pass
+  tools/oryxlint.py --json             # machine-readable
+  tools/oryxlint.py --update-baseline  # accept current findings
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from oryx_tpu.analysis.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -177,6 +177,14 @@ def main() -> None:
     from oryx_tpu.common.metrics import registry
     from oryx_tpu.lambda_.speed import SpeedLayer
 
+    if os.environ.get("ORYX_LOCK_WATCHDOG") == "1":
+        # bench.py lock-watchdog overhead row: patch the lock factories
+        # before the broker/layer allocate theirs, the same way the
+        # chaos/fleet test suites run
+        from oryx_tpu.common import locks
+
+        locks.instrument(strict=True)
+
     broker = bus.get_broker(locator)
     broker.create_topic("OryxInput", 1)
     broker.create_topic("OryxUpdate", 1)
