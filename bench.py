@@ -876,8 +876,15 @@ def bench_speed() -> None:
             )
         return json.loads(line)
 
+    # sharded row at N_cores shards (floor 2 so the multi-chain path is
+    # exercised even on single-core CI hosts)
+    n_shards = max(2, os.cpu_count() or 1)
     modes = [
         ("backlog", ["--prefill", "500000"]),
+        (
+            f"backlog {n_shards}-shard",
+            ["--prefill", "500000", "--shards", str(n_shards)],
+        ),
         ("live", ["--seconds", "12", "--producers", "2"]),
     ]
     for idx, (label, extra) in enumerate(modes):

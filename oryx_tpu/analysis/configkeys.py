@@ -33,6 +33,7 @@ ANN_PREFIX = "oryx.serving.scan.ann"
 LINTED_PREFIXES = (
     ANN_PREFIX,
     "oryx.bus.shm",
+    "oryx.speed.parse",
     "oryx.speed.pipeline",
     "oryx.tracing",
 )

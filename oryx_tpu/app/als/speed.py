@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 from collections import deque
 from typing import Iterable, Iterator
@@ -37,6 +38,11 @@ from oryx_tpu.native.store import (
 )
 
 log = logging.getLogger(__name__)
+
+# parse_batch may legitimately return None (empty batch), so the native
+# parser signals "run the Python path instead" with a distinct sentinel
+_NATIVE_DECLINED = object()
+
 
 class ALSSpeedModel(SpeedModel):
     def __init__(
@@ -133,7 +139,36 @@ class ALSSpeedModelManager(SpeedModelManager):
         )
         if not 0.0 <= self.min_model_load_fraction <= 1.0:
             raise ValueError("oryx.speed.min-model-load-fraction must be in [0,1]")
+        self.native_parse = config.get_bool("oryx.speed.parse.native")
+        threads = config.get_optional_int("oryx.speed.parse.threads") or 0
+        self.parse_threads = threads if threads > 0 else (os.cpu_count() or 1)
+        # sharded pipeline state: shard count (configure_sharding), and the
+        # shared PartitionedFoldInSession bound to the current Solver pair.
+        # _fold_lock guards the (solvers, session) swap; each shard then
+        # works its private slice without further synchronization.
+        self._shards = 1
+        self._fold_lock = threading.Lock()
+        self._part_session = None
+        self._part_session_solvers: tuple | None = None
         self.model: ALSSpeedModel | None = None
+
+    def configure_sharding(self, shards: int) -> None:
+        """Declare that ``shards`` pipeline chains will call
+        :meth:`fold_parsed` concurrently (shard-private fold slices over
+        one shared Gramian pair). With more than one shard the
+        self-pending skip queue is retired: its exact-byte matching
+        assumes this instance's publishes hit the UP partition in fold
+        order, which concurrent per-shard publishers no longer guarantee
+        — unmatched self-deltas simply re-apply (absolute vectors,
+        idempotent). Native parse threads are divided among shards so K
+        pinned chains don't oversubscribe the cores K-fold."""
+        with self._fold_lock:
+            self._shards = max(1, int(shards))
+            shards = self._shards
+        if shards > 1:
+            self._self_pending_cap = 0
+            self._self_pending.clear()
+            self.parse_threads = max(1, self.parse_threads // shards)
 
     # -- update-topic consumption (ALSSpeedModelManager.consume:74-126) ------
 
@@ -277,6 +312,13 @@ class ALSSpeedModelManager(SpeedModelManager):
                         users, items, values, ts, self.implicit,
                         first.user_prefix, first.item_prefix,
                     )
+            # native columnar parse: one GIL-released C++ pass per text
+            # block straight to typed int columns (bit-identical to the
+            # numpy path or it declines and we fall through)
+            if self.native_parse:
+                rm = self._parse_text_native([b.messages for b in blocks])
+                if rm is not _NATIVE_DECLINED:
+                    return rm
             # columnar text parse + aggregate: one numpy pass over the
             # micro-batch (same semantics as parse_interactions +
             # aggregate; the indexed form gives aggregated (user, item,
@@ -291,8 +333,61 @@ class ALSSpeedModelManager(SpeedModelManager):
             ]
             if not msgs:
                 return None
+            if self.native_parse:
+                rm = self._parse_text_native([msgs])
+                if rm is not _NATIVE_DECLINED:
+                    return rm
             cols = als_data.parse_interaction_block(msgs)
         rm = als_data.rating_matrix_from_columns(cols, self.implicit)
+        return rm if len(rm.values) else None
+
+    def _parse_text_native(self, message_arrays: list):
+        """Native-parse every text block to typed int columns and build
+        the RatingMatrix through the int fast path. Returns the sentinel
+        ``_NATIVE_DECLINED`` when any block (or the library) declines —
+        the caller then runs the Python parser for the WHOLE batch, so
+        edge semantics (quotes, malformed-line ValueError, mixed
+        prefixes) stay byte-for-byte Python's."""
+        from oryx_tpu.native import parse as native_parse
+
+        parts = []
+        for msgs in message_arrays:
+            if len(msgs) == 0:
+                continue
+            out = native_parse.parse_text_columns(msgs, threads=self.parse_threads)
+            if out is None:
+                return _NATIVE_DECLINED
+            if parts and (
+                out.user_prefix != parts[0].user_prefix
+                or out.item_prefix != parts[0].item_prefix
+            ):
+                return _NATIVE_DECLINED  # blocks disagree on the prefixes
+            parts.append(out)
+        if not parts:
+            return None  # no events in the batch
+        if len(parts) == 1:
+            users, items, values = parts[0].users, parts[0].items, parts[0].values
+            ts = parts[0].timestamps
+        else:
+            users = np.concatenate([p.users for p in parts])
+            items = np.concatenate([p.items for p in parts])
+            values = np.concatenate([p.values for p in parts])
+            ts = (
+                np.concatenate(
+                    [
+                        p.timestamps
+                        if p.timestamps is not None
+                        else np.zeros(len(p.users), np.int64)
+                        for p in parts
+                    ]
+                )
+                if any(p.timestamps is not None for p in parts)
+                else None
+            )
+        rm = als_data.rating_matrix_from_int_columns(
+            users, items, values, ts, self.implicit,
+            parts[0].user_prefix, parts[0].item_prefix,
+        )
         return rm if len(rm.values) else None
 
     def _device_gramian(self, solver: Solver):
@@ -301,16 +396,57 @@ class ALSSpeedModelManager(SpeedModelManager):
         so a fresh Solver is the only event that re-pays the upload."""
         from oryx_tpu.ops import als as als_ops
 
-        g = getattr(solver, "_device_gramian", None)
+        g = getattr(solver, "_device_gramian_cache", None)
         if g is None:
             g = als_ops.device_gramian(solver.matrix)
-            solver._device_gramian = g
+            solver._device_gramian_cache = g
         return g
 
-    def fold_parsed(self, rm) -> list[str]:
+    def _fold_session(self, yty: Solver, xtx: Solver, n: int, k: int, shard: int):
+        """Shard ``shard``'s private fold-in slice over the shared
+        :class:`~oryx_tpu.ops.als.PartitionedFoldInSession`. The session
+        is bound to the current Solver PAIR (held by identity — solver
+        caches invalidate exactly when the Gramians change, so a new pair
+        means rebuild + one fresh device upload shared by all shards);
+        only the pair swap is locked, the returned slice is touched by
+        its shard alone."""
+        from oryx_tpu.ops import als as als_ops
+
+        with self._fold_lock:
+            ps = self._part_session
+            solvers = self._part_session_solvers
+            if (
+                ps is None
+                or ps.shards != self._shards
+                or solvers is None
+                or solvers[0] is not yty
+                or solvers[1] is not xtx
+            ):
+                ps = als_ops.PartitionedFoldInSession(
+                    yty.matrix, xtx.matrix, self.implicit, self._shards,
+                    backend=self.fold_backend,
+                )
+                if ps.resolved_backend(n, k) == "device":
+                    # device-resident Gramians: uploaded once per Solver
+                    # pair (i.e. only when vector writes or a rotation
+                    # invalidated the cache) and shared by every shard's
+                    # slice. Host/auto folds keep the float64 originals —
+                    # their Cholesky runs in f64, and the device path
+                    # casts to f32 regardless, so results are
+                    # bit-identical to the unbatched fold either way.
+                    ps.set_gramians(
+                        self._device_gramian(yty), self._device_gramian(xtx)
+                    )
+                self._part_session = ps
+                self._part_session_solvers = (yty, xtx)
+        return ps.session(shard)
+
+    def fold_parsed(self, rm, shard: int = 0) -> list[str]:
         """Stage 2: fold an aggregated RatingMatrix into the live model
         and render the update messages. Re-checks the load-fraction gate
-        (the pipeline parses ahead of the model becoming ready)."""
+        (the pipeline parses ahead of the model becoming ready). In the
+        sharded pipeline each chain passes its ``shard`` index and folds
+        its slice concurrently with the others."""
         model = self.model
         if rm is None or len(rm.values) == 0:
             return []
@@ -330,8 +466,6 @@ class ALSSpeedModelManager(SpeedModelManager):
         # reference's parallelStream, but as a single batched solve. The
         # vector fetch and update serialization are likewise batched (one
         # native call each) — the per-event hot path has no Python in it.
-        from oryx_tpu.ops import als as als_ops
-
         n = len(rm.values)
         # vocab-level gather: one native fetch per UNIQUE id, expanded to
         # per-event rows by a fancy-index copy — the store pays |vocab|
@@ -343,18 +477,7 @@ class ALSSpeedModelManager(SpeedModelManager):
         xu, xu_valid = xu_vocab[rm.user_idx], xu_ok[rm.user_idx]
         yi, yi_valid = yi_vocab[rm.item_idx], yi_ok[rm.item_idx]
         values = rm.values
-        session = als_ops.FoldInSession(
-            yty.matrix, xtx.matrix, self.implicit, backend=self.fold_backend
-        )
-        if session.resolved_backend(n, model.features) == "device":
-            # device-resident Gramians: uploaded once per Solver (i.e.
-            # only when vector writes or a rotation invalidated the
-            # cache), not once per micro-batch. Host/auto folds keep the
-            # float64 originals — their Cholesky runs in f64, and the
-            # device path casts to f32 regardless, so results are
-            # bit-identical to the unbatched fold either way.
-            session.yty = self._device_gramian(yty)
-            session.xtx = self._device_gramian(xtx)
+        session = self._fold_session(yty, xtx, n, model.features, shard)
         session.add_block(xu, xu_valid, yi, yi_valid, values)
         new_xu, x_upd, new_yi, y_upd = session.solve()
         x_rows = np.nonzero(x_upd)[0]

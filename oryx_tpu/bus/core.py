@@ -159,11 +159,18 @@ class Broker(abc.ABC):
         topic: str,
         group: str | None = None,
         from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
         """A consumer. With `group` set and offsets stored, resumes from the
         stored offsets; `from_beginning=True` starts at offset 0 (the
         update-topic replay path, SpeedLayer.java:107-121); otherwise starts
-        at the topic end (latest)."""
+        at the topic end (latest). `partitions` restricts the consumer to
+        that subset of the topic's partitions (manual assignment, the
+        sharded-pipeline primitive): positions()/commit() then cover ONLY
+        the owned partitions, so concurrent owners of disjoint subsets
+        never clobber each other's ledger entries (set_offsets merges
+        per-partition). None = all partitions (group-free Kafka-style
+        assignment of everything). Brokers that cannot restrict raise."""
 
     @abc.abstractmethod
     def get_offsets(self, group: str, topic: str) -> dict[int, int]: ...
@@ -173,6 +180,23 @@ class Broker(abc.ABC):
 
     @abc.abstractmethod
     def latest_offsets(self, topic: str) -> dict[int, int]: ...
+
+
+def resolve_partitions(nparts: int, partitions: list[int] | None) -> list[int]:
+    """Normalize a consumer's partition-subset request against the topic's
+    partition count: None = everything; otherwise a sorted, deduped subset
+    that must be non-empty and in range (a silent clamp would quietly
+    un-own data)."""
+    if partitions is None:
+        return list(range(nparts))
+    parts = sorted({int(p) for p in partitions})
+    if not parts:
+        raise ValueError("partitions must be non-empty (or None for all)")
+    if parts[0] < 0 or parts[-1] >= nparts:
+        raise ValueError(
+            f"partitions {parts} out of range for a {nparts}-partition topic"
+        )
+    return parts
 
 
 def partition_for(key: str | None, num_partitions: int) -> int:

@@ -268,12 +268,15 @@ class FaultBroker(Broker):
         return _FaultProducer(self._inner.producer(topic), self._state)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
         if self._state.take_connect_failure():
             metrics.registry.counter("bus.fault.connect-failures").inc()
             raise ConnectionError("injected connect failure (consumer)")
-        return _FaultConsumer(self._inner.consumer(topic, group, from_beginning), self._state)
+        return _FaultConsumer(
+            self._inner.consumer(topic, group, from_beginning, partitions), self._state
+        )
 
 
 class _FaultProducer(TopicProducer):

@@ -38,7 +38,14 @@ import time
 from pathlib import Path
 
 from oryx_tpu.bus import blockcodec
-from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, partition_for
+from oryx_tpu.bus.core import (
+    Broker,
+    KeyMessage,
+    TopicConsumer,
+    TopicProducer,
+    partition_for,
+    resolve_partitions,
+)
 
 _OFFSETS_DIR = "__offsets__"
 
@@ -229,11 +236,12 @@ class FileBroker(Broker):
         return _FileProducer(self, topic)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
         if not self.topic_exists(topic):
             self.create_topic(topic, 1)
-        return _FileConsumer(self, topic, group, from_beginning)
+        return _FileConsumer(self, topic, group, from_beginning, partitions)
 
 
 def _count_lines(path: Path) -> int:
@@ -381,27 +389,29 @@ class _FileProducer(TopicProducer):
 
 class _FileConsumer(TopicConsumer):
     def __init__(
-        self, broker: FileBroker, topic: str, group: str | None, from_beginning: bool
+        self, broker: FileBroker, topic: str, group: str | None,
+        from_beginning: bool, partitions: list[int] | None = None,
     ) -> None:
         self._broker = broker
         self._topic = topic
         self._group = group
         self._closed = False
         nparts = broker._num_partitions(topic)
+        parts = resolve_partitions(nparts, partitions)
         stored = broker.get_offsets(group, topic) if group else {}
         if stored:
             # a stored offset older than retention clamps forward to the
             # earliest retained record (Kafka earliest-reset semantics)
             earliest = broker.earliest_offsets(topic)
             self._pos = {
-                i: max(stored.get(i, 0), earliest.get(i, 0)) for i in range(nparts)
+                i: max(stored.get(i, 0), earliest.get(i, 0)) for i in parts
             }
         elif from_beginning:
             earliest = broker.earliest_offsets(topic)
-            self._pos = {i: earliest.get(i, 0) for i in range(nparts)}
+            self._pos = {i: earliest.get(i, 0) for i in parts}
         else:
             latest = broker.latest_offsets(topic)
-            self._pos = {i: latest.get(i, 0) for i in range(nparts)}
+            self._pos = {i: latest.get(i, 0) for i in parts}
         # (segment base, byte position of record self._pos[i]) per
         # partition; established lazily (one O(n) line skip), then advanced
         # incrementally so each poll seeks instead of re-reading. Survives

@@ -10,7 +10,14 @@ from __future__ import annotations
 
 import threading
 
-from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, TopicProducer, partition_for
+from oryx_tpu.bus.core import (
+    Broker,
+    KeyMessage,
+    TopicConsumer,
+    TopicProducer,
+    partition_for,
+    resolve_partitions,
+)
 
 
 class _Topic:
@@ -110,9 +117,10 @@ class InProcessBroker(Broker):
         return _InProcProducer(self, topic)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
-        return _InProcConsumer(self, topic, group, from_beginning)
+        return _InProcConsumer(self, topic, group, from_beginning, partitions)
 
 
 class _InProcProducer(TopicProducer):
@@ -140,22 +148,26 @@ class _InProcProducer(TopicProducer):
 
 class _InProcConsumer(TopicConsumer):
     def __init__(
-        self, broker: InProcessBroker, topic: str, group: str | None, from_beginning: bool
+        self, broker: InProcessBroker, topic: str, group: str | None,
+        from_beginning: bool, partitions: list[int] | None = None,
     ) -> None:
         self._broker = broker
         self._topic = topic
         self._group = group
         self._closed = False
+        # None = dynamic assignment: follow the topic as it grows partitions
+        self._assigned = partitions is not None
         with broker._cond:
             t = broker._topics.get(topic)
             nparts = len(t.partitions) if t else 1
+            parts = resolve_partitions(nparts, partitions)
             stored = broker._offsets.get((group, topic)) if group else None
             if stored:
-                self._pos = {i: stored.get(i, 0) for i in range(nparts)}
+                self._pos = {i: stored.get(i, 0) for i in parts}
             elif from_beginning:
-                self._pos = {i: 0 for i in range(nparts)}
+                self._pos = {i: 0 for i in parts}
             else:
-                self._pos = {i: (len(t.partitions[i]) if t else 0) for i in range(nparts)}
+                self._pos = {i: (len(t.partitions[i]) if t else 0) for i in parts}
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
         out: list[KeyMessage] = []
@@ -166,10 +178,13 @@ class _InProcConsumer(TopicConsumer):
                     return out
                 t = self._broker._topics.get(self._topic)
                 if t is not None:
-                    # topic may have grown partitions since construction
-                    for i in range(len(t.partitions)):
-                        self._pos.setdefault(i, 0)
+                    if not self._assigned:
+                        # topic may have grown partitions since construction
+                        for i in range(len(t.partitions)):
+                            self._pos.setdefault(i, 0)
                     for i, log in enumerate(t.partitions):
+                        if i not in self._pos:
+                            continue
                         start = self._pos[i]
                         take = log[start : start + (max_records - len(out))]
                         if take:

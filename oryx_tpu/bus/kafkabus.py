@@ -210,8 +210,13 @@ class KafkaBroker(Broker):
         return _KafkaProducer(self, topic)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
+        if partitions is not None:
+            raise ValueError(
+                "kafka:// consumers do not support manual partition assignment"
+            )
         return _KafkaConsumer(self, topic, group, from_beginning)
 
     def _offset_consumer(self, group: str):

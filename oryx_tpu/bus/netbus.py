@@ -564,8 +564,13 @@ class NetBroker(Broker):
         return _NetProducer(self, topic)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> TopicConsumer:
+        if partitions is not None:
+            raise ValueError(
+                "tcp:// consumers do not support manual partition assignment"
+            )
         c = _NetConsumer(
             self,
             _Conn(self._host, self._port, self._connect_timeout),

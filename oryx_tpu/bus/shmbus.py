@@ -72,6 +72,7 @@ from oryx_tpu.bus.core import (
     TopicConsumer,
     TopicProducer,
     partition_for,
+    resolve_partitions,
 )
 from oryx_tpu.bus.filebus import FileBroker, _Flock
 from oryx_tpu.common import metrics, tracing
@@ -498,11 +499,12 @@ class ShmBroker(Broker):
         return _ShmProducer(self, topic)
 
     def consumer(
-        self, topic: str, group: str | None = None, from_beginning: bool = False
+        self, topic: str, group: str | None = None, from_beginning: bool = False,
+        partitions: list[int] | None = None,
     ) -> "_ShmConsumer":
         if not self.topic_exists(topic):
             self.create_topic(topic, 1)
-        return _ShmConsumer(self, topic, group, from_beginning)
+        return _ShmConsumer(self, topic, group, from_beginning, partitions)
 
 
 class _ShmProducer(TopicProducer):
@@ -617,7 +619,8 @@ class _ShmConsumer(TopicConsumer):
     """
 
     def __init__(
-        self, broker: ShmBroker, topic: str, group: str | None, from_beginning: bool
+        self, broker: ShmBroker, topic: str, group: str | None,
+        from_beginning: bool, partitions: list[int] | None = None,
     ) -> None:
         self._broker = broker
         self._topic = topic
@@ -625,8 +628,9 @@ class _ShmConsumer(TopicConsumer):
         self._closed = False
         self._pinned = False
         nparts = broker._num_partitions(topic)
+        parts = resolve_partitions(nparts, partitions)
         stored = broker.get_offsets(group, topic) if group else {}
-        self._rings = {i: broker._ring(topic, i) for i in range(nparts)}
+        self._rings = {i: broker._ring(topic, i) for i in parts}
         self._slot: dict[int, int] = {}
         self._pos: dict[int, int] = {}
         self._cursor: dict[int, int] = {}

@@ -85,12 +85,16 @@ class AbstractLayer:
         if ub is not None:
             ub.create_topic(self.update_topic, self.update_partitions)
 
-    def make_input_consumer(self) -> TopicConsumer:
+    def make_input_consumer(self, partitions: list[int] | None = None) -> TopicConsumer:
         """Input consumer resuming from stored offsets when oryx.id is set
-        (AbstractSparkLayer.buildInputDStream:179-252)."""
+        (AbstractSparkLayer.buildInputDStream:179-252). `partitions`
+        restricts the consumer to a subset of input partitions (the sharded
+        speed-pipeline path); commits of disjoint subsets merge in the
+        offset ledger, so shards sharing a group never clobber each other."""
         return self.input_broker().consumer(
             self.input_topic,
             group=self.group_id if self.id else None,
+            partitions=partitions,
         )
 
     # -- lifecycle ----------------------------------------------------------

@@ -32,11 +32,25 @@ Managers exposing the staged API (``parse_batch``/``fold_parsed``, e.g.
 ALSSpeedModelManager) parse on stage 1; for anything else stage 1
 materializes the drained blocks (transport views don't survive the
 hand-off) and stage 2 calls plain ``build_updates``.
+
+Sharding (``oryx.speed.pipeline.shards``, clamped to the input topic's
+partition count): the pipeline is replicated into N independent
+parse→fold→publish chains, shard s owning input partitions
+``p % shards == s`` through a manually-assigned consumer. Each shard has
+its own hand-off queues, commits ONLY its own partitions' offsets after
+its own publish (the ledger merges disjoint subsets), and keeps the
+retry/drop fold semantics per shard. Where the platform allows
+(``pin-cores``, Linux with >1 CPU), a shard's three workers are pinned
+to one core, round-robin over the allowed set, so shards scale across
+cores instead of timeslicing one. With shards == 1 the behavior — thread
+names, the layer-owned consumer, commit path — is exactly the unsharded
+pipeline.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
@@ -98,51 +112,127 @@ class HandoffQueue:
             self._not_empty.notify()
 
 
+class _Shard:
+    """One parse→fold→publish chain: its queues, its consumer (None in
+    single-shard mode, where the layer-owned consumer is used), and the
+    CPU its three workers pin to (None = no pinning)."""
+
+    __slots__ = ("index", "consumer", "parsed", "folded", "cpu")
+
+    def __init__(self, index: int, consumer, depth: int, cpu: int | None) -> None:
+        self.index = index
+        self.consumer = consumer
+        self.parsed = HandoffQueue(depth)
+        self.folded = HandoffQueue(depth)
+        self.cpu = cpu
+
+
 class SpeedPipeline:
-    """The three supervised stages, owned by a :class:`SpeedLayer`.
+    """The supervised stages, owned by a :class:`SpeedLayer`.
 
     Threads run under the layer's retry policy and count toward
-    ``layer.healthy()``; the layer's stop event stops all three.
+    ``layer.healthy()``; the layer's stop event stops all of them.
     """
 
     def __init__(self, layer) -> None:
         self._layer = layer
         config = layer.config
-        depth = config.get_optional_int("oryx.speed.pipeline.queue-depth") or 2
+        self._depth = config.get_optional_int("oryx.speed.pipeline.queue-depth") or 2
         min_batch_ms = config.get_optional_int("oryx.speed.pipeline.min-batch-ms")
         self._min_batch_sec = (200 if min_batch_ms is None else min_batch_ms) / 1000.0
-        self._parsed = HandoffQueue(depth)
-        self._folded = HandoffQueue(depth)
         manager = layer.manager
         self._staged = hasattr(manager, "parse_batch") and hasattr(
             manager, "fold_parsed"
         )
+        self._fold_takes_shard = False
+        if self._staged:
+            import inspect
+
+            try:
+                self._fold_takes_shard = (
+                    "shard" in inspect.signature(manager.fold_parsed).parameters
+                )
+            except (TypeError, ValueError):
+                pass
+        shards = config.get_optional_int("oryx.speed.pipeline.shards") or 1
+        nparts = max(1, layer.input_partitions)
+        if shards > nparts:
+            log.warning(
+                "clamping oryx.speed.pipeline.shards=%d to the input topic's "
+                "%d partition(s)", shards, nparts,
+            )
+            shards = nparts
+        self.shards = max(1, shards)
+        cpus: list[int] = []
+        if self.shards > 1 and config.get_bool("oryx.speed.pipeline.pin-cores"):
+            try:
+                cpus = sorted(os.sched_getaffinity(0))
+            except AttributeError:  # non-Linux
+                cpus = []
+            if len(cpus) < 2:
+                cpus = []
+        # tells a worker thread whether it already pinned itself (the pin
+        # syscall is per-thread; doing it once beats once per loop)
+        self._tls = threading.local()
+        if self.shards > 1 and hasattr(manager, "configure_sharding"):
+            manager.configure_sharding(self.shards)
+        # consumers owned by the pipeline (sharded mode only); the layer
+        # closes them alongside its own
+        self.shard_consumers: list = []
+        self._shards: list[_Shard] = []
+        for s in range(self.shards):
+            consumer = None
+            if self.shards > 1:
+                parts = [p for p in range(nparts) if p % self.shards == s]
+                consumer = layer.make_input_consumer(partitions=parts)
+                self.shard_consumers.append(consumer)
+            cpu = cpus[s % len(cpus)] if cpus else None
+            self._shards.append(_Shard(s, consumer, self._depth, cpu))
         self.threads: list = []
 
     def start(self) -> None:
         layer = self._layer
-        self.threads = [
-            layer.supervise(
-                "SpeedPipelineParse", self._parse_step, loop=True,
-                metrics_prefix="speed.pipeline.parse",
-            ),
-            layer.supervise(
-                "SpeedPipelineFold", self._fold_step, loop=True,
-                metrics_prefix="speed.pipeline.fold",
-            ),
-            layer.supervise(
-                "SpeedPipelinePublish", self._publish_step, loop=True,
-                metrics_prefix="speed.pipeline.publish",
-            ),
-        ]
+        multi = self.shards > 1
+        self.threads = []
+        for sh in self._shards:
+            suffix = f"-{sh.index}" if multi else ""
+            self.threads += [
+                layer.supervise(
+                    f"SpeedPipelineParse{suffix}",
+                    lambda sh=sh: self._parse_step(sh), loop=True,
+                    metrics_prefix="speed.pipeline.parse",
+                ),
+                layer.supervise(
+                    f"SpeedPipelineFold{suffix}",
+                    lambda sh=sh: self._fold_step(sh), loop=True,
+                    metrics_prefix="speed.pipeline.fold",
+                ),
+                layer.supervise(
+                    f"SpeedPipelinePublish{suffix}",
+                    lambda sh=sh: self._publish_step(sh), loop=True,
+                    metrics_prefix="speed.pipeline.publish",
+                ),
+            ]
         log.info(
-            "speed pipeline started: depth=%d min-batch=%.0fms staged=%s",
-            self._parsed._depth, self._min_batch_sec * 1000, self._staged,
+            "speed pipeline started: shards=%d depth=%d min-batch=%.0fms "
+            "staged=%s pinned=%s",
+            self.shards, self._depth, self._min_batch_sec * 1000, self._staged,
+            any(sh.cpu is not None for sh in self._shards),
         )
+
+    def _pin(self, shard: _Shard) -> None:
+        """Pin the calling worker to its shard's core, once per thread."""
+        if shard.cpu is None or getattr(self._tls, "pinned", False):
+            return
+        self._tls.pinned = True
+        try:
+            os.sched_setaffinity(0, {shard.cpu})
+        except OSError:  # cpuset changed under us; run unpinned
+            log.warning("could not pin shard %d to cpu %d", shard.index, shard.cpu)
 
     # -- stage 1: drain + parse ---------------------------------------------
 
-    def _parse_step(self) -> None:
+    def _parse_step(self, shard: _Shard) -> None:
         """Drain one accumulation window off the input bus and parse it.
 
         Transport blocks may be zero-copy views whose lifetime ends at the
@@ -150,8 +240,9 @@ class SpeedPipeline:
         drain and everything is copied out (parsed, or materialized) BEFORE
         the hand-off, so nothing downstream touches transport memory.
         """
+        self._pin(shard)
         layer = self._layer
-        consumer = layer.input_consumer()
+        consumer = shard.consumer if shard.consumer is not None else layer.input_consumer()
         limit = layer.max_batch_events
         deadline = time.monotonic() + self._min_batch_sec
         pin = getattr(consumer, "pin", None)
@@ -159,7 +250,9 @@ class SpeedPipeline:
             pin()
         t0 = time.time()
         try:
-            blocks, total = layer.drain_input_blocks(limit, deadline=deadline)
+            blocks, total = layer.drain_input_blocks(
+                limit, deadline=deadline, consumer=consumer
+            )
             if total == 0:
                 return
             # trace/freshness metadata rides the hand-off tuples so the
@@ -195,12 +288,13 @@ class SpeedPipeline:
                 "speed.parse", ctx.child(), ctx.span_id, t0,
                 time.time() - t0, {"events": total, "blocks": len(blocks)},
             )
-        self._parsed.put((payload, total, positions, 0, meta), layer._stop_event)
+        shard.parsed.put((payload, total, positions, 0, meta), layer._stop_event)
 
     # -- stage 2: fold -------------------------------------------------------
 
-    def _fold_step(self) -> None:
-        item = self._parsed.get(timeout=0.2)
+    def _fold_step(self, shard: _Shard) -> None:
+        self._pin(shard)
+        item = shard.parsed.get(timeout=0.2)
         if item is None:
             return
         payload, total, positions, attempts, meta = item
@@ -209,7 +303,12 @@ class SpeedPipeline:
         try:
             with metrics.timed(metrics.registry.histogram("speed.batch.seconds")):
                 if self._staged:
-                    result = self._layer.manager.fold_parsed(payload)
+                    if self._fold_takes_shard:
+                        result = self._layer.manager.fold_parsed(
+                            payload, shard=shard.index
+                        )
+                    else:
+                        result = self._layer.manager.fold_parsed(payload)
                 else:
                     result = self._layer.manager.build_updates(payload)
                 updates = list(result)
@@ -223,21 +322,22 @@ class SpeedPipeline:
                 )
                 return
             metrics.registry.counter("speed.pipeline.fold-retries").inc()
-            self._parsed.unget((payload, total, positions, attempts, meta))
+            shard.parsed.unget((payload, total, positions, attempts, meta))
             raise  # the supervisor logs, counts and backs off
         if ctx is not None:
             tracing.record_span(
                 "speed.fold", ctx.child(), ctx.span_id, t1,
                 time.time() - t1, {"events": total},
             )
-        self._folded.put(
+        shard.folded.put(
             (updates, total, positions, meta), self._layer._stop_event
         )
 
     # -- stage 3: publish + commit -------------------------------------------
 
-    def _publish_step(self) -> None:
-        item = self._folded.get(timeout=0.2)
+    def _publish_step(self, shard: _Shard) -> None:
+        self._pin(shard)
+        item = shard.folded.get(timeout=0.2)
         if item is None:
             return
         updates, total, positions, meta = item
@@ -280,4 +380,7 @@ class SpeedPipeline:
             )
         metrics.registry.counter("speed.events").inc(total)
         metrics.registry.counter("speed.updates").inc(sent)
+        metrics.registry.counter(
+            f"speed.pipeline.shard.{shard.index}.events"
+        ).inc(total)
         layer.note_batch_published()

@@ -123,15 +123,21 @@ class SpeedLayer(AbstractLayer):
             metrics_prefix="speed.consume",
             on_failure=feed.record_failure,
         )
-        self.prepare_input()
         if self.pipeline_enabled:
-            # three-stage pipelined micro-batching: parse/fold/publish on
-            # separate supervised workers with bounded hand-off queues
+            # pipelined micro-batching: parse/fold/publish on separate
+            # supervised workers with bounded hand-off queues, replicated
+            # per shard when oryx.speed.pipeline.shards > 1
             from oryx_tpu.lambda_.pipeline import SpeedPipeline
 
             self._pipeline = SpeedPipeline(self)
+            if self._pipeline.shards == 1:
+                # sharded mode owns per-partition consumers instead; an
+                # idle layer consumer would hold a zero-copy transport
+                # guard forever and stall the ring
+                self.prepare_input()
             self._pipeline.start()
         else:
+            self.prepare_input()
             self._batch_thread = self.supervise(
                 "SpeedLayer", self._one_interval, loop=True, metrics_prefix="speed.batch"
             )
@@ -146,7 +152,8 @@ class SpeedLayer(AbstractLayer):
         super().close()
         with self._state_lock:
             input_consumer = self._input_consumer
-        for c in (input_consumer, self._update_consumer):
+        shard_consumers = self._pipeline.shard_consumers if self._pipeline else []
+        for c in (input_consumer, self._update_consumer, *shard_consumers):
             if c is not None:
                 c.close()
         pipeline_threads = self._pipeline.threads if self._pipeline else []
@@ -201,7 +208,7 @@ class SpeedLayer(AbstractLayer):
             raise
 
     def drain_input_blocks(
-        self, limit: int, deadline: float | None = None
+        self, limit: int, deadline: float | None = None, consumer=None
     ) -> tuple[list, int]:
         """Columnar input drain shared by the monolithic batch and the
         pipeline's parse stage: blocks of byte-string (or typed int)
@@ -209,10 +216,13 @@ class SpeedLayer(AbstractLayer):
         100K events/s path. Without a deadline, the first empty poll ends
         the batch; with one, polling continues until the accumulation
         window closes (or ``limit`` is hit), so micro-batches stay large
-        enough to amortize the fold solve."""
+        enough to amortize the fold solve. ``consumer`` overrides the
+        layer-owned input consumer (the sharded pipeline drains its own
+        partition-subset consumers)."""
         blocks: list = []
         total = 0
-        consumer = self.input_consumer()
+        if consumer is None:
+            consumer = self.input_consumer()
         while total < limit and not self.is_stopped():
             timeout = 0.05
             if deadline is not None:

@@ -20,7 +20,7 @@ import threading
 log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["feature_store.cpp"]
+_SOURCES = ["feature_store.cpp", "parse.cpp"]
 _LOCK = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _lib_failed = False
@@ -130,6 +130,13 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_int64), c.c_char_p, c.POINTER(c.c_int64), c.c_char_p,
         c.c_char, c.c_int, c.c_int64, c.POINTER(c.c_char),
         c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+    ]
+    lib.als_parse_text_block.restype = c.c_int64
+    lib.als_parse_text_block.argtypes = [
+        c.c_char_p, c.c_int64, c.c_int64,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.POINTER(c.c_float),
+        c.POINTER(c.c_int64), c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
+        c.c_int64,
     ]
     lib.als_format_updates_multi.restype = c.c_int64
     lib.als_format_updates_multi.argtypes = [

@@ -1144,3 +1144,77 @@ class FoldInSession:
             self.implicit,
             backend=backend,
         )
+
+
+class PartitionedFoldInSession:
+    """Sharded fold-in: K disjoint accumulator slices over ONE shared
+    Gramian pair.
+
+    The sharded speed pipeline runs K independent parse->fold->publish
+    chains; each chain folds only its own partitions' events. A naive
+    per-shard :class:`FoldInSession` would re-upload the Gramians per
+    shard per micro-batch; here every slice shares the same ``yty``/
+    ``xtx`` references (device-resident via :func:`device_gramian` when
+    the backend resolves there — uploaded ONCE for all K shards), and
+    each shard's blocks accumulate in its own slice so concurrent
+    ``add_block``/``solve_shard`` calls never touch shared state.
+
+    Bit-identity: the fold math is row-wise independent — each event row
+    gets its own einsum/target/GEMM against the same fixed Gramians (see
+    ``_fold_half_host`` / ``_fold_half``) — so folding a shard's slice
+    alone, or merging all slices into one solve (:meth:`solve`, shard
+    order), produces EXACTLY the f32 bits a single session fed the same
+    events would. Tests assert both forms against ``FoldInSession``.
+    """
+
+    def __init__(self, yty, xtx, implicit: bool, shards: int, backend: str = "auto") -> None:
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        self.implicit = implicit
+        self.backend = backend
+        self._slices = [
+            FoldInSession(yty, xtx, implicit, backend) for _ in range(shards)
+        ]
+
+    @property
+    def shards(self) -> int:
+        return len(self._slices)
+
+    @property
+    def pending(self) -> int:
+        return sum(s.pending for s in self._slices)
+
+    def set_gramians(self, yty, xtx) -> None:
+        """Swap in (typically device-resident) Gramians for every slice —
+        one upload serves all K shards for the life of the Solver pair."""
+        for s in self._slices:
+            s.yty = yty
+            s.xtx = xtx
+
+    def session(self, shard: int) -> FoldInSession:
+        """Shard ``shard``'s private slice. Distinct shards may use their
+        slices concurrently; one shard's slice is single-threaded."""
+        return self._slices[shard % len(self._slices)]
+
+    def resolved_backend(self, n: int, k: int) -> str:
+        return self._slices[0].resolved_backend(n, k)
+
+    def add_block(self, shard: int, xu, xu_valid, yi, yi_valid, values) -> None:
+        self.session(shard).add_block(xu, xu_valid, yi, yi_valid, values)
+
+    def solve_shard(self, shard: int):
+        """Fold shard ``shard``'s accumulated slice alone (its micro-batch
+        boundary); other shards' slices are untouched."""
+        return self.session(shard).solve()
+
+    def solve(self):
+        """The merge step: reconcile ALL slices in shard order into one
+        solve — the cheap cross-shard synchronization point (list moves
+        only; the concatenation happens inside the single solve)."""
+        merged = self._slices[0]
+        for s in self._slices[1:]:
+            merged._blocks.extend(s._blocks)
+            merged._pending += s._pending
+            s._blocks = []
+            s._pending = 0
+        return merged.solve()

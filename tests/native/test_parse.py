@@ -1,0 +1,208 @@
+"""Native columnar text parser: bit-identical parity with the Python
+parse path, and conservative whole-block fallback on anything the native
+grammar cannot reproduce exactly (adversarial inputs). Skips cleanly when
+the native library is unavailable."""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als import data as als_data
+from oryx_tpu.native import get_library
+from oryx_tpu.native import parse as native_parse
+
+needs_native = pytest.mark.skipif(
+    get_library() is None, reason="native library unavailable"
+)
+
+pytestmark = needs_native
+
+
+def as_block(lines):
+    """Lines (str) -> the S-dtype array a decoded text frame holds."""
+    return np.asarray([ln.encode() for ln in lines], dtype="S")
+
+
+def reconstruct_ids(ints, prefix):
+    """prefix + canonical decimal per row, as an S array (what the native
+    typed columns denote)."""
+    s = np.char.mod("%d", ints).astype("S")
+    if prefix:
+        s = np.char.add(np.full(len(s), prefix, dtype=f"S{len(prefix)}"), s)
+    return s
+
+
+def assert_parity(lines, threads=1):
+    """Native columns must reproduce the Python parser's output exactly:
+    ids byte-for-byte, values as identical f32 bit patterns, ts exact."""
+    block = as_block(lines)
+    out = native_parse.parse_text_columns(block, threads=threads)
+    assert out is not None, f"native declined a canonical block: {lines[:3]}"
+    ref = als_data.parse_interaction_block(block)
+    np.testing.assert_array_equal(
+        reconstruct_ids(out.users, out.user_prefix), ref.users.astype("S")
+    )
+    np.testing.assert_array_equal(
+        reconstruct_ids(out.items, out.item_prefix), ref.items.astype("S")
+    )
+    assert out.values.dtype == np.float32
+    np.testing.assert_array_equal(
+        out.values.view(np.uint32), ref.values.view(np.uint32)
+    )
+    ts = out.timestamps
+    if ts is None:
+        ts = np.zeros(len(out.users), np.int64)
+    np.testing.assert_array_equal(ts, ref.timestamps)
+    return out
+
+
+def assert_fallback(lines):
+    out = native_parse.parse_text_columns(as_block(lines))
+    assert out is None, f"native accepted a non-canonical block: {lines[:3]}"
+
+
+# -- parity on canonical inputs ------------------------------------------------
+
+
+def test_parity_basic_with_ts():
+    assert_parity(["1,7,5.0,1000", "2,7,3.5,2000", "1,9,1.0,3000"])
+
+
+def test_parity_no_ts_column():
+    out = assert_parity(["1,7,5.0", "2,9,3.5"])
+    assert out.timestamps is None
+
+
+def test_parity_mixed_ts_presence():
+    # some lines carry a ts, some don't: missing ts parses as 0
+    assert_parity(["1,7,5.0,1000", "2,9,3.5", "3,9,1.5,2000"])
+
+
+def test_parity_empty_value_is_delete_marker():
+    out = assert_parity(["1,7,,1000", "2,9,2.0,2000"])
+    assert np.isnan(out.values[0])
+
+
+def test_parity_empty_ts_field():
+    # trailing comma: present-but-empty ts parses as 0
+    assert_parity(["1,7,5.0,", "2,9,3.5,7"])
+
+
+def test_parity_prefixed_ids():
+    assert_parity(["u1,i7,5.0,1", "u2,i9,3.5,2"])
+
+
+def test_parity_long_prefix_and_exponent_values():
+    assert_parity(
+        ["user_1,item-7,1e-3,1", "user_22,item-9,2.5e2,2", "user_3,item-11,1E4,3"]
+    )
+
+
+def test_parity_signs_dotfloat_and_negative_ts():
+    assert_parity(["1,7,+0.5,-5", "2,9,-3.25,2", "3,11,.5,3", "4,13,2.9,4"])
+
+
+def test_parity_float_timestamps():
+    # float ts truncates toward zero like astype(int64)
+    assert_parity(["1,7,5.0,1000.9", "2,9,3.5,-2.7"])
+
+
+def test_parity_int32_extremes():
+    assert_parity([f"{2**31 - 1},0,1.0,1", "0,2147483647,2.0,2"])
+
+
+def test_parity_seeded_random_block_multithreaded():
+    gen = np.random.default_rng(42)
+    n = 20_000
+    users = gen.integers(0, 100_000, n)
+    items = gen.integers(0, 50_000, n)
+    vals = gen.normal(size=n).astype(np.float32)
+    ts = gen.integers(0, 2**40, n)
+    lines = [
+        f"u{u},i{i},{float(v)!r},{t}" for u, i, v, t in zip(users, items, vals, ts)
+    ]
+    assert_parity(lines, threads=4)
+
+
+# -- conservative fallback on adversarial inputs -------------------------------
+
+
+def test_fallback_non_ascii_ids():
+    assert_fallback(["ü1,7,5.0,1", "ü2,9,3.5,2"])
+
+
+def test_fallback_mixed_prefixes_within_block():
+    assert_fallback(["u1,i7,5.0,1", "v2,i9,3.5,2"])
+
+
+def test_fallback_leading_zero_id():
+    # "01" != str(1): not canonically reconstructible
+    assert_fallback(["01,7,5.0,1"])
+
+
+def test_fallback_quoted_csv():
+    assert_fallback(['"u,1",7,5.0,1'])
+
+
+def test_fallback_json_lines():
+    assert_fallback(['["u1","i7",5.0,1]'])
+
+
+def test_fallback_too_many_fields():
+    assert_fallback(["1,7,5.0,1,extra"])
+
+
+def test_fallback_truncated_lines_python_raises():
+    # native declines; the authoritative Python path raises on bad input
+    lines = ["1,7,5.0,1", "2,9"]
+    assert_fallback(lines)
+    with pytest.raises(ValueError):
+        als_data.parse_interaction_block(as_block(lines))
+
+
+def test_fallback_id_overflow():
+    assert_fallback([f"{2**32},7,5.0,1"])
+
+
+def test_fallback_value_overflow():
+    assert_fallback(["1,7,1e400,1"])
+
+
+def test_fallback_nan_literal():
+    # numpy parses "nan"; the native grammar conservatively declines it
+    assert_fallback(["1,7,nan,1"])
+
+
+def test_empty_batch_returns_none():
+    assert native_parse.parse_text_columns([]) is None
+    assert native_parse.parse_text_columns(np.empty(0, "S1")) is None
+
+
+# -- manager-level parity ------------------------------------------------------
+
+
+def test_manager_native_and_python_paths_publish_identical_updates():
+    """ALSSpeedModelManager.parse_batch|>fold_parsed emits the same update
+    messages whether the native parse stage ran or the block fell back to
+    the Python parser."""
+    from oryx_tpu.common import config as C
+    from oryx_tpu.app.als.speed import ALSSpeedModel, ALSSpeedModelManager
+    from oryx_tpu.bus.core import KeyMessage
+
+    events = ["u1,i2,3.0,1", "u2,i1,2.0,2", "u1,i2,1.5,3"]
+
+    def run(native):
+        cfg = C.get_default().with_overlay(
+            f"oryx.speed.parse.native = {str(native).lower()}"
+        )
+        mgr = ALSSpeedModelManager(cfg)
+        mgr.model = ALSSpeedModel(2, True, set(), set())
+        mgr.model.set_user_vectors(
+            ["u1", "u2"], np.array([[1.0, 0.1], [0.2, 1.0]], np.float32)
+        )
+        mgr.model.set_item_vectors(
+            ["i1", "i2"], np.array([[0.9, 0.3], [0.4, 0.8]], np.float32)
+        )
+        rm = mgr.parse_batch([KeyMessage(None, e) for e in events])
+        return sorted(mgr.fold_parsed(rm))
+
+    assert run(True) == run(False)

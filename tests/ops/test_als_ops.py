@@ -377,3 +377,104 @@ def test_train_als_matches_naive_reference_solver():
         nx, ny = naive_als(uu, ii, vv, implicit, 0.05, 1.0, 3, 9)
         np.testing.assert_allclose(model.x, nx, rtol=2e-2, atol=2e-3)
         np.testing.assert_allclose(model.y, ny, rtol=2e-2, atol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# partitioned fold-in sessions (sharded speed pipeline)
+# ---------------------------------------------------------------------------
+
+
+def _fold_inputs(gen, n, k):
+    xu = gen.standard_normal((n, k)).astype(np.float32)
+    yi = gen.standard_normal((n, k)).astype(np.float32)
+    xu_valid = gen.random(n) < 0.9
+    yi_valid = gen.random(n) < 0.9
+    values = gen.standard_normal(n).astype(np.float32)
+    return xu, xu_valid, yi, yi_valid, values
+
+
+@pytest.mark.parametrize("backend", ["host", "device"])
+@pytest.mark.parametrize("implicit", [True, False])
+def test_partitioned_fold_merge_bit_identical_to_single_session(implicit, backend):
+    """Distributing a micro-batch's rows over K shard slices and merging
+    (solve, shard order) yields EXACTLY the f32 bits one FoldInSession fed
+    the same rows would — the fold math is row-wise independent."""
+    from oryx_tpu.ops import als as als_ops
+
+    gen = np.random.default_rng(7)
+    k, n, shards = 4, 96, 4
+    g = gen.standard_normal((6, k)).astype(np.float32)
+    yty = (g.T @ g).astype(np.float64)
+    xtx = (g.T @ g * 0.5).astype(np.float64)
+    xu, xu_valid, yi, yi_valid, values = _fold_inputs(gen, n, k)
+
+    owner = np.arange(n) % shards  # round-robin rows -> shards
+    part = als_ops.PartitionedFoldInSession(yty, xtx, implicit, shards, backend=backend)
+    for s in range(shards):
+        sel = owner == s
+        part.add_block(s, xu[sel], xu_valid[sel], yi[sel], yi_valid[sel], values[sel])
+    assert part.pending == n
+    got = part.solve()
+    assert part.pending == 0
+
+    # single-session reference, rows in the merged (shard-major) order
+    order = np.concatenate([np.flatnonzero(owner == s) for s in range(shards)])
+    single = als_ops.FoldInSession(yty, xtx, implicit, backend=backend)
+    single.add_block(
+        xu[order], xu_valid[order], yi[order], yi_valid[order], values[order]
+    )
+    want = single.solve()
+    for g_arr, w_arr in zip(got, want):
+        g_arr, w_arr = np.asarray(g_arr), np.asarray(w_arr)
+        if g_arr.dtype == np.float32:
+            np.testing.assert_array_equal(
+                g_arr.view(np.uint32), w_arr.view(np.uint32)
+            )
+        else:
+            np.testing.assert_array_equal(g_arr, w_arr)
+
+
+@pytest.mark.parametrize("backend", ["host", "device"])
+def test_partitioned_solve_shard_matches_private_session(backend):
+    """solve_shard folds ONLY that shard's slice, bit-identical to a
+    private session over the same rows; other slices stay pending."""
+    from oryx_tpu.ops import als as als_ops
+
+    gen = np.random.default_rng(11)
+    k, n = 4, 32
+    g = gen.standard_normal((5, k)).astype(np.float32)
+    yty = (g.T @ g).astype(np.float64)
+    xtx = (g.T @ g * 0.25).astype(np.float64)
+    a = _fold_inputs(gen, n, k)
+    b = _fold_inputs(gen, n, k)
+
+    part = als_ops.PartitionedFoldInSession(yty, xtx, True, 2, backend=backend)
+    part.add_block(0, *a)
+    part.add_block(1, *b)
+    single = als_ops.FoldInSession(yty, xtx, True, backend=backend)
+    single.add_block(*a)
+
+    got = part.solve_shard(0)
+    want = single.solve()
+    for g_arr, w_arr in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g_arr), np.asarray(w_arr))
+    # shard 1 untouched by shard 0's micro-batch boundary
+    assert part.pending == n
+    assert part.session(1).pending == n
+    assert part.solve_shard(1) is not None
+    assert part.solve_shard(1) is None  # drained
+
+
+def test_partitioned_set_gramians_swaps_every_slice():
+    from oryx_tpu.ops import als as als_ops
+
+    part = als_ops.PartitionedFoldInSession(
+        np.eye(3), np.eye(3), False, 3, backend="host"
+    )
+    yty2, xtx2 = np.eye(3) * 2.0, np.eye(3) * 3.0
+    part.set_gramians(yty2, xtx2)
+    for s in range(3):
+        assert part.session(s).yty is yty2
+        assert part.session(s).xtx is xtx2
+    with pytest.raises(ValueError):
+        als_ops.PartitionedFoldInSession(np.eye(3), np.eye(3), False, 0)

@@ -22,8 +22,15 @@ Two modes:
 --trials runs the timed phase N times and reports per-trial rates, the
 median, and the spread ((max-min)/median; >20% is flagged NOISY).
 
+--shards N (shm only) partitions the input topic N ways and runs N
+independent parse->fold->publish pipeline chains (one per partition
+subset, core-pinned where the platform allows). In backlog mode each
+trial gets a FRESH layer so the prefill happens while the pipeline is
+down — producer cost stays excluded from the timed drain.
+
 Usage:
     python tools/speed_layer_benchmark.py --prefill 2000000 --trials 3
+    python tools/speed_layer_benchmark.py --prefill 2000000 --shards 4
     python tools/speed_layer_benchmark.py --seconds 15 --trials 3 [--pipeline]
 """
 
@@ -57,12 +64,16 @@ def build_chunks(seed: int, users: int, items: int):
     return out
 
 
-def produce(locator: str, users: int, items: int, stop_path: str) -> None:
+def produce(
+    locator: str, users: int, items: int, stop_path: str, nparts: int = 1
+) -> None:
     """Producer-process body: pump synthetic rating events until stopped.
 
     Everything format-shaped happens ONCE, before the loop: shm producers
     replay pre-encoded columnar payloads (send_payload = header pack +
-    memcpy), file producers replay a pre-rendered record list.
+    memcpy), file producers replay a pre-rendered record list. With
+    ``nparts`` > 1, frames round-robin over the input partitions so every
+    pipeline shard sees traffic.
     """
     from oryx_tpu import bus
     from oryx_tpu.bus import blockcodec
@@ -79,7 +90,10 @@ def produce(locator: str, users: int, items: int, stop_path: str) -> None:
             while not os.path.exists(stop_path):
                 flags, count, payload, crc = frames[j % len(frames)]
                 try:
-                    p.send_payload(blockcodec.KIND_COLS, flags, count, payload, crc)
+                    p.send_payload(
+                        blockcodec.KIND_COLS, flags, count, payload, crc,
+                        partition=j % nparts,
+                    )
                 except BlockingIOError:
                     time.sleep(0.002)  # ring full: consumer owns the core
                     continue
@@ -98,25 +112,30 @@ def produce(locator: str, users: int, items: int, stop_path: str) -> None:
                 j += 1
 
 
-def prefill_events(broker, typed: bool, n: int, users: int, items: int, seed=7):
-    """Pre-produce n events (typed columnar frames on shm, text on file)."""
+def prefill_events(
+    broker, typed: bool, n: int, users: int, items: int, seed=7, nparts: int = 1
+):
+    """Pre-produce n events (typed columnar frames on shm, text on file),
+    chunk-round-robined over ``nparts`` input partitions."""
     gen = np.random.default_rng(seed)
     t0 = time.perf_counter()
     with broker.producer("OryxInput") as p:
         left = n
+        j = 0
         while left > 0:
-            m = min(200_000, left)
+            m = min(100_000, left)
             u = gen.integers(0, users, m).astype(np.int32)
             i = gen.integers(0, items, m).astype(np.int32)
             v = (1.0 + gen.random(m)).astype(np.float32)
             if typed:
-                p.send_interactions(u, i, v)
+                p.send_interactions(u, i, v, partition=j % nparts)
             else:
                 p.send_many(
-                    (None, f"u{uu},i{ii},{vv:.3f},{j}")
-                    for j, (uu, ii, vv) in enumerate(zip(u, i, v))
+                    (None, f"u{uu},i{ii},{vv:.3f},{jj}")
+                    for jj, (uu, ii, vv) in enumerate(zip(u, i, v))
                 )
             left -= m
+            j += 1
     return time.perf_counter() - t0
 
 
@@ -133,6 +152,9 @@ def main() -> None:
     ap.add_argument("--pipeline", action="store_true",
                     help="run the three-stage parse/fold/publish pipeline "
                     "(live mode only)")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="partition the input topic this many ways and run "
+                    "one pipeline chain per partition subset (shm only)")
     ap.add_argument("--trials", type=int, default=1)
     ap.add_argument("--seconds", type=float, default=15.0,
                     help="per-trial window in live mode")
@@ -156,9 +178,15 @@ def main() -> None:
                     help="shm ring size; 0 = auto-size to the prefill")
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
-    if args.pipeline and args.prefill:
-        ap.error("--pipeline is a live-mode flag (backlog mode times "
-                 "run_one_batch directly)")
+    if args.shards < 1:
+        ap.error("--shards must be >= 1")
+    if args.shards > 1 and args.bus != "shm":
+        ap.error("--shards > 1 requires --bus shm (the partitioned ring "
+                 "transport)")
+    if args.pipeline and args.prefill and args.shards == 1:
+        ap.error("--pipeline is a live-mode flag (unsharded backlog mode "
+                 "times run_one_batch directly; use --shards N for a "
+                 "pipelined backlog drain)")
 
     root = Path(tempfile.mkdtemp(prefix="oryx-speedbench-"))
     stop_path = str(root / "STOP")
@@ -186,7 +214,8 @@ def main() -> None:
         locks.instrument(strict=True)
 
     broker = bus.get_broker(locator)
-    broker.create_topic("OryxInput", 1)
+    nparts = max(1, args.shards)
+    broker.create_topic("OryxInput", nparts)
     broker.create_topic("OryxUpdate", 1)
 
     cfg = C.get_default().with_overlay(
@@ -197,44 +226,127 @@ def main() -> None:
         oryx.als.no-known-items = true
         oryx.speed.fold-in-backend = "{args.backend}"
         oryx.input-topic.broker = "{locator}"
+        oryx.input-topic.message.partitions = {nparts}
         oryx.update-topic.broker = "{locator}"
         oryx.speed.streaming.generation-interval-sec = 3600
         oryx.speed.streaming.max-batch-events = {args.batch_events}
-        oryx.speed.pipeline.enabled = {str(args.pipeline).lower()}
+        oryx.speed.pipeline.enabled = {str(args.pipeline or args.shards > 1).lower()}
+        oryx.speed.pipeline.shards = {args.shards}
         """
     )
-    layer = SpeedLayer(cfg)
 
-    # seed the model directly on the manager (no bus replay of a 60K-id
-    # PMML blob): MODEL sets shape + expected ids, batched setters load
-    # the factors so get_fraction_loaded() reaches 1.0
-    t0 = time.perf_counter()
-    gen = np.random.default_rng(42)
-    root_pmml = pmml_io.build_skeleton_pmml()
-    add_extension(root_pmml, "features", args.features)
-    add_extension(root_pmml, "implicit", "true")
-    add_extension_content(root_pmml, "XIDs", [f"u{j}" for j in range(args.users)])
-    add_extension_content(root_pmml, "YIDs", [f"i{j}" for j in range(args.items)])
-    layer.manager.consume(iter([KeyMessage("MODEL", pmml_io.to_string(root_pmml))]))
-    m = layer.manager.model
-    x = gen.standard_normal((args.users, args.features)).astype(np.float32)
-    y = gen.standard_normal((args.items, args.features)).astype(np.float32)
-    m.set_user_vectors([f"u{j}" for j in range(args.users)], x)
-    m.set_item_vectors([f"i{j}" for j in range(args.items)], y)
-    assert m.get_fraction_loaded() >= 1.0, m.get_fraction_loaded()
-    print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
+    def build_layer() -> SpeedLayer:
+        # seed the model directly on the manager (no bus replay of a
+        # 60K-id PMML blob): MODEL sets shape + expected ids, batched
+        # setters load the factors so get_fraction_loaded() reaches 1.0
+        built = SpeedLayer(cfg)
+        t0 = time.perf_counter()
+        gen = np.random.default_rng(42)
+        root_pmml = pmml_io.build_skeleton_pmml()
+        add_extension(root_pmml, "features", args.features)
+        add_extension(root_pmml, "implicit", "true")
+        add_extension_content(
+            root_pmml, "XIDs", [f"u{j}" for j in range(args.users)]
+        )
+        add_extension_content(
+            root_pmml, "YIDs", [f"i{j}" for j in range(args.items)]
+        )
+        built.manager.consume(
+            iter([KeyMessage("MODEL", pmml_io.to_string(root_pmml))])
+        )
+        m = built.manager.model
+        x = gen.standard_normal((args.users, args.features)).astype(np.float32)
+        y = gen.standard_normal((args.items, args.features)).astype(np.float32)
+        m.set_user_vectors([f"u{j}" for j in range(args.users)], x)
+        m.set_item_vectors([f"i{j}" for j in range(args.items)], y)
+        assert m.get_fraction_loaded() >= 1.0, m.get_fraction_loaded()
+        print(f"model ready in {time.perf_counter() - t0:.1f}s", flush=True)
+        return built
 
-    # the input consumer must exist BEFORE any produce: its guard pins the
-    # shm ring tail so prefilled frames are never reclaimed underneath us
-    layer.prepare_input()
+    sharded_backlog = bool(args.prefill) and args.shards > 1
+    layer = None
+    if not sharded_backlog:
+        layer = build_layer()
+        if args.shards == 1:
+            # the input consumer must exist BEFORE any produce: its guard
+            # pins the shm ring tail so prefilled frames are never
+            # reclaimed underneath us. (Sharded chains own their
+            # consumers — an idle layer consumer would stall the rings.)
+            layer.prepare_input()
     typed = args.bus == "shm"
     events_counter = registry.counter("speed.events")
     rates: list[float] = []
+    shard_rates: list[list[float]] = []
     producers: list[subprocess.Popen] = []
     total_events = total_updates = total_batches = 0
 
     try:
-        if args.prefill:
+        if sharded_backlog:
+            # one pipeline chain per partition subset drains the backlog;
+            # each trial gets a fresh layer so the prefill lands while the
+            # pipeline is down (producer cost excluded from the drain)
+            first = True
+            for trial in range(-1, args.trials):  # trial -1 = warm-up
+                n = 100_000 if trial < 0 else args.prefill
+                broker.delete_topic("OryxUpdate")
+                broker.create_topic("OryxUpdate", 1)
+                layer = build_layer()
+                if first:
+                    # no stored offsets yet -> consumers would start at
+                    # latest and skip the prefill; pin them to 0 first
+                    broker.set_offsets(
+                        layer.group_id, "OryxInput",
+                        {p: 0 for p in range(nparts)},
+                    )
+                    first = False
+                dt = prefill_events(
+                    broker, typed, n, args.users, args.items,
+                    seed=100 + trial, nparts=nparts,
+                )
+                label = "warm-up" if trial < 0 else f"trial {trial + 1}"
+                print(f"{label}: prefilled {n} events in {dt:.1f}s",
+                      flush=True)
+                before = int(events_counter.value)
+                shard_before = [
+                    int(registry.counter(
+                        f"speed.pipeline.shard.{s}.events").value)
+                    for s in range(args.shards)
+                ]
+                start = time.perf_counter()
+                layer.start()
+                got, last_advance = 0, start
+                while got < n:
+                    time.sleep(0.01)
+                    seen = int(events_counter.value) - before
+                    now = time.perf_counter()
+                    if seen > got:
+                        got, last_advance = seen, now
+                    elif now - last_advance > 60:
+                        print(f"{label}: STALLED at {got}/{n}", flush=True)
+                        break
+                elapsed = time.perf_counter() - start
+                batches = layer.batch_count
+                layer.close()
+                layer = None
+                if trial < 0:
+                    continue
+                per_shard = [
+                    (int(registry.counter(
+                        f"speed.pipeline.shard.{s}.events").value) - b)
+                    / elapsed
+                    for s, b in enumerate(shard_before)
+                ]
+                shard_rates.append(per_shard)
+                rates.append(got / elapsed)
+                total_events += got
+                total_batches += batches
+                print(
+                    f"{label}: {got} events in {elapsed:.2f}s -> "
+                    f"{got / elapsed:,.0f} events/s  (per-shard: "
+                    f"{', '.join(f'{r:,.0f}' for r in per_shard)})",
+                    flush=True,
+                )
+        elif args.prefill:
             # warm-up: compile/calibrate the fold path before timing
             prefill_events(broker, typed, 100_000, args.users, args.items, seed=1)
             while layer.run_one_batch() or int(events_counter.value) == 0:
@@ -273,12 +385,13 @@ def main() -> None:
                         "--produce-stop", stop_path,
                         "--users", str(args.users),
                         "--items", str(args.items),
+                        "--nparts", str(nparts),
                     ]
                 )
                 for _ in range(args.producers)
             ]
             time.sleep(1.0)  # let the bus fill so the layer never starves
-            if args.pipeline:
+            if args.pipeline or args.shards > 1:
                 layer.start()  # pipeline workers drain continuously
                 time.sleep(2.0)  # warm-up / fold calibration
                 for trial in range(args.trials):
@@ -317,11 +430,19 @@ def main() -> None:
         Path(stop_path).touch()
         for p in producers:
             p.wait(timeout=30)
-        layer.close()
+        if layer is not None:
+            layer.close()
 
     med, spread, flag = summarize(rates)
     framing = "typed-columnar frames" if typed else "text lines"
-    if args.prefill:
+    if sharded_backlog:
+        mode = (
+            f"backlog: {args.trials} trial(s) x {args.prefill}-event prefill "
+            f"over {nparts} partitions; {args.shards}-shard pipeline drain "
+            f"(fresh layer per trial; producer cost excluded — prefill "
+            f"lands while the pipeline is down)"
+        )
+    elif args.prefill:
         mode = (
             f"backlog: {args.trials} trial(s) x {args.prefill}-event prefill; "
             f"producer cost excluded from the timed drain (events were "
@@ -338,17 +459,31 @@ def main() -> None:
             f"live: {args.producers} producer process(es) racing the layer "
             f"for {args.seconds:.0f}s windows; {split}; layer core pays the "
             f"full parse->fold->publish path"
-            + ("; three-stage pipeline on" if args.pipeline else "")
+            + (f"; {args.shards}-shard pipeline on"
+               if args.shards > 1
+               else ("; three-stage pipeline on" if args.pipeline else ""))
         )
     lines = [
         f"=== speed_layer_benchmark @ {time.strftime('%Y-%m-%d %H:%M:%S %Z')} ===",
         f"bus={args.bus} ({framing}); model {args.users}u x {args.items}i x "
-        f"{args.features}f implicit; host cores: {os.cpu_count()}",
+        f"{args.features}f implicit; host cores: {os.cpu_count()}; "
+        f"shards: {args.shards}",
         mode,
         f"per-trial events/s: [{', '.join(f'{r:,.0f}' for r in rates)}] -> "
         f"median {med:,.0f} events/s (spread {spread:.1%}, {flag}); "
         f"{total_events} events over {total_batches} micro-batches",
     ]
+    if shard_rates:
+        shard_medians = [
+            float(np.median([t[s] for t in shard_rates]))
+            for s in range(args.shards)
+        ]
+        lines.append(
+            "per-shard median events/s: "
+            + ", ".join(
+                f"shard{s}={r:,.0f}" for s, r in enumerate(shard_medians)
+            )
+        )
     print("\n".join(lines), flush=True)
     print(
         json.dumps(
@@ -356,7 +491,8 @@ def main() -> None:
                 "metric": (
                     f"speed layer sustained fold-in over {args.bus} bus, "
                     f"{'backlog' if args.prefill else 'live'} mode "
-                    f"({args.features} feat, {args.users // 1000}K users, "
+                    + (f"[{args.shards} shards] " if args.shards > 1 else "")
+                    + f"({args.features} feat, {args.users // 1000}K users, "
                     f"{args.items // 1000}K items)"
                 ),
                 "value": round(med, 0),
@@ -364,6 +500,7 @@ def main() -> None:
                 "rates": [round(r, 0) for r in rates],
                 "trials": len(rates),
                 "spread": round(spread, 3),
+                "shards": args.shards,
                 "vs_baseline": round(med / 100_000.0, 2),
             }
         )
@@ -387,7 +524,8 @@ if __name__ == "__main__":
         ap.add_argument("--produce")
         ap.add_argument("--users", type=int, default=50_000)
         ap.add_argument("--items", type=int, default=10_000)
+        ap.add_argument("--nparts", type=int, default=1)
         a = ap.parse_args()
-        produce(a.produce, a.users, a.items, stop)
+        produce(a.produce, a.users, a.items, stop, nparts=a.nparts)
     else:
         main()
