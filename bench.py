@@ -1227,6 +1227,194 @@ def bench_lock_watchdog_overhead() -> None:
         raise RuntimeError("lock watchdog overhead above envelope: " + "; ".join(failures))
 
 
+def bench_ledger_overhead() -> None:
+    """Resource-ledger cost acceptance rows (docs/static-analysis.md):
+    the weakref live-resource accounting every layer registers into must
+    cost <= 2% on the same two hot paths the lock-watchdog rows guard.
+    Registration happens per acquisition (layer/consumer/session
+    construction), never per event or per request, so the expected
+    overhead is indistinguishable from noise — these rows pin that down.
+
+    Both halves pair the arms INSIDE one process — the ledger's cost is
+    so small that any protocol comparing separate processes (or separate
+    layers) measures placement/drift artifacts instead; median AND best
+    must both miss the envelope before a row hard-fails.
+
+    - speed layer backlog events/s: ONE subprocess run of the real
+      SpeedLayer bench with --toggle-env ORYX_RESOURCE_LEDGER flipping
+      the ledger between drain trials (``enabled()`` re-reads the env
+      per call), so on/off trials share JIT warm-up and host state;
+    - closed-loop serving qps under a 2 Hz /metrics scraper: ONE live
+      layer (its resources registered at construction), with the env
+      toggle flipping the ledger's only steady-state work — the gauge
+      refresh that probes every weakref on each scrape. A same-layer
+      A/B sidesteps the two-layers-in-one-process placement bias that
+      dwarfs the real cost (the /recommend path itself never touches
+      the ledger).
+    """
+    import threading
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    envelope = float(os.environ.get("ORYX_BENCH_LEDGER_ENVELOPE", 0.98))
+    failures: list[str] = []
+
+    def ratio_row(
+        kind: str, unit: str, on_rates: list, off_rates: list, order: int
+    ) -> None:
+        med_on = statistics.median(on_rates)
+        med_off = max(statistics.median(off_rates), 1e-9)
+        ratio = med_on / med_off
+        best = max(on_rates) / med_off
+        detail = (
+            f"ledger on {med_on:.0f} vs off {med_off:.0f} {unit} "
+            f"(medians of {len(on_rates)}/{len(off_rates)} trials), "
+            f"overhead {100 * (1 - ratio):.2f}%, envelope <= "
+            f"{100 * (1 - envelope):.0f}%"
+        )
+        print(f"bench[resource-ledger {kind}]: {detail}", file=sys.stderr)
+        _emit(
+            f"resource ledger overhead, {kind}, registered vs disabled "
+            f"(vs_baseline = on/off ratio, floor {envelope})",
+            med_on,
+            unit,
+            ratio,
+            order=order,
+            detail=detail,
+            off_value=round(med_off, 2),
+            overhead_pct=round(100 * (1 - ratio), 3),
+            noise_suspect=ratio < envelope <= best,
+            spread=[round(float(min(on_rates)), 2), round(float(max(on_rates)), 2)],
+            trials=len(on_rates),
+        )
+        if ratio < envelope and best < envelope:
+            failures.append(f"{kind}: on/off {ratio:.4f} < {envelope}")
+
+    # --- speed backlog: ONE subprocess, env flipped per drain trial ---------
+    # (--toggle-env pairs the arms inside one process; separate on/off
+    # subprocesses on this 1-core host measure minutes-apart machine
+    # drift — a control run with the ledger off in BOTH arms showed
+    # 3-11% phantom "overhead" under that protocol)
+    prefill = int(os.environ.get("ORYX_BENCH_LEDGER_PREFILL", 300_000))
+    # round up to a multiple of 4: the tool's ABBA toggle order is only
+    # first-order balanced against host drift at 4k trials (drain trials
+    # cost ~1.5s each, so the extra arms are nearly free)
+    speed_trials = ((max(8, 2 * _TRIALS) + 3) // 4) * 4
+
+    env = dict(os.environ)
+    env["ORYX_RESOURCE_LEDGER"] = "1"  # construction registers under "on"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_HERE, "tools", "speed_layer_benchmark.py"),
+            "--trials",
+            str(speed_trials),
+            "--prefill",
+            str(prefill),
+            "--toggle-env",
+            "ORYX_RESOURCE_LEDGER",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        env=env,
+    )
+    sys.stderr.write(proc.stderr[-800:])
+    line = None
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("{") and '"metric"' in ln:
+            line = ln
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"resource-ledger speed run failed rc={proc.returncode}"
+        )
+    toggle = json.loads(line)["toggle"]
+    ratio_row(
+        "speed backlog fold-in", "events/sec",
+        [float(r) for r in toggle["on"]],
+        [float(r) for r in toggle["off"]],
+        order=44,
+    )
+
+    # --- serving closed-loop: ONE live layer, env toggle flips the ----------
+    # --- /metrics-scrape refresh work, trials interleaved -------------------
+    items = int(os.environ.get("ORYX_BENCH_LEDGER_ITEMS", 200_000))
+    users = 10_000
+    seconds = float(os.environ.get("ORYX_BENCH_LEDGER_SECONDS", 4.0))
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "BenchResourceLedger"
+          input-topic.broker = "inproc://benchledger"
+          update-topic.broker = "inproc://benchledger"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+    layer = ServingLayer(cfg)  # built with the ledger at its default (on)
+    try:
+        layer.start()
+        layer.model_manager.model = build_model(users, items, 50)
+        base = f"http://127.0.0.1:{layer.port}"
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+
+        def serving_trial(ledger_on: bool) -> float:
+            prev = os.environ.get("ORYX_RESOURCE_LEDGER")
+            os.environ["ORYX_RESOURCE_LEDGER"] = "1" if ledger_on else "0"
+            stop = threading.Event()
+
+            def scrape():  # 2 Hz operator scrape: where refresh() runs
+                while not stop.is_set():
+                    try:
+                        urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+                    except OSError:
+                        pass
+                    stop.wait(0.5)
+
+            scraper = threading.Thread(target=scrape, daemon=True)
+            scraper.start()
+            try:
+                lats: list = []
+                deadline = time.perf_counter() + seconds
+                t1 = time.perf_counter()
+                worker(base, "/recommend/u%d", users, deadline, lats, [], stop)
+                if not lats:
+                    raise RuntimeError("resource-ledger serving: no requests")
+                return len(lats) / (time.perf_counter() - t1)
+            finally:
+                stop.set()
+                scraper.join(timeout=10)
+                if prev is None:
+                    os.environ.pop("ORYX_RESOURCE_LEDGER", None)
+                else:
+                    os.environ["ORYX_RESOURCE_LEDGER"] = prev
+
+        srv_on: list = []
+        srv_off: list = []
+        # an EVEN pair count keeps the alternating (on,off)/(off,on)
+        # order positionally balanced against host drift
+        for pair in range(((max(4, _TRIALS) + 1) // 2) * 2):
+            for mode_on in (True, False) if pair % 2 == 0 else (False, True):
+                (srv_on if mode_on else srv_off).append(serving_trial(mode_on))
+    finally:
+        layer.close()
+    ratio_row("serving closed-loop", "queries/sec", srv_on, srv_off, order=45)
+
+    if failures:
+        raise RuntimeError(
+            "resource ledger overhead above envelope: " + "; ".join(failures)
+        )
+
+
 def bench_serving_closed_loop() -> None:
     """Closed-loop /recommend latency through the REAL serving stack:
     ServingLayer HTTP server + ALS endpoints + request micro-batcher +
@@ -1452,6 +1640,7 @@ BENCHES = [
     ("speed", bench_speed),
     ("tracing-overhead", bench_tracing_overhead),
     ("lock-watchdog", bench_lock_watchdog_overhead),
+    ("resource-ledger", bench_ledger_overhead),
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),

@@ -115,6 +115,7 @@ def _load_builtin_passes() -> None:
         configkeys,
         deploymanifests,
         jaxhot,
+        lifecycle,
         lockorder,
         lockset,
         metricscatalog,

@@ -479,6 +479,11 @@ class ALSServingModel(ServingModel):
             # through to the vector path instead of paying seconds of
             # latency; _x_building (set under the lock above) already
             # serializes builds, so at most one thread runs this
+            prev = self._x_restage_thread
+            if prev is not None:
+                # _x_building guarantees the previous restage's body has
+                # finished; reap the thread object before replacing it
+                prev.join(timeout=5.0)
             t = threading.Thread(
                 target=self._rebuild_x_staging,
                 args=(rebuild_dirty, rebuild_epoch),
@@ -487,6 +492,9 @@ class ALSServingModel(ServingModel):
             )
             self._x_restage_thread = t  # joinable: tests + orderly close
             t.start()
+            from oryx_tpu.common import ledger
+
+            ledger.register("thread", t, live=threading.Thread.is_alive)
         if row is None:
             return None, None
         return x_mat, row
@@ -629,6 +637,29 @@ class ALSServingModel(ServingModel):
     def all_user_ids(self) -> list[str]:
         return self.x.ids()
 
+    def close(self) -> None:
+        """Orderly teardown: reap the in-flight X restage thread and drop
+        the device-resident score matrices so a replaced model (fleet
+        rotation, MODEL update with new hyperparams) releases its HBM
+        instead of pinning it until GC notices. Idempotent."""
+        t = self._x_restage_thread
+        if t is not None:
+            self._x_restage_thread = None
+            t.join(timeout=10.0)
+        with self._cache_lock:
+            self._y_matrix = None
+            self._y_host = None
+            self._y_partitions = None
+            self._x_matrix = None
+            self._x_index = {}
+            self._x_ids = []
+            # a straggler request still holding this model rebuilds from
+            # the vector stores instead of scoring against a dropped cache
+            self._y_dirty = True
+            self._y_full_rebuild = True
+            self._x_dirty = True
+            self._x_full_rebuild = True
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"ALSServingModel[features={self.features}, X={self.x.size()}, Y={self.y.size()}]"
 
@@ -738,6 +769,7 @@ class ALSServingModelManager(AbstractServingModelManager):
                     or self.model.features != features
                     or self.model.implicit != implicit
                 ):
+                    old = self.model
                     self.model = ALSServingModel(
                         features,
                         implicit,
@@ -747,6 +779,11 @@ class ALSServingModelManager(AbstractServingModelManager):
                         device_user_matrix=self.device_user_matrix,
                     )
                     self.model.set_expected(x_ids, y_ids)
+                    if old is not None:
+                        # requests racing the swap hold their own model ref
+                        # (get_model snapshots); teardown only reaps the
+                        # restage thread and drops device matrices
+                        old.close()
                 else:
                     self.model.retain_recent_and_user_ids(x_ids)
                     self.model.retain_recent_and_item_ids(y_ids)
@@ -762,6 +799,11 @@ class ALSServingModelManager(AbstractServingModelManager):
 
     def get_model(self) -> ALSServingModel | None:
         return self.model
+
+    def close(self) -> None:
+        model, self.model = self.model, None
+        if model is not None:
+            model.close()
 
 
 def _load_rescorer_providers(config: Config):

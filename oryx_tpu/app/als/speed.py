@@ -567,4 +567,10 @@ class ALSSpeedModelManager(SpeedModelManager):
         return f'["{matrix}",{id_json},{vec_json},[{ks}]]'
 
     def close(self) -> None:
-        pass
+        # drop the device-resident fold-in session: its per-shard Gramian
+        # blocks pin HBM until the last reference dies, and a manager that
+        # outlives its layer (fleet rotation) would otherwise hold them
+        # for the life of the process
+        with self._fold_lock:
+            self._part_session = None
+            self._part_session_solvers = None

@@ -418,6 +418,9 @@ class _FileConsumer(TopicConsumer):
         # segment rolls: a rolled active keeps its base in the archived
         # name, so the cached byte stays valid for the same content.
         self._cursor: dict[int, tuple[int, int]] = {}
+        from oryx_tpu.common import ledger
+
+        ledger.register("consumer", self, live=lambda c: not c.closed())
 
     def _read_partition_raw(self, i: int, budget: int, out: list[bytes]) -> None:
         """Append up to `budget` complete raw record lines (bytes, newline

@@ -168,6 +168,9 @@ class _InProcConsumer(TopicConsumer):
                 self._pos = {i: 0 for i in parts}
             else:
                 self._pos = {i: (len(t.partitions[i]) if t else 0) for i in parts}
+        from oryx_tpu.common import ledger
+
+        ledger.register("consumer", self, live=lambda c: not c.closed())
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
         out: list[KeyMessage] = []

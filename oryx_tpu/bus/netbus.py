@@ -194,6 +194,15 @@ class BusServer(socketserver.ThreadingTCPServer):
         self.broker = FileBroker(data_dir)
         self._client_socks: set = set()
         self._client_lock = threading.Lock()
+        self._serve_thread: threading.Thread | None = None
+
+    def shutdown(self):
+        super().shutdown()
+        # reap the background serve_forever thread started by serve();
+        # shutdown() returns only after the loop exits, so this is quick
+        t, self._serve_thread = self._serve_thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
     # live-connection tracking: server_close() must sever established
     # client connections too, not just the listener — otherwise a
@@ -231,6 +240,7 @@ def serve(host: str, port: int, data_dir: str) -> BusServer:
     the returned object, which is what the CLI does."""
     server = BusServer((host, port), data_dir)
     t = threading.Thread(target=server.serve_forever, name="oryx-bus-serve", daemon=True)
+    server._serve_thread = t
     t.start()
     log.info("bus server on %s:%d over %s", host, server.server_address[1], data_dir)
     return server
@@ -349,6 +359,9 @@ class _NetConsumer(TopicConsumer):
         self._from_beginning = from_beginning
         self._last_positions: dict[int, int] | None = None
         self._closed = False
+        from oryx_tpu.common import ledger
+
+        ledger.register("consumer", self, live=lambda c: not c.closed())
 
     def poll(self, max_records: int = 1000, timeout: float = 0.1) -> list[KeyMessage]:
         block = self.poll_block(max_records, timeout)

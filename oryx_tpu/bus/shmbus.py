@@ -128,11 +128,20 @@ class _Ring:
     def __init__(self, path: Path) -> None:
         self.path = path
         self.lock_path = path.with_suffix(".lock")
+        self._closed = False
         self._f = open(path, "r+b")
-        self.mm = mmap.mmap(self._f.fileno(), 0)
+        try:
+            self.mm = mmap.mmap(self._f.fileno(), 0)
+        except BaseException:
+            self._f.close()
+            raise
         if self.u64(_OFF_MAGIC) != RING_FILE_MAGIC:
+            self.close()
             raise OSError(f"not a shm ring file: {path}")
         self.ring_bytes = self.u64(_OFF_RING_BYTES)
+        from oryx_tpu.common import ledger
+
+        ledger.register("ring", self, live=lambda r: not r._closed)
 
     # -- header words -------------------------------------------------------
 
@@ -143,6 +152,9 @@ class _Ring:
         _U64.pack_into(self.mm, off, v)
 
     def close(self) -> None:
+        if self._closed:  # idempotent: brokers and consumers both reach here
+            return
+        self._closed = True
         try:
             self.mm.close()
         except BufferError:
@@ -506,6 +518,13 @@ class ShmBroker(Broker):
             self.create_topic(topic, 1)
         return _ShmConsumer(self, topic, group, from_beginning, partitions)
 
+    def close(self) -> None:
+        """Drop every process-local ring handle (file + mmap). Idempotent;
+        the ring files themselves stay on disk for other processes."""
+        rings, self._rings = self._rings, {}
+        for ring in rings.values():
+            ring.close()
+
 
 class _ShmProducer(TopicProducer):
     def __init__(self, broker: ShmBroker, topic: str) -> None:
@@ -637,21 +656,36 @@ class _ShmConsumer(TopicConsumer):
         # per-partition trace context captured from a KIND_TRACE frame,
         # attached to the next delivered block
         self._pending_trace: dict[int, str] = {}
-        for i, ring in self._rings.items():
-            slot, head, tail, nseq, bseq = ring.claim_slot_and_snapshot(broker.slots)
-            self._slot[i] = slot
-            if stored:
-                # stored offset older than the ring retains: clamp forward
-                # (Kafka earliest-reset semantics, same as the file bus)
-                self._pos[i] = max(int(stored.get(i, 0)), bseq)
-                self._cursor[i] = tail
-            elif from_beginning:
-                self._pos[i] = bseq
-                self._cursor[i] = tail
-            else:
-                self._pos[i] = nseq
-                self._cursor[i] = head
-                ring.set_guard(slot, head)
+        try:
+            for i, ring in self._rings.items():
+                slot, head, tail, nseq, bseq = ring.claim_slot_and_snapshot(broker.slots)
+                self._slot[i] = slot
+                if stored:
+                    # stored offset older than the ring retains: clamp forward
+                    # (Kafka earliest-reset semantics, same as the file bus)
+                    self._pos[i] = max(int(stored.get(i, 0)), bseq)
+                    self._cursor[i] = tail
+                elif from_beginning:
+                    self._pos[i] = bseq
+                    self._cursor[i] = tail
+                else:
+                    self._pos[i] = nseq
+                    self._cursor[i] = head
+                    ring.set_guard(slot, head)
+        except BaseException:
+            # a claim partway through the ring set failed (e.g. all slots
+            # taken on a later ring): release the slots already claimed so
+            # the aborted constructor doesn't strand guard positions that
+            # would stall ring reclaim until pid eviction notices
+            for i, slot in self._slot.items():
+                try:
+                    self._rings[i].release_slot(slot)
+                except OSError:
+                    pass
+            raise
+        from oryx_tpu.common import ledger
+
+        ledger.register("consumer", self, live=lambda c: not c.closed())
 
     # -- guard lifetime -----------------------------------------------------
 

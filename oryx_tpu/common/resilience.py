@@ -350,7 +350,12 @@ class SupervisedThread:
     # -- thread surface ------------------------------------------------------
 
     def start(self) -> None:
+        from oryx_tpu.common import ledger
+
         self._thread.start()
+        # registered at start (not construction) so an unstarted thread
+        # never counts as a live resource; live while the OS thread runs
+        ledger.register("thread", self, live=SupervisedThread.is_alive)
 
     def join(self, timeout: float | None = None) -> None:
         self._thread.join(timeout)

@@ -13,7 +13,7 @@ import threading
 from typing import Iterator
 
 from oryx_tpu.bus.core import Broker, KeyMessage, TopicConsumer, get_broker
-from oryx_tpu.common import metrics
+from oryx_tpu.common import ledger, metrics
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
 
@@ -105,7 +105,11 @@ class AbstractLayer:
         batch/speed ui.port; here it serves the metrics registry and a
         one-line status as JSON). No-op when the port is null."""
         port = self.config.get(f"oryx.{self.layer_name}.ui.port", None)
-        if port is None or getattr(self, "_ui_server", None) is not None:
+        if (
+            port is None
+            or getattr(self, "_ui_server", None) is not None
+            or getattr(self, "_ui_thread", None) is not None
+        ):
             return
         # loopback by default: the endpoint has no auth (the reference's
         # Spark UI bound 0.0.0.0 unauthenticated; metrics scrapers that
@@ -128,6 +132,8 @@ class AbstractLayer:
                     body = {"healthy": healthy, "layer": layer.layer_name}
                     status = 200 if healthy else 503
                 else:
+                    if ledger.enabled():
+                        ledger.ledger.refresh()
                     body = dict(_metrics.registry.snapshot())
                     body["layer"] = {
                         "type": "status",
@@ -151,7 +157,9 @@ class AbstractLayer:
         self._ui_server = srv
         self.ui_port = srv.server_address[1]  # resolved (port 0 = ephemeral)
         t = threading.Thread(target=srv.serve_forever, name=f"{self.layer_name}-ui", daemon=True)
+        self._ui_thread = t
         t.start()
+        ledger.register("thread", t, live=threading.Thread.is_alive)
 
     def supervise(
         self, name: str, target, *, loop: bool = False, metrics_prefix: str | None = None,
@@ -205,6 +213,10 @@ class AbstractLayer:
             srv.shutdown()
             srv.server_close()
             self._ui_server = None
+        t = getattr(self, "_ui_thread", None)
+        if t is not None:
+            self._ui_thread = None
+            self.join_or_report_leak(t)
 
 
 def blocking_iterator(consumer: TopicConsumer, stop_event: threading.Event) -> Iterator[KeyMessage]:

@@ -91,6 +91,7 @@ class SpeedLayer(AbstractLayer):
         self._batch_thread = None
         self._pipeline = None
         self._batch_count = 0
+        self._closed = False
 
     def prepare_input(self) -> None:
         """Attach the input consumer; from this point input is observed."""
@@ -105,6 +106,11 @@ class SpeedLayer(AbstractLayer):
             return self._input_consumer
 
     def start(self) -> None:
+        if self._update_consumer is not None:
+            raise RuntimeError(
+                "SpeedLayer.start() called twice: the live update consumer "
+                "and worker threads would be overwritten and leak"
+            )
         self.init_topics()
         self.maybe_start_ui()
         ub = self.update_broker()
@@ -149,6 +155,10 @@ class SpeedLayer(AbstractLayer):
         )
 
     def close(self) -> None:
+        with self._state_lock:
+            if self._closed:
+                return  # idempotent: fleet drivers + atexit both call close
+            self._closed = True
         super().close()
         with self._state_lock:
             input_consumer = self._input_consumer

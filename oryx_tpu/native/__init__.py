@@ -59,16 +59,93 @@ def _build_library() -> str | None:
     return so_path
 
 
+_SANITIZE_FLAGS = [
+    # -O1 keeps stack traces honest; frame pointers make ASan reports
+    # readable. detect_leaks is left to the harness (CPython itself is
+    # not leak-clean, so LSan would drown real reports in interpreter
+    # noise).
+    "-fsanitize=address,undefined",
+    "-fno-sanitize-recover=undefined",
+    "-fno-omit-frame-pointer",
+    "-g",
+    "-O1",
+]
+
+
+def build_sanitized_library() -> str | None:
+    """Compile an ASan+UBSan instrumented variant of the native sources.
+
+    Kept as a SEPARATE artifact in _build/ (``liboryx_native_san_*``) so
+    the production .so is never polluted with sanitizer runtime deps.
+    Loading it into CPython requires the ASan runtime to be preloaded
+    (see `find_asan_runtime`); the test harness runs the parity suite in
+    a subprocess with LD_PRELOAD set. Returns None when the toolchain is
+    unavailable — callers skip, they do not fail.
+    """
+    h = hashlib.sha256()
+    paths = [os.path.join(_HERE, s) for s in _SOURCES]
+    for path in paths:
+        with open(path, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(_SANITIZE_FLAGS).encode())
+    build_dir = os.path.join(_HERE, "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(
+        build_dir, f"liboryx_native_san_{h.hexdigest()[:16]}.so"
+    )
+    if os.path.exists(so_path):
+        return so_path
+    cmd = [
+        "g++", *_SANITIZE_FLAGS, "-std=c++17", "-shared", "-fPIC",
+        "-o", so_path, *paths, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=240)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"")
+        log.warning(
+            "sanitized native build unavailable (%s): %s",
+            e, (err or b"").decode("utf-8", "replace")[:500],
+        )
+        return None
+    return so_path
+
+
+def find_asan_runtime() -> str | None:
+    """Absolute path to libasan.so for LD_PRELOAD, or None.
+
+    A sanitized .so dlopen()ed into an uninstrumented CPython needs the
+    ASan runtime loaded FIRST; g++ knows where its copy lives.
+    """
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            check=True, capture_output=True, timeout=30,
+        ).stdout.decode().strip()
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError):
+        return None
+    # when the runtime is missing g++ echoes the bare name back
+    if out and os.path.isabs(out) and os.path.exists(out):
+        return os.path.realpath(out)
+    return None
+
+
 def get_library() -> ctypes.CDLL | None:
     """The loaded native library, or None (disabled or build failure —
-    callers fall back to Python implementations)."""
+    callers fall back to Python implementations). With
+    ORYX_NATIVE_SANITIZE=1 the ASan/UBSan build variant is loaded
+    instead (the harness sets this in a subprocess whose LD_PRELOAD
+    carries the ASan runtime)."""
     global _lib, _lib_failed
     if not native_enabled():
         return None
     with _LOCK:
         if _lib is not None or _lib_failed:
             return _lib
-        so_path = _build_library()
+        if os.environ.get("ORYX_NATIVE_SANITIZE") == "1":
+            so_path = build_sanitized_library()
+        else:
+            so_path = _build_library()
         if so_path is None:
             _lib_failed = True
             return None

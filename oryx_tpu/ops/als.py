@@ -1064,6 +1064,11 @@ class FoldInSession:
         self.backend = backend
         self._blocks: list[tuple] = []
         self._pending = 0
+        from oryx_tpu.common import ledger
+
+        # released by reference drop (the device Gramians/blocks live as
+        # long as the session) — no probe, live while strongly referenced
+        ledger.register("session", self)
 
     def _resolved_backend(self, n: int, k: int) -> str:
         if self.backend != "auto":

@@ -287,6 +287,11 @@ def _metrics(ctx: ServingContext, req: Request) -> Response:
     come from this instance's own registry when one is attached, so N
     replicas in one process each report their *own* traffic (the fleet
     harness computes per-replica SLO burn rates from exactly this)."""
+    from oryx_tpu.common import ledger
+
+    if ledger.enabled():
+        # resources.<kind>.live gauges: the leak alarm for week-long runs
+        ledger.ledger.refresh()
     snap = metrics.registry.snapshot()
     if ctx.instance_metrics is not None:
         # instance-scoped values shadow the process-global ones: in a
@@ -615,6 +620,16 @@ class ServingLayer:
     def start(self) -> None:
         from oryx_tpu.serving.batcher import retain_default_batcher
 
+        if (
+            self._server is not None
+            or self._server_thread is not None
+            or self._update_consumer is not None
+        ):
+            raise RuntimeError(
+                "ServingLayer.start() called twice (or retried after a "
+                "partial start): the live HTTP server, update consumer, "
+                "and consume thread would be overwritten and leak"
+            )
         retain_default_batcher()
         self._batcher_retained = True
         cfg = self.config
@@ -717,6 +732,9 @@ class ServingLayer:
             target=self._server.serve_forever, name="ServingHTTP", daemon=True
         )
         self._server_thread.start()
+        from oryx_tpu.common import ledger
+
+        ledger.register("thread", self._server_thread, live=threading.Thread.is_alive)
         log.info("ServingLayer listening on :%d%s", self.port, self.context_path or "/")
 
     def _consume_updates(self) -> None:

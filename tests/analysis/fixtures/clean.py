@@ -1,5 +1,6 @@
 """A clean fixture: threaded state consistently guarded, locks nested
-in one global order, jit cached module-level. No pass should flag it."""
+in one global order, jit cached module-level, resources released on
+every path (finally / with / idempotent close). No pass should flag it."""
 
 import functools
 import threading
@@ -36,6 +37,47 @@ class OneOrder:
         with self._outer:
             with self._inner:
                 pass
+
+
+class Lifecycled:
+    """Every release idiom the lifecycle pass (ORX5xx) must accept:
+    closed-flag idempotency, release-before-reacquire, thread join."""
+
+    def __init__(self, broker):
+        self._closed = False
+        self._consumer = broker.consumer("updates")
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        pass
+
+    def reconnect(self, broker):
+        if self._consumer is not None:
+            self._consumer.close()
+        self._consumer = broker.consumer("updates")
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._consumer.close()
+        self._thread.join(timeout=5.0)
+
+
+def probe(path, validator):
+    # release lives in a finally: the raise-capable call between acquire
+    # and close cannot strand the file
+    f = open(path)
+    try:
+        validator.check(path)
+    finally:
+        f.close()
+
+
+def read_with(path):
+    with open(path) as f:
+        return f.read()
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,65 @@
+"""Seeded resource-lifecycle bugs: one per ORX5xx code. Never imported —
+the lifecycle pass must flag every class/function here by AST alone."""
+
+import socket
+import threading
+
+
+def exception_path_leak(path, validator):
+    # ORX501: released on the straight-line path only — validator.check()
+    # raising strands the open file (no try/finally, no with)
+    f = open(path)
+    validator.check(path)
+    f.close()
+    return True
+
+
+def never_released_local(path):
+    # ORX506: acquired, never released, never escapes
+    f = open(path)
+    return path.upper()
+
+
+class UnreleasedConsumer:
+    # ORX502: the consumer (guard slot / socket on the broker side) has
+    # no release path in any method of the class
+    def __init__(self, broker):
+        self._consumer = broker.consumer("updates")
+
+    def poll(self):
+        return self._consumer.poll(timeout=0.1)
+
+
+class UnjoinedWorker:
+    # ORX504: the thread is started but no method ever joins or stops it
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+
+class NonIdempotentClose:
+    # ORX503: close() releases the socket with no closed-flag, None-guard
+    # or null-out — a second close() double-releases the handle
+    def __init__(self, host):
+        self._sock = socket.create_connection((host, 80))
+
+    def close(self):
+        self._sock.close()
+
+
+class OverwritingReconnector:
+    # ORX505: reconnect() drops the live socket without closing it
+    def __init__(self, host):
+        self._host = host
+        self._sock = socket.create_connection((host, 80))
+
+    def reconnect(self):
+        self._sock = socket.create_connection((self._host, 80))
+
+    def close(self):
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

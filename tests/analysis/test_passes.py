@@ -69,11 +69,39 @@ def test_jaxhot_flags_recompile_and_host_sync(tmp_path):
     assert any(f.symbol.endswith(":acc") for f in syncs)
 
 
+# -- lifecycle -----------------------------------------------------------------
+
+
+def test_lifecycle_flags_each_seeded_leak(tmp_path):
+    found = _scan(tmp_path, "leaks.py", select={"lifecycle"})
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f)
+    assert set(by_code) == {
+        "ORX501", "ORX502", "ORX503", "ORX504", "ORX505", "ORX506"
+    }, by_code
+    assert any("exception_path_leak.f" in f.symbol for f in by_code["ORX501"])
+    assert any("UnreleasedConsumer._consumer" in f.symbol for f in by_code["ORX502"])
+    assert any("NonIdempotentClose.close" in f.symbol for f in by_code["ORX503"])
+    assert any("UnjoinedWorker._thread" in f.symbol for f in by_code["ORX504"])
+    assert any("OverwritingReconnector._sock" in f.symbol for f in by_code["ORX505"])
+    assert any("never_released_local.f" in f.symbol for f in by_code["ORX506"])
+
+
+def test_lifecycle_accepts_release_idioms(tmp_path):
+    # the Lifecycled class + finally/with functions in the clean fixture
+    # exercise every idiom the pass must NOT flag
+    found = _scan(tmp_path, "clean.py", select={"lifecycle"})
+    assert found == []
+
+
 # -- clean fixture -------------------------------------------------------------
 
 
 def test_clean_fixture_is_quiet(tmp_path):
-    found = _scan(tmp_path, "clean.py", select={"lockset", "lockorder", "jaxhot"})
+    found = _scan(
+        tmp_path, "clean.py", select={"lockset", "lockorder", "jaxhot", "lifecycle"}
+    )
     assert found == []
 
 

@@ -12,6 +12,7 @@ def test_all_passes_registered():
         "lockset",
         "lockorder",
         "jaxhot",
+        "lifecycle",
         "config-keys",
         "registry",
         "deploy",

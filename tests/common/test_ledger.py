@@ -1,0 +1,110 @@
+"""ResourceLedger unit coverage: weakref semantics (registration never
+extends a lifetime), probe-based release, GC-based release, gauge
+publication including zeroing emptied kinds, and the env kill switch."""
+
+import gc
+import threading
+import time
+
+from oryx_tpu.common import ledger as ledger_mod
+from oryx_tpu.common.ledger import ResourceLedger
+
+
+class Handle:
+    def __init__(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def test_probe_release_and_gc_release():
+    led = ResourceLedger()
+    h = Handle()
+    led.register("handle", h, live=lambda x: not x.closed)
+    s = object()
+
+    class Session:
+        pass
+
+    sess = Session()
+    led.register("session", sess)  # no probe: GC-released
+    del s
+    assert led.counts() == {"handle": 1, "session": 1}
+
+    h.close()  # probe now reports released; the strong ref still exists
+    assert led.counts() == {"session": 1}
+    # pruned on the probe flip — a later reopen must not resurrect it
+    h.closed = False
+    assert led.counts() == {"session": 1}
+
+    del sess
+    gc.collect()
+    assert led.counts() == {}
+
+
+def test_ledger_never_extends_lifetimes():
+    led = ResourceLedger()
+    h = Handle()
+    led.register("handle", h, live=lambda x: not x.closed)
+    ref_alive = [True]
+
+    import weakref
+
+    weakref.finalize(h, lambda: ref_alive.__setitem__(0, False))
+    del h
+    gc.collect()
+    assert not ref_alive[0], "ledger held a strong reference"
+    assert led.counts() == {}
+
+
+def test_raising_probe_counts_as_released():
+    led = ResourceLedger()
+    h = Handle()
+    led.register("handle", h, live=lambda x: x.missing_attr)  # raises
+    assert led.counts() == {}
+
+
+def test_unweakreffable_objects_are_skipped():
+    led = ResourceLedger()
+    led.register("int", 7)  # plain ints have no weakref support
+    assert led.counts() == {}
+
+
+def test_thread_probe_tracks_os_thread_exit():
+    led = ResourceLedger()
+    gate = threading.Event()
+    t = threading.Thread(target=gate.wait, daemon=True)
+    t.start()
+    led.register("thread", t, live=threading.Thread.is_alive)
+    assert led.live("thread") == 1
+    gate.set()
+    t.join(timeout=5.0)
+    deadline = time.monotonic() + 5.0
+    while led.live("thread") and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert led.live("thread") == 0
+
+
+def test_refresh_publishes_and_zeroes_gauges():
+    from oryx_tpu.common import metrics
+
+    led = ResourceLedger()
+    h = Handle()
+    led.register("handle", h, live=lambda x: not x.closed)
+    led.refresh()
+    assert metrics.registry.gauge("resources.handle.live").value == 1
+    h.close()
+    led.refresh()  # the emptied kind is zeroed, not left stale at 1
+    assert metrics.registry.gauge("resources.handle.live").value == 0
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("ORYX_RESOURCE_LEDGER", "0")
+    assert not ledger_mod.enabled()
+    before = ledger_mod.ledger.counts()
+    h = Handle()
+    ledger_mod.register("handle", h, live=lambda x: not x.closed)
+    assert ledger_mod.ledger.counts() == before  # module register no-ops
+    monkeypatch.delenv("ORYX_RESOURCE_LEDGER")
+    assert ledger_mod.enabled()

@@ -76,6 +76,54 @@ def _lock_watchdog(request):
     assert not found, f"lock watchdog violations: {found}"
 
 
+@pytest.fixture(autouse=True)
+def _resource_ledger(request):
+    """Dynamic leak oracle for the suites that create and destroy whole
+    layers: chaos/fleet/pipeline tests must release every thread, bus
+    consumer, shm ring, and fold-in session they acquire. The ledger
+    (oryx_tpu/common/ledger.py) tracks acquisitions via weakrefs; this
+    fixture snapshots the live counts before the test and asserts the
+    population returned to the snapshot after teardown — the runtime
+    validation of the static lifecycle pass (ORX501-ORX506). Disable
+    with ORYX_RESOURCE_LEDGER=0."""
+    wanted = {"chaos", "fleet", "pipeline"}
+    if not (wanted & {m.name for m in request.node.iter_markers()}) or (
+        os.environ.get("ORYX_RESOURCE_LEDGER", "1") == "0"
+    ):
+        yield
+        return
+    import gc
+
+    from oryx_tpu.common.ledger import ledger
+
+    gc.collect()
+    before = ledger.counts()
+    yield
+    # GC-released kinds (fold-in sessions) need the collector to run;
+    # thread probes need the OS thread to actually exit, so give joined
+    # daemon threads a beat to leave is_alive()
+    import time
+
+    gc.collect()
+    after = ledger.counts()
+    deadline = time.monotonic() + 5.0
+    while (
+        any(after.get(k, 0) > before.get(k, 0) for k in after)
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+        gc.collect()
+        after = ledger.counts()
+    leaked = {
+        k: after[k] - before.get(k, 0)
+        for k in after
+        if after[k] > before.get(k, 0)
+    }
+    assert not leaked, (
+        f"resource ledger: leaked {leaked} (before={before}, after={after})"
+    )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -415,8 +415,13 @@ def test_sharded_pipeline_fold_failure_restarts_without_lost_offsets():
     """A shard's fold worker dying (exception -> supervised restart) must
     not lose the batch: it is re-queued in order and its offsets are
     committed once the retried fold publishes."""
-    from oryx_tpu.common import metrics
+    import gc
 
+    from oryx_tpu.common import metrics
+    from oryx_tpu.common.ledger import ledger as resource_ledger
+
+    gc.collect()
+    resources_before = resource_ledger.counts()
     broker_loc = "inproc://shard-death"
     broker = bus.get_broker(broker_loc)
     cfg = make_config(broker_loc, extra="pipeline.shards = 2")
@@ -460,3 +465,14 @@ def test_sharded_pipeline_fold_failure_restarts_without_lost_offsets():
         assert all(t.is_alive() for t in layer._pipeline.threads)
     finally:
         layer.close()
+    # death-and-restart must not accrete resources: every supervised
+    # worker (including the restarted fold chain) and every consumer the
+    # shards owned is gone once close() returns
+    del layer
+    assert wait_until(
+        lambda: (gc.collect() or True)
+        and all(
+            resource_ledger.counts().get(k, 0) <= resources_before.get(k, 0)
+            for k in ("thread", "consumer", "session")
+        )
+    ), (resources_before, resource_ledger.counts())

@@ -95,7 +95,16 @@ def test_rolling_restart_under_load_zero_downtime(tmp_path):
     """Drain-aware rolling restart of every replica, one at a time, while
     the offered rate holds: readiness pulls the draining replica out of
     rotation, in-flight requests finish, a fresh replica replays the
-    topic and rejoins — and no request ever fails."""
+    topic and rejoins — and no request ever fails. The resource ledger
+    must also come back clean: each rotated-out replica's server thread,
+    consume thread, and update consumer die with it."""
+    import gc
+    import time as _time
+
+    from oryx_tpu.common.ledger import ledger as resource_ledger
+
+    gc.collect()
+    resources_before = resource_ledger.counts()
     with FleetHarness(2, str(tmp_path), bus_name="fleet-restart") as fleet:
         gen = fleet.publish(metric=0.90)
         assert fleet.wait_converged(gen, timeout=15.0)
@@ -131,6 +140,25 @@ def test_rolling_restart_under_load_zero_downtime(tmp_path):
         # traffic flowed to both slots across the rotation
         assert result.per_target["replica-0"].ok > 0
         assert result.per_target["replica-1"].ok > 0
+        del originals
+    # the rotation churned 2 replicas + 2 fresh ones through their whole
+    # lifecycle; after harness teardown no thread/consumer/ring may
+    # outlive the test beyond what was live before it
+    del fleet, engine, runner, result
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        gc.collect()
+        after = resource_ledger.counts()
+        if all(
+            after.get(k, 0) <= resources_before.get(k, 0)
+            for k in ("thread", "consumer", "ring")
+        ):
+            break
+        _time.sleep(0.05)
+    assert all(
+        after.get(k, 0) <= resources_before.get(k, 0)
+        for k in ("thread", "consumer", "ring")
+    ), (resources_before, after)
 
 
 def test_rollback_hammered_concurrently_under_traffic(tmp_path):

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Build the native (C++) layer ahead of time.
+
+The library normally builds lazily on first import; this CLI forces the
+build (CI warm-up, container images) and exposes the sanitizer variant:
+
+    python tools/build_native.py             # production -O3 build
+    python tools/build_native.py --sanitize  # ASan+UBSan -O1 build
+
+Exit status: 0 on success OR a clean toolchain-missing skip (so CI can
+call it unconditionally), 1 only when a present toolchain fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from oryx_tpu import native  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--sanitize", action="store_true",
+        help="build the ASan+UBSan instrumented variant instead of the "
+        "production library (separate _build/ artifact)",
+    )
+    args = ap.parse_args(argv)
+
+    if shutil.which("g++") is None:
+        print("build_native: g++ not on PATH; skipping (pure-Python fallback)")
+        return 0
+
+    if args.sanitize:
+        so_path = native.build_sanitized_library()
+        if so_path is None:
+            print("build_native: sanitized build failed with g++ present")
+            return 1
+        runtime = native.find_asan_runtime()
+        print(f"sanitized library: {so_path}")
+        if runtime:
+            print(f"asan runtime:      {runtime}")
+            print(
+                "run the parity suite against it with:\n"
+                f"  LD_PRELOAD={runtime} ASAN_OPTIONS=detect_leaks=0 "
+                "ORYX_NATIVE_SANITIZE=1 python -m pytest tests/native/test_parse.py"
+            )
+        else:
+            print(
+                "asan runtime:      not found (libasan.so missing); the "
+                "sanitized .so cannot be loaded into an uninstrumented python"
+            )
+        return 0
+
+    so_path = native._build_library()
+    if so_path is None:
+        print("build_native: build failed with g++ present")
+        return 1
+    print(f"native library: {so_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

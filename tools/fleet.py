@@ -131,13 +131,21 @@ class FleetHarness:
         return layer
 
     def start(self) -> None:
+        if self._skew_thread is not None or self.replicas:
+            raise RuntimeError("FleetHarness.start() called twice")
         bus.get_broker(self.inner_locator).create_topic(UPDATE_TOPIC, 1)
-        for i in range(self.n_replicas):
-            layer = self._start_replica()
-            self.replicas.append(layer)
-            self.targets.append(
-                Target(f"replica-{i}", f"http://127.0.0.1:{layer.port}")
-            )
+        try:
+            for i in range(self.n_replicas):
+                layer = self._start_replica()
+                self.replicas.append(layer)
+                self.targets.append(
+                    Target(f"replica-{i}", f"http://127.0.0.1:{layer.port}")
+                )
+        except BaseException:
+            # partial fleet bring-up: tear down the replicas that DID
+            # start so an aborted run strands no servers or consumers
+            self.stop()
+            raise
         self._skew_stop.clear()
         self._skew_thread = threading.Thread(
             target=self._watch_skew, name="FleetSkewWatch", daemon=True
@@ -146,10 +154,19 @@ class FleetHarness:
 
     def stop(self) -> None:
         self._skew_stop.set()
-        if self._skew_thread is not None:
-            self._skew_thread.join(timeout=self._skew_poll_s + 2.0)
-        for layer in self.replicas:
-            layer.close()
+        t, self._skew_thread = self._skew_thread, None
+        if t is not None:
+            t.join(timeout=self._skew_poll_s + 2.0)
+        replicas, self.replicas = list(self.replicas), []
+        self.targets.clear()
+        errors = []
+        for layer in replicas:
+            try:
+                layer.close()
+            except Exception as e:  # close the rest before surfacing
+                errors.append(e)
+        if errors:
+            raise errors[0]
 
     def __enter__(self) -> "FleetHarness":
         self.start()
@@ -238,11 +255,16 @@ class FleetHarness:
         complete, the replica closes, and a fresh one takes its slot (and
         its Target, at a new port) once it has replayed the topic."""
         old = self.replicas[replica]
-        old.begin_drain()
-        # let readiness pollers observe the 503 before tearing down
-        time.sleep(0.6)
-        old.drain(drain_s)
-        old.close()
+        try:
+            old.begin_drain()
+            # let readiness pollers observe the 503 before tearing down
+            time.sleep(0.6)
+            old.drain(drain_s)
+        finally:
+            # the old replica must die even when the drain protocol blows
+            # up — a stranded replica keeps its server + consumer alive
+            # and the slot would point at a half-drained layer
+            old.close()
         fresh = self._start_replica()
         self.replicas[replica] = fresh
         self.targets[replica].base_url = f"http://127.0.0.1:{fresh.port}"

@@ -176,6 +176,15 @@ def main() -> None:
     )
     ap.add_argument("--ring-mb", type=int, default=0,
                     help="shm ring size; 0 = auto-size to the prefill")
+    ap.add_argument(
+        "--toggle-env", default=None, metavar="VAR",
+        help="A/B mode for overhead rows: flip this env var 1/0 across "
+        "the timed trials (ABBA order) INSIDE one process, so both arms "
+        "share the same JIT warm-up, memory layout, and host state. "
+        "Per-arm rates land in the JSON as toggle.on / toggle.off. "
+        "Single-trial subprocess A/Bs on a 1-core host measure minutes-"
+        "apart machine drift (±10%% observed), not the toggled feature.",
+    )
     ap.add_argument("--out", default=None, help="append an evidence block here")
     args = ap.parse_args()
     if args.shards < 1:
@@ -276,6 +285,18 @@ def main() -> None:
     typed = args.bus == "shm"
     events_counter = registry.counter("speed.events")
     rates: list[float] = []
+    arms: list[str] = []  # per-trial "on"/"off" when --toggle-env is set
+
+    def set_toggle(trial: int) -> None:
+        """Flip the A/B env var for this timed trial. ABBA order (on, off,
+        off, on, ...) balances both arms against monotonic host drift to
+        first order; anything reading the var per call (e.g. the resource
+        ledger's ``enabled()``) sees the flip immediately."""
+        if not args.toggle_env or trial < 0:
+            return
+        on = trial % 4 in (0, 3)
+        os.environ[args.toggle_env] = "1" if on else "0"
+        arms.append("on" if on else "off")
     shard_rates: list[list[float]] = []
     producers: list[subprocess.Popen] = []
     total_events = total_updates = total_batches = 0
@@ -287,6 +308,7 @@ def main() -> None:
             # pipeline is down (producer cost excluded from the drain)
             first = True
             for trial in range(-1, args.trials):  # trial -1 = warm-up
+                set_toggle(trial)  # before build_layer: registrations flip too
                 n = 100_000 if trial < 0 else args.prefill
                 broker.delete_topic("OryxUpdate")
                 broker.create_topic("OryxUpdate", 1)
@@ -352,6 +374,7 @@ def main() -> None:
             while layer.run_one_batch() or int(events_counter.value) == 0:
                 pass
             for trial in range(args.trials):
+                set_toggle(trial)
                 dt = prefill_events(
                     broker, typed, args.prefill, args.users, args.items,
                     seed=100 + trial,
@@ -395,6 +418,7 @@ def main() -> None:
                 layer.start()  # pipeline workers drain continuously
                 time.sleep(2.0)  # warm-up / fold calibration
                 for trial in range(args.trials):
+                    set_toggle(trial)
                     before = int(events_counter.value)
                     start = time.perf_counter()
                     time.sleep(args.seconds)
@@ -409,6 +433,7 @@ def main() -> None:
             else:
                 layer.run_one_batch()  # warm-up
                 for trial in range(args.trials):
+                    set_toggle(trial)
                     events = updates = batches = 0
                     start = time.perf_counter()
                     deadline = start + args.seconds
@@ -429,9 +454,22 @@ def main() -> None:
     finally:
         Path(stop_path).touch()
         for p in producers:
-            p.wait(timeout=30)
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                # a wedged producer must not strand its sibling processes
+                # or skip the layer teardown below
+                p.kill()
+                p.wait(timeout=10)
         if layer is not None:
             layer.close()
+        if hasattr(broker, "close"):
+            broker.close()  # shm: drop ring mmaps + fds held by this process
+        import shutil
+
+        # in the finally so an aborted run doesn't strand the work dir
+        # (ring files are ring_mb x nparts of disk each)
+        shutil.rmtree(root, ignore_errors=True)
 
     med, spread, flag = summarize(rates)
     framing = "typed-columnar frames" if typed else "text lines"
@@ -484,6 +522,18 @@ def main() -> None:
                 f"shard{s}={r:,.0f}" for s, r in enumerate(shard_medians)
             )
         )
+    toggle: dict | None = None
+    if args.toggle_env and arms:
+        toggle = {
+            "var": args.toggle_env,
+            "on": [round(r, 0) for r, a in zip(rates, arms) if a == "on"],
+            "off": [round(r, 0) for r, a in zip(rates, arms) if a == "off"],
+        }
+        lines.append(
+            f"A/B {args.toggle_env}: "
+            f"on [{', '.join(f'{r:,.0f}' for r in toggle['on'])}] vs "
+            f"off [{', '.join(f'{r:,.0f}' for r in toggle['off'])}] events/s"
+        )
     print("\n".join(lines), flush=True)
     print(
         json.dumps(
@@ -502,16 +552,13 @@ def main() -> None:
                 "spread": round(spread, 3),
                 "shards": args.shards,
                 "vs_baseline": round(med / 100_000.0, 2),
+                **({"toggle": toggle} if toggle else {}),
             }
         )
     )
     if args.out:
         with open(args.out, "a", encoding="utf-8") as f:
             f.write("\n".join(lines) + "\n")
-
-    import shutil
-
-    shutil.rmtree(root, ignore_errors=True)
 
 
 if __name__ == "__main__":
