@@ -1633,6 +1633,288 @@ def bench_serving_open_loop() -> None:
     )
 
 
+def bench_overload() -> None:
+    """Overload-control acceptance rows (docs/overload.md). Two halves:
+
+    - idle admission overhead: closed-loop serving qps through one warm
+      layer with the admission controller wired vs bypassed — the
+      per-request decide() cost at calm pressure must stay <= 2%
+      (median AND best below the 0.98 envelope hard-fails; median-only
+      misses are flagged `noise-suspect` per the repo's noise protocol);
+    - 10x Poisson spike over a 3-replica fleet with 60 ms scripted probe
+      work (saturation is then a function of offered rate alone —
+      Little's law — deterministic on a single-core host): offered vs
+      answered rate, queue-inclusive p99, per-stage shed fractions, zero
+      failed requests and zero 5xx required, plus the seconds until every
+      replica answers at full quality again after the spike ends."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.loadgen import OpenLoopEngine, PoissonProcess, PowerLawUsers
+    from oryx_tpu.serving.layer import ServingLayer
+    from oryx_tpu.serving.overload import SHED_HEADER
+    from tools.fleet import FleetHarness
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    envelope = float(os.environ.get("ORYX_BENCH_OVERLOAD_ENVELOPE", 0.98))
+    failures: list[str] = []
+
+    # --- idle overhead: admission wired vs bypassed, one warm layer -------
+    items = int(os.environ.get("ORYX_BENCH_OVERLOAD_ITEMS", 200_000))
+    users = 10_000
+    seconds = float(os.environ.get("ORYX_BENCH_OVERLOAD_SECONDS", 4.0))
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          id = "BenchOverload"
+          input-topic.broker = "inproc://benchovl"
+          update-topic.broker = "inproc://benchovl"
+          serving {
+            api.port = 0
+            api.read-only = true
+            model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+          }
+        }
+        """
+    )
+    layer = ServingLayer(cfg)
+    layer.start()
+    layer.model_manager.model = build_model(users, items, 50)
+    base = f"http://127.0.0.1:{layer.port}"
+    admission = layer.admission
+    if admission is None:
+        raise RuntimeError("bench overload: admission controller not enabled")
+    try:
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+
+        def one_trial(wired: bool) -> float:
+            # _admit_and_route reads layer.admission per request, so this
+            # is the exact operator toggle (oryx.serving.overload.enabled)
+            layer.admission = admission if wired else None
+            lats: list = []
+            stop = threading.Event()
+            deadline = time.perf_counter() + seconds
+            t1 = time.perf_counter()
+            worker(base, "/recommend/u%d", users, deadline, lats, [], stop)
+            if not lats:
+                raise RuntimeError("bench overload: no requests completed")
+            return len(lats) / (time.perf_counter() - t1)
+
+        # interleave wired/bypassed pairs, alternating order, so the slow
+        # single-core throughput drift over a long run cancels instead of
+        # landing entirely on one arm
+        on: list = []
+        off: list = []
+        for i in range(_TRIALS):
+            if i % 2 == 0:
+                on.append(one_trial(True))
+                off.append(one_trial(False))
+            else:
+                off.append(one_trial(False))
+                on.append(one_trial(True))
+    finally:
+        layer.admission = admission
+        layer.close()
+
+    med_on = statistics.median(on)
+    med_off = max(statistics.median(off), 1e-9)
+    ratio = med_on / med_off
+    best = max(on) / med_off
+    detail = (
+        f"admission wired {med_on:.0f} vs bypassed {med_off:.0f} queries/sec "
+        f"(medians of {len(on)}/{len(off)} trials), overhead "
+        f"{100 * (1 - ratio):.2f}%, envelope <= {100 * (1 - envelope):.0f}%"
+    )
+    print(f"bench[overload idle]: {detail}", file=sys.stderr)
+    _emit(
+        "overload admission idle overhead, closed-loop serving, controller "
+        f"wired vs bypassed (vs_baseline = wired/bypassed ratio, floor "
+        f"{envelope})",
+        med_on,
+        "queries/sec",
+        ratio,
+        order=43,
+        detail=detail,
+        off_value=round(med_off, 2),
+        overhead_pct=round(100 * (1 - ratio), 3),
+        noise_suspect=ratio < envelope <= best,
+        spread=[round(float(min(on)), 2), round(float(max(on)), 2)],
+        trials=len(on),
+    )
+    if ratio < envelope and best < envelope:
+        failures.append(f"idle overhead: wired/bypassed {ratio:.4f} < {envelope}")
+
+    # --- 10x spike over 3 replicas, scripted 60 ms probe work -------------
+    base_rate = float(os.environ.get("ORYX_BENCH_OVERLOAD_BASE_RATE", 25.0))
+    spike_rate = 10.0 * base_rate
+    recovery_cap_s = 20.0
+    recovery_budget_s = 10.0
+    # same tuning as test_spike_absorbed_by_staged_shedding_zero_5xx: the
+    # tightened ladder knobs let the controller walk rungs within the
+    # few-second phases of one trial
+    overlay = """
+        oryx {
+          serving.overload {
+            inflight-target = 4
+            hold-s = 0.2
+            control-interval-ms = 25
+            alpha = 0.5
+          }
+          test.probe-work-ms = 60
+        }
+        """
+
+    def fivexx_total(fleet) -> float:
+        total = 0.0
+        for replica in fleet.replicas:
+            snap = replica.instance_metrics.snapshot()
+            entry = snap.get("serving.responses.5xx") or {}
+            total += float(entry.get("value") or 0.0)
+        return total
+
+    trials: list[dict] = []
+    for t in range(_TRIALS):
+        with tempfile.TemporaryDirectory() as tmp:
+            with FleetHarness(
+                3, tmp, bus_name=f"benchovl{t}", overlay=overlay
+            ) as fleet:
+                gen = fleet.publish(metric=0.90)
+                if not fleet.wait_converged(gen, timeout=30.0):
+                    raise RuntimeError("bench overload: fleet never converged")
+
+                def run_phase(rate, secs, seed):
+                    engine = OpenLoopEngine(
+                        fleet.targets,
+                        template="/probe/recommend/u%d",
+                        readiness_poll_s=0.1,
+                    )
+                    return engine.run(
+                        PoissonProcess(rate=rate, seed=seed),
+                        PowerLawUsers(100_000, seed=seed),
+                        secs,
+                    )
+
+                baseline = run_phase(base_rate, 2.0, seed=31 + t)
+                spike = run_phase(spike_rate, 2.5, seed=47 + t)
+
+                # recovery: seconds from spike end until every replica
+                # answers 3 straight probes at full quality (no shed
+                # header, no 429) — the probes themselves drive the
+                # controllers' release evaluations
+                t0 = time.perf_counter()
+                recovery_s = recovery_cap_s
+                streak = 0
+                while time.perf_counter() - t0 < recovery_cap_s:
+                    full = True
+                    for target in fleet.targets:
+                        try:
+                            with urllib.request.urlopen(
+                                target.base_url + "/probe/recommend/u1",
+                                timeout=10,
+                            ) as resp:
+                                resp.read()
+                                if resp.headers.get(SHED_HEADER):
+                                    full = False
+                        except urllib.error.HTTPError:
+                            full = False
+                    streak = streak + 1 if full else 0
+                    if streak >= 3:
+                        recovery_s = time.perf_counter() - t0
+                        break
+                    time.sleep(0.05)
+
+                q = spike.quality()
+                trials.append(
+                    {
+                        "answered_qps": (spike.ok + spike.shed)
+                        / max(spike.duration_s, 1e-9),
+                        "offered_qps": spike.offered_rate,
+                        "p99_ms": spike.latency_quantile(0.99) * 1000.0,
+                        "failed": baseline.failed + spike.failed,
+                        "fivexx": fivexx_total(fleet),
+                        "q_full": q["full"],
+                        "q_reduced": q["reduced-probe"],
+                        "q_stale": q["stale"],
+                        "q_shed": q["shed"],
+                        "recovery_s": recovery_s,
+                    }
+                )
+
+    med = _median_run(trials, "answered_qps")
+    answered = [r["answered_qps"] for r in trials]
+    clean = med["failed"] == 0 and med["fivexx"] == 0
+    detail = (
+        f"10x Poisson spike over 3 replicas ({med['offered_qps']:.0f} rps "
+        f"offered, 60 ms scripted probe work): answered "
+        f"{med['answered_qps']:.0f} rps (ok + deliberate 429 sheds), "
+        f"queue-inclusive p99 {med['p99_ms']:.0f} ms, quality "
+        f"full/reduced/stale/shed = {med['q_full']:.2f}/{med['q_reduced']:.2f}"
+        f"/{med['q_stale']:.2f}/{med['q_shed']:.2f}, "
+        f"{int(med['failed'])} failed, {int(med['fivexx'])} 5xx"
+    )
+    print(f"bench[overload spike]: {detail}", file=sys.stderr)
+    _emit(
+        "overload 10x spike, 3 replicas: answered rate under staged "
+        "shedding (vs_baseline = answered/offered with zero failures and "
+        "zero 5xx required)",
+        med["answered_qps"],
+        "responses/sec",
+        (med["answered_qps"] / max(med["offered_qps"], 1e-9)) if clean else 0.0,
+        order=44,
+        detail=detail,
+        offered_rate=med["offered_qps"],
+        p99_ms=med["p99_ms"],
+        quality_full=med["q_full"],
+        quality_reduced_probe=med["q_reduced"],
+        quality_stale=med["q_stale"],
+        quality_shed=med["q_shed"],
+        failed=int(med["failed"]),
+        responses_5xx=int(med["fivexx"]),
+        replicas=3,
+        spread=[round(min(answered), 2), round(max(answered), 2)],
+        trials=len(trials),
+    )
+    for r in trials:
+        if r["failed"] or r["fivexx"]:
+            failures.append(
+                f"spike trial: {int(r['failed'])} failed, "
+                f"{int(r['fivexx'])} 5xx (both must be 0)"
+            )
+    if med["q_full"] >= 1.0:
+        failures.append("spike: shed ladder never engaged (quality full = 1.0)")
+
+    recs = [r["recovery_s"] for r in trials]
+    med_rec = statistics.median(recs)
+    detail = (
+        f"seconds from spike end until all 3 replicas answer 3 straight "
+        f"probes at full quality: median {med_rec:.2f}s over {len(recs)} "
+        f"trials (budget {recovery_budget_s:.0f}s, poll cap {recovery_cap_s:.0f}s)"
+    )
+    print(f"bench[overload recovery]: {detail}", file=sys.stderr)
+    _emit(
+        "overload recovery after 10x spike: seconds until every replica "
+        f"answers at full quality again (vs_baseline = {recovery_budget_s:.0f}s "
+        "budget / measured, >= 1.0 = inside budget)",
+        med_rec,
+        "seconds",
+        recovery_budget_s / max(med_rec, 1e-9),
+        order=45,
+        detail=detail,
+        spread=[round(min(recs), 2), round(max(recs), 2)],
+        trials=len(recs),
+    )
+    if med_rec > recovery_budget_s:
+        failures.append(f"recovery {med_rec:.2f}s > {recovery_budget_s:.0f}s budget")
+
+    if failures:
+        raise RuntimeError("overload bench failed: " + "; ".join(failures))
+
+
 BENCHES = [
     ("kmeans", bench_kmeans),
     ("als", bench_als),
@@ -1641,6 +1923,7 @@ BENCHES = [
     ("tracing-overhead", bench_tracing_overhead),
     ("lock-watchdog", bench_lock_watchdog_overhead),
     ("resource-ledger", bench_ledger_overhead),
+    ("overload", bench_overload),
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),

@@ -10,6 +10,8 @@ of truth for each knob set) does not declare.
 
 Linted prefixes:
   oryx.serving.scan.ann   — ANN tier of the serving scan
+  oryx.serving.overload   — admission control / shed ladder
+  oryx.fleet.autoscale    — predictive fleet autoscaler
   oryx.bus.shm            — shared-memory ring transport
   oryx.speed.pipeline     — three-stage speed-layer pipeline
   oryx.tracing            — distributed tracer (common/tracing.py)
@@ -33,6 +35,8 @@ ANN_PREFIX = "oryx.serving.scan.ann"
 LINTED_PREFIXES = (
     ANN_PREFIX,
     "oryx.bus.shm",
+    "oryx.fleet.autoscale",
+    "oryx.serving.overload",
     "oryx.speed.parse",
     "oryx.speed.pipeline",
     "oryx.tracing",

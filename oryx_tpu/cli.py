@@ -293,6 +293,15 @@ def run_health(cfg: Config, out=None) -> int:
             detail = None
         if endpoint == "/healthz" and isinstance(detail, dict):
             live_generation = detail.get("live_generation")
+            # unified operator verdict (ok/degraded/draining/down) plus the
+            # overload ladder's current rung when it is shedding quality
+            unified = detail.get("status")
+            if unified is not None:
+                shed = detail.get("shed_stage")
+                summary = f"status={unified}"
+                if shed and shed != "full":
+                    summary += f" shed_stage={shed}"
+                print(f"{endpoint}: {summary}", file=out)
         print(f"{endpoint}: {status}" + (f" {detail}" if detail is not None else ""), file=out)
         ok = ok and status == 200
 

@@ -43,6 +43,13 @@ from oryx_tpu.common.metrics import SLOWindow
 
 __all__ = ["LoadResult", "OpenLoopEngine", "RequestRecord", "Target", "classify_error"]
 
+# Mirrors oryx_tpu.serving.overload.SHED_HEADER / STAGE_NAMES — declared
+# locally because importing the serving package would drag the whole
+# layer (and jax) into the loadgen client; tests/serving/test_overload.py
+# asserts the two stay in sync.
+SHED_HEADER = "X-Oryx-Shed-Stage"
+SHED_STAGES = ("full", "reduced-probe", "stale", "shed")
+
 
 def classify_error(exc: Exception) -> str:
     """Map a request exception to an error KIND — timeouts must never be
@@ -70,6 +77,7 @@ class Target:
         self.slo = SLOWindow()
         self.ok = 0
         self.failed = 0
+        self.shed = 0  # deliberate 429s from the overload ladder
         self.error_kinds: Counter = Counter()
 
     def __repr__(self) -> str:  # pragma: no cover
@@ -87,6 +95,9 @@ class RequestRecord:
     # sampled requests carry a traceparent header, so the client-side
     # record can be joined against the server's spans in GET /trace
     trace_id: str | None = None
+    # the X-Oryx-Shed-Stage response header: which overload-ladder rung
+    # actually served the answer ("full" when absent)
+    shed_stage: str = "full"
 
 
 @dataclass
@@ -101,6 +112,11 @@ class LoadResult:
     queued_arrivals: int  # arrivals that found all workers busy
     peak_inflight: int
     per_target: dict[str, Target]
+    # deliberate overload-ladder 429s (X-Oryx-Shed-Stage: shed). Counted
+    # separately from `failed`: a shed is the server absorbing excess load
+    # by design, not an outage — "zero failed requests" stays assertable
+    # through a spike while quality() reports what the shedding cost.
+    shed: int = 0
 
     @property
     def offered_rate(self) -> float:
@@ -126,6 +142,19 @@ class LoadResult:
             return 0.0
         return svc[min(len(svc) - 1, int(q * len(svc)))]
 
+    def quality(self) -> dict[str, float]:
+        """Fraction of ANSWERED requests served at each ladder stage —
+        the achieved-quality dimension next to latency. Answered = ok
+        responses plus deliberate sheds (the 429 IS the ladder's answer);
+        genuine failures are excluded, they're accounted in `failed`."""
+        answered = [r for r in self.records if r.ok or r.kind == "shed"]
+        if not answered:
+            return {stage: 0.0 for stage in SHED_STAGES}
+        counts = Counter(r.shed_stage for r in answered)
+        return {
+            stage: counts.get(stage, 0) / len(answered) for stage in SHED_STAGES
+        }
+
     def summary(self) -> dict:
         return {
             "duration_s": round(self.duration_s, 3),
@@ -134,6 +163,8 @@ class LoadResult:
             "achieved_rate": round(self.achieved_rate, 2),
             "ok": self.ok,
             "failed": self.failed,
+            "shed": self.shed,
+            "quality": {k: round(v, 4) for k, v in self.quality().items()},
             "error_rate": round(self.error_rate, 6),
             "error_kinds": dict(self.error_kinds),
             "p50_ms": round(self.latency_quantile(0.50) * 1000, 2),
@@ -142,7 +173,12 @@ class LoadResult:
             "queued_arrivals": self.queued_arrivals,
             "peak_inflight": self.peak_inflight,
             "per_target": {
-                name: {"ok": t.ok, "failed": t.failed, "errors": dict(t.error_kinds)}
+                name: {
+                    "ok": t.ok,
+                    "failed": t.failed,
+                    "shed": t.shed,
+                    "errors": dict(t.error_kinds),
+                }
                 for name, t in self.per_target.items()
             },
         }
@@ -205,6 +241,7 @@ class OpenLoopEngine:
         target = self._pick_target()
         ok = False
         kind = "ok"
+        shed_stage = "full"
         # client root span: sampled requests ship their context as a
         # traceparent header, so the server's serving.request (and the
         # queue-wait/scan/rescore spans under it) land in the same trace
@@ -220,8 +257,19 @@ class OpenLoopEngine:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     resp.read()
                     ok = 200 <= resp.status < 300
+                    shed_stage = resp.headers.get(SHED_HEADER) or "full"
                     if not ok:  # non-2xx that didn't raise (3xx)
                         kind = f"http-{resp.status // 100}xx"
+            except urllib.error.HTTPError as e:
+                # a 429 stamped by the shed ladder is the overload
+                # controller doing its job — account it as shed load,
+                # not as a failure
+                stage = e.headers.get(SHED_HEADER) if e.headers else None
+                if e.code == 429 and stage == "shed":
+                    kind = "shed"
+                    shed_stage = "shed"
+                else:
+                    kind = classify_error(e)
             except Exception as e:  # noqa: BLE001 - classified, not swallowed
                 kind = classify_error(e)
         t_end = time.perf_counter()
@@ -239,15 +287,22 @@ class OpenLoopEngine:
             ok=ok,
             kind=kind,
             trace_id=ctx.trace_id if ctx is not None else None,
+            shed_stage=shed_stage,
         )
         with self._lock:
             sink.append(rec)
             self._inflight -= 1
         if target is not None:
-            target.slo.record(ok, rec.latency)
+            if kind != "shed":
+                # sheds stay out of the SLO window: the 429 is deliberate
+                # absorption, not an error burning budget, and its tiny
+                # latency would skew the quantiles the SLO is about
+                target.slo.record(ok, rec.latency)
             with self._lock:
                 if ok:
                     target.ok += 1
+                elif kind == "shed":
+                    target.shed += 1
                 else:
                     target.failed += 1
                     target.error_kinds[kind] += 1
@@ -296,8 +351,9 @@ class OpenLoopEngine:
                 poller.join(timeout=self.readiness_poll_s + self.timeout_s + 1.0)
         with self._lock:
             recs = list(records)
-        kinds = Counter(r.kind for r in recs if not r.ok)
+        kinds = Counter(r.kind for r in recs if not r.ok and r.kind != "shed")
         n_ok = sum(1 for r in recs if r.ok)
+        n_shed = sum(1 for r in recs if r.kind == "shed")
         return LoadResult(
             # rates are over the SCHEDULED window: the post-deadline tail
             # draining responses is not extra serving time
@@ -305,10 +361,11 @@ class OpenLoopEngine:
             offered=offered,
             completed=len(recs),
             ok=n_ok,
-            failed=len(recs) - n_ok,
+            failed=len(recs) - n_ok - n_shed,
             error_kinds=kinds,
             records=recs,
             queued_arrivals=queued,
             peak_inflight=self._peak_inflight,
             per_target={t.name: t for t in self.targets},
+            shed=n_shed,
         )

@@ -48,6 +48,11 @@ class SLOSpec:
     error_rate: float = 0.0
     window_s: float = 5.0
     max_burn: float = 1.0
+    # quality dimension: minimum fraction of answered requests that must
+    # be served at FULL quality (shed-ladder stage 0). None = no quality
+    # assertion. Deliberate sheds never count against error_rate — they
+    # count against this instead.
+    min_full_quality: float | None = None
 
 
 @dataclass
@@ -58,6 +63,9 @@ class SLOVerdict:
     failed_requests: int
     burn_rates: dict[str, float] = field(default_factory=dict)  # scope -> burn
     violations: list[str] = field(default_factory=list)
+    # fraction of answered requests per shed-ladder stage (engine
+    # LoadResult.quality()); {} for runs recorded before the ladder
+    quality: dict[str, float] = field(default_factory=dict)
 
     def __bool__(self) -> bool:  # `assert verdict, verdict.violations`
         return self.passed
@@ -81,6 +89,15 @@ def evaluate_slo(result: LoadResult, spec: SLOSpec) -> SLOVerdict:
         violations.append(
             f"fleet error rate {result.error_rate:.5f} > SLO {spec.error_rate:.5f}"
         )
+    quality = result.quality()
+    if spec.min_full_quality is not None:
+        full = quality.get("full", 0.0)
+        if full < spec.min_full_quality:
+            violations.append(
+                f"quality SLO: {full:.4f} full-quality answers < "
+                f"required {spec.min_full_quality:.4f} "
+                f"(per-stage {quality})"
+            )
     burns: dict[str, float] = {}
     for name, target in result.per_target.items():
         burn = target.slo.error_burn_rate(spec.window_s, spec.error_rate)
@@ -97,6 +114,7 @@ def evaluate_slo(result: LoadResult, spec: SLOSpec) -> SLOVerdict:
         failed_requests=result.failed,
         burn_rates=burns,
         violations=violations,
+        quality=quality,
     )
 
 

@@ -518,6 +518,7 @@ def submit_top_k_multi(
     k: int,
     cosine: bool = False,
     scan_batch: int = 256,
+    nprobe: int | None = None,
 ) -> MultiTopNHandle:
     """Fused form of submit_top_k: ceil(n / scan_batch) full-matrix scans
     run inside ONE device dispatch (lax.map), so per-dispatch host work
@@ -528,8 +529,11 @@ def submit_top_k_multi(
     q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
     if isinstance(uploaded, IVFIndex):
         # the IVF program does its own QUERY_BLOCK grouping (lax.map over
-        # groups inside one dispatch), so the whole batch submits at once
-        vals, ids = ivf_ops.top_k_device(uploaded, q, k, cosine=cosine)
+        # groups inside one dispatch), so the whole batch submits at once.
+        # `nprobe` overrides the index default per call (overload control's
+        # reduced-probe rung); ignored for non-IVF handles below, which
+        # have no probe concept.
+        vals, ids = ivf_ops.top_k_device(uploaded, q, k, cosine=cosine, nprobe=nprobe)
         return _async_multi_handle(vals[None], ids[None], q.shape[0])
     q_kb, n = _group_pad(q, scan_batch)
     dl = _auto_download_dtype(uploaded)
@@ -691,6 +695,7 @@ def submit_top_k_multi_indexed(
     k: int,
     cosine: bool = False,
     scan_batch: int = 256,
+    nprobe: int | None = None,
 ) -> MultiTopNHandle:
     """submit_top_k_multi with the query VECTORS already device-resident:
     the host ships only int32 row indices into ``x_dev`` (4 B/query vs
@@ -704,7 +709,7 @@ def submit_top_k_multi_indexed(
     idx = np.atleast_1d(np.asarray(indices, dtype=np.int32))
     if isinstance(uploaded, IVFIndex):
         vals, ids = ivf_ops.top_k_device_indexed(
-            uploaded, x_dev, idx, k, cosine=cosine
+            uploaded, x_dev, idx, k, cosine=cosine, nprobe=nprobe
         )
         return _async_multi_handle(vals[None], ids[None], len(idx))
     idx_kb_np, n = _group_pad(idx, scan_batch)
@@ -724,14 +729,17 @@ def submit_top_k_multi_indexed(
 
 
 def submit_top_k(
-    uploaded, queries: np.ndarray, k: int, cosine: bool = False
+    uploaded, queries: np.ndarray, k: int, cosine: bool = False,
+    nprobe: int | None = None,
 ) -> TopNHandle:
     """Enqueue a batched top-k without waiting: device compute and the
     device→host copy both run asynchronously. Keeping a window of
-    handles in flight pipelines transfers behind compute."""
+    handles in flight pipelines transfers behind compute. ``nprobe``
+    overrides the IVF index's default probe count per call (the overload
+    controller's reduced-probe rung); ignored for non-IVF handles."""
     if isinstance(uploaded, IVFIndex):
         vals, ids = ivf_ops.top_k_device(
-            uploaded, np.atleast_2d(queries), k, cosine=cosine
+            uploaded, np.atleast_2d(queries), k, cosine=cosine, nprobe=nprobe
         )
         try:
             vals.copy_to_host_async()

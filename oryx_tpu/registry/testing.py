@@ -18,6 +18,7 @@ importable module path.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterator
 
 from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
@@ -120,5 +121,11 @@ def probe_recommend(ctx: ServingContext, req: Request) -> Response:
     model = ctx.model_manager.get_model() if ctx.model_manager else None
     if model is None:
         raise OryxServingException(503, "model not yet available")
+    # test-only overlay knob: scripted per-request service time, so the
+    # overload/autoscale fleet tests can saturate a replica at a known
+    # rate (Little's law) deterministically on a single-core host
+    work_ms = ctx.config.get_optional_float("oryx.test.probe-work-ms") if ctx.config else None
+    if work_ms:
+        time.sleep(work_ms / 1000.0)
     body = {"user": req.params["userID"], "generation_id": model.generation_id}
     return Response(200, body, content_type="application/json")

@@ -60,6 +60,7 @@ import numpy as np
 from oryx_tpu.common import tracing
 from oryx_tpu.common.metrics import registry as _metrics
 from oryx_tpu.ops import topn as topn_ops
+from oryx_tpu.serving.overload import active_probe_fraction
 
 log = logging.getLogger(__name__)
 
@@ -72,11 +73,28 @@ MAX_ADAPTIVE_BATCH = 4096
 MIN_INFLIGHT = 2  # always enough to overlap host prep with device work
 MAX_INFLIGHT = 32
 
+# Queue-wait EWMA (the admission controller's pressure signal): smoothing
+# factor per dispatch, plus an idle decay so the signal fades once the
+# queue goes quiet — without it a burst's last reading would pin the shed
+# ladder engaged long after the overload passed.
+WAIT_EWMA_ALPHA = 0.3
+WAIT_DECAY_GRACE_S = 0.25
+WAIT_DECAY_HALF_LIFE_S = 0.5
+
 
 class BatcherClosedError(RuntimeError):
     """Raised by ``score`` when the batcher was closed before the entry
     could be enqueued; distinguishes the benign close race from device
     errors so ``score_default`` never retries a real failure."""
+
+
+class BatcherOverloadedError(RuntimeError):
+    """Raised by ``score`` when the bounded queue
+    (``oryx.serving.overload.max-queue``) is full at enqueue: the caller
+    gets an immediate shed decision instead of the unbounded
+    queued-behind-pipeline wait BENCH_r05 measured at 8.9-18 s p99.
+    Deliberately NOT retried by ``score_default`` — the serving layer maps
+    it to a fast 429 with Retry-After."""
 
 
 @dataclass
@@ -98,6 +116,13 @@ class _Entry:
     t_enqueue: float = 0.0
     t_dispatch: float = 0.0
     t_submit: float = 0.0
+    # overload control: monotonic enqueue stamp feeding the queue-wait
+    # EWMA (always set, unlike the tracing stamps), plus the per-request
+    # reduced-probe override snapshotted from the admission contextvar on
+    # the request thread — it rides the entry across to the dispatcher.
+    t_q: float = 0.0
+    probe_fraction: float | None = None
+    nprobe_applied: int | None = None
 
 
 def _k_bucket(k: int) -> int:
@@ -125,12 +150,15 @@ def _record_entry_spans(e: _Entry, t_done: float) -> None:
     """
     ctx = e.trace_ctx
     attrs = None
-    resolve_nprobe = getattr(e.uploaded, "resolve_nprobe", None)
-    if resolve_nprobe is not None:
-        try:
-            attrs = {"nprobe": int(resolve_nprobe())}
-        except Exception:
-            attrs = None
+    if e.nprobe_applied is not None:
+        attrs = {"nprobe": e.nprobe_applied, "probe_fraction": e.probe_fraction}
+    else:
+        resolve_nprobe = getattr(e.uploaded, "resolve_nprobe", None)
+        if resolve_nprobe is not None:
+            try:
+                attrs = {"nprobe": int(resolve_nprobe())}
+            except Exception:
+                attrs = None
     tracing.record_span(
         "serving.queue-wait", ctx.child(), ctx.span_id,
         e.t_enqueue, e.t_dispatch - e.t_enqueue,
@@ -156,7 +184,10 @@ class TopNBatcher:
     MULTI_THRESHOLD = 256
 
     def __init__(
-        self, max_batch: int | None = None, max_inflight: int | None = None
+        self,
+        max_batch: int | None = None,
+        max_inflight: int | None = None,
+        max_queue: int | None = None,
     ) -> None:
         # None => adaptive: the completer resizes the knob from its EWMA
         # of dispatch latency; an explicit value pins it (legacy behavior,
@@ -167,7 +198,13 @@ class TopNBatcher:
         self._inflight_cap = (
             MIN_INFLIGHT + 2 if max_inflight is None else int(max_inflight)
         )
+        # bounded queue (oryx.serving.overload.max-queue): None = unbounded
+        self._max_queue = None if max_queue is None else int(max_queue)
         self._ewma_ms: float | None = None
+        # queue-wait EWMA (the admission controller's primary pressure
+        # signal); guarded by _flight_cv like the dispatch EWMA
+        self._queue_wait_ewma_ms = 0.0
+        self._last_wait_obs = time.monotonic()
         self._queue: queue.Queue[_Entry | None] = queue.Queue()
         self._pending: queue.Queue = queue.Queue()
         # inflight tracked under a Condition (not a Semaphore) so the
@@ -215,10 +252,22 @@ class TopNBatcher:
             if ctx is not None and ctx.sampled:
                 e.trace_ctx = ctx
                 e.t_enqueue = time.time()
+        # snapshot the admission controller's reduced-probe override here,
+        # on the request thread that carries the contextvar
+        e.probe_fraction = active_probe_fraction()
+        e.t_q = time.monotonic()
         with self._state_lock:  # an entry can never land after the sentinel
             if self._closed:
                 raise BatcherClosedError("batcher is closed")
+            if self._max_queue is not None and self._queue.qsize() >= self._max_queue:
+                # approximate bound (qsize races concurrent enqueues by a
+                # few entries) — exactness doesn't matter, unboundedness does
+                _metrics.counter("serving.batcher.queue.rejected").inc()
+                raise BatcherOverloadedError(
+                    f"batcher queue full ({self._max_queue} entries)"
+                )
             self._queue.put(e)
+            _metrics.gauge("serving.batcher.queue.depth").set(self._queue.qsize())
         e.done.wait()
         if e.error is not None:
             raise e.error
@@ -263,6 +312,7 @@ class TopNBatcher:
         if coalesced:
             _metrics.counter("serving.batcher.coalesced").inc(coalesced)
         _metrics.gauge("serving.batcher.queue_depth").set(self._queue.qsize())
+        _metrics.gauge("serving.batcher.queue.depth").set(self._queue.qsize())
         _metrics.gauge("serving.batcher.batch_size").set(len(batch))
         return batch
 
@@ -272,14 +322,18 @@ class TopNBatcher:
             if batch is None:
                 self._pending.put(None)
                 return
-            # group by (matrix snapshot, cosine, query-matrix snapshot):
-            # indices are only meaningful against the snapshots the caller
-            # captured, and vector entries never mix with index entries
+            # group by (matrix snapshot, cosine, query-matrix snapshot,
+            # probe override): indices are only meaningful against the
+            # snapshots the caller captured, vector entries never mix with
+            # index entries, and a reduced-probe request must not widen a
+            # full-probe neighbour's scan (or vice versa)
             groups: dict[tuple, list[_Entry]] = {}
             for e in batch:
                 xk = id(e.x_dev) if e.row is not None else None
-                groups.setdefault((id(e.uploaded), e.cosine, xk), []).append(e)
-            for (_, cosine, _xk), entries in groups.items():
+                groups.setdefault(
+                    (id(e.uploaded), e.cosine, xk, e.probe_fraction), []
+                ).append(e)
+            for (_, cosine, _xk, _pf), entries in groups.items():
                 self._submit_group(entries, cosine)
 
     def _acquire_slot(self) -> None:
@@ -322,10 +376,58 @@ class TopNBatcher:
                 self.max_batch *= 2
             self.max_batch = max(MIN_ADAPTIVE_BATCH, min(self.max_batch, MAX_ADAPTIVE_BATCH))
 
+    def _group_nprobe(self, entries: list[_Entry]) -> int | None:
+        """Resolve a reduced-probe override into a concrete ``nprobe`` for
+        one coalesced group (all entries share the same probe fraction by
+        group key). None when the group runs at full quality or the handle
+        is not an IVF index."""
+        pf = entries[0].probe_fraction
+        if pf is None:
+            return None
+        resolve = getattr(entries[0].uploaded, "resolve_nprobe", None)
+        if resolve is None:
+            return None
+        try:
+            nprobe = max(1, int(resolve() * pf))
+        except Exception:
+            return None
+        for e in entries:
+            e.nprobe_applied = nprobe
+        return nprobe
+
+    def _observe_queue_wait(self, entries: list[_Entry]) -> None:
+        """EWMA the worst enqueue->dispatch wait of the group — the
+        admission controller's primary pressure signal."""
+        now = time.monotonic()
+        wait_ms = max(now - e.t_q for e in entries) * 1000.0
+        with self._flight_cv:
+            self._queue_wait_ewma_ms = (
+                WAIT_EWMA_ALPHA * wait_ms
+                + (1.0 - WAIT_EWMA_ALPHA) * self._queue_wait_ewma_ms
+            )
+            self._last_wait_obs = now
+            _metrics.gauge("serving.batcher.queue.wait-ewma-ms").set(
+                self._queue_wait_ewma_ms
+            )
+
+    def queue_wait_ewma_ms(self) -> float:
+        """Current queue-wait EWMA with idle decay: when no dispatches
+        happen (queue went quiet) the signal halves every
+        ``WAIT_DECAY_HALF_LIFE_S`` so the shed ladder can release even
+        though nothing is flowing to refresh the EWMA."""
+        now = time.monotonic()
+        with self._flight_cv:
+            idle = now - self._last_wait_obs
+            ewma = self._queue_wait_ewma_ms
+        if idle <= WAIT_DECAY_GRACE_S:
+            return ewma
+        return ewma * 0.5 ** ((idle - WAIT_DECAY_GRACE_S) / WAIT_DECAY_HALF_LIFE_S)
+
     def _submit_group(self, entries: list[_Entry], cosine: bool) -> None:
         self._acquire_slot()
         # queue-wait ends here: the entry has a dispatcher AND an inflight
         # slot (slot contention is backpressure, i.e. still queueing)
+        self._observe_queue_wait(entries)
         for e in entries:
             if e.trace_ctx is not None:
                 e.t_dispatch = time.time()
@@ -333,6 +435,7 @@ class TopNBatcher:
             if entries[0].row is not None:
                 self._submit_indexed(entries, cosine)
                 return
+            nprobe = self._group_nprobe(entries)
             queries = np.stack([e.query for e in entries])
             kk = _k_bucket(max(e.k for e in entries))
             if len(entries) > self.MULTI_THRESHOLD:
@@ -344,6 +447,7 @@ class TopNBatcher:
                     kk,
                     cosine=cosine,
                     scan_batch=self.MULTI_THRESHOLD,
+                    nprobe=nprobe,
                 )
             else:
                 pad_rows = _b_bucket(len(entries)) - len(entries)
@@ -352,7 +456,7 @@ class TopNBatcher:
                         [queries, np.zeros((pad_rows, queries.shape[1]), queries.dtype)]
                     )
                 handle = topn_ops.submit_top_k(
-                    entries[0].uploaded, queries, kk, cosine=cosine
+                    entries[0].uploaded, queries, kk, cosine=cosine, nprobe=nprobe
                 )
             for e in entries:
                 if e.trace_ctx is not None:
@@ -369,6 +473,7 @@ class TopNBatcher:
         inflight slot; errors deliver to waiters exactly like the vector
         path)."""
         try:
+            nprobe = self._group_nprobe(entries)
             rows = np.asarray([e.row for e in entries], dtype=np.int32)
             kk = _k_bucket(max(e.k for e in entries))
             pad = _b_bucket(len(rows)) - len(rows)
@@ -381,6 +486,7 @@ class TopNBatcher:
                 kk,
                 cosine=cosine,
                 scan_batch=self.MULTI_THRESHOLD,
+                nprobe=nprobe,
             )
             for e in entries:
                 if e.trace_ctx is not None:
@@ -442,16 +548,31 @@ def configure_scheduler(
     max_batch: int | None = None,
     max_inflight: int | None = None,
     latency_budget_ms: float | None = None,
+    max_queue: int | None = None,
 ) -> None:
     """Pin the process-wide batcher's scheduler knobs (the serving layer
-    maps ``oryx.serving.scan.*`` here at startup, before the default
-    batcher spins up). ``None`` leaves a knob adaptive."""
+    maps ``oryx.serving.scan.*`` / ``oryx.serving.overload.max-queue``
+    here at startup, before the default batcher spins up). ``None`` leaves
+    a knob adaptive (for ``max_queue``: unbounded)."""
     global LATENCY_BUDGET_MS
     with _default_lock:
         _default_init["max_batch"] = max_batch
         _default_init["max_inflight"] = max_inflight
+        _default_init["max_queue"] = max_queue
         if latency_budget_ms is not None:
             LATENCY_BUDGET_MS = float(latency_budget_ms)
+
+
+def default_batcher_signals() -> tuple[float, int]:
+    """(queue_wait_ewma_ms, queue_depth) of the live default batcher, or
+    zeros when none is running — the admission controller polls this on
+    its control interval, so the idle fast path must stay cheap and must
+    never lazily create a batcher."""
+    with _default_lock:
+        b = _default
+    if b is None or b._closed:
+        return 0.0, 0
+    return b.queue_wait_ewma_ms(), b._queue.qsize()
 
 
 def get_default_batcher() -> TopNBatcher:

@@ -37,6 +37,7 @@ from oryx_tpu.common import metrics, profiling, tracing
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
+from oryx_tpu.serving import overload as _overload
 from oryx_tpu.serving.web import (
     OryxServingException,
     Request,
@@ -252,13 +253,30 @@ def _ready(ctx: ServingContext, req: Request) -> Response:
 def _healthz(ctx: ServingContext, req: Request) -> Response:
     """Liveness + degraded-mode report. 200 while the process can serve —
     including degraded (update stream down, answering from the last good
-    model); 503 only when the update consumer has given up for good."""
+    model); 503 only when the update consumer has given up for good.
+
+    The ``status`` field unifies the two degraded-mode notions (last-good
+    -model serving per reference.conf's degraded contract, and the shed
+    ladder's reduced-quality stages) into one operator-facing word:
+    down > draining > degraded > ok; ``shed_stage`` names the ladder rung
+    currently serving answers. ``cli health`` renders exactly this."""
     health = ctx.health
     if health is None:
         return Response(200, {"alive": True}, content_type="application/json")
+    stage = ctx.admission.stage if ctx.admission is not None else _overload.STAGE_FULL
+    if not health.alive:
+        status = "down"
+    elif health.draining:
+        status = "draining"
+    elif health.degraded or stage > _overload.STAGE_FULL:
+        status = "degraded"
+    else:
+        status = "ok"
     body = {
         "alive": health.alive,
-        "degraded": health.degraded,
+        "degraded": health.degraded or stage > _overload.STAGE_FULL,
+        "status": status,
+        "shed_stage": _overload.STAGE_NAMES[stage],
         "stream_healthy": health.stream_healthy,
         "staleness_seconds": health.staleness(),
         "live_generation": health.live_generation,
@@ -547,6 +565,9 @@ class ServingLayer:
             latency_budget_ms=config.get_optional_float(
                 "oryx.serving.scan.latency-budget-ms"
             ),
+            # bounded queue: full queue => immediate shed decision instead
+            # of the unbounded queued-behind-pipeline wait (BENCH_r05)
+            max_queue=config.get_optional_int("oryx.serving.overload.max-queue"),
         )
         configure_scan(
             oversample=config.get_optional_int("oryx.serving.scan.oversample"),
@@ -606,6 +627,23 @@ class ServingLayer:
         self.generation_tracker = GenerationTracker(self.health)
         self._rollback_producer = None
         self._rollback_lock = threading.Lock()
+
+        # adaptive overload control: the admission controller watches the
+        # batcher's queue-wait EWMA / queue depth / HTTP inflight against
+        # the oryx.serving.overload.* budget and walks the shed ladder
+        # (docs/overload.md); None when disabled, so the request fast path
+        # pays nothing
+        self.overload_config = _overload.OverloadConfig.from_config(config)
+        self.admission = (
+            _overload.AdmissionController(
+                self.overload_config,
+                signals=self._overload_signals,
+                instance_metrics=self.instance_metrics,
+                generation_fn=lambda: self.health.live_generation,
+            )
+            if self.overload_config.enabled
+            else None
+        )
 
         self.router = Router()
         if self.app_resources:
@@ -706,6 +744,7 @@ class ServingLayer:
             registry=self.registry_store,
             rollback_publisher=rollback_publisher,
             instance_metrics=self.instance_metrics,
+            admission=self.admission,
         )
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
@@ -832,6 +871,15 @@ class ServingLayer:
         with self._inflight_cond:
             return self._inflight
 
+    def _overload_signals(self) -> tuple[float, int, int]:
+        """(queue_wait_ewma_ms, queue_depth, http_inflight) for the
+        admission controller — the batcher half reads the process-wide
+        default batcher without ever creating one."""
+        from oryx_tpu.serving.batcher import default_batcher_signals
+
+        queue_wait_ms, depth = default_batcher_signals()
+        return queue_wait_ms, depth, self.inflight_requests
+
     def begin_drain(self) -> None:
         """Start refusing NEW traffic at the readiness level: /ready and
         /readyz flip to 503 so load balancers (and the open-loop engine's
@@ -901,6 +949,96 @@ class ServingLayer:
         self.close()
 
 
+def _shed_response(retry_after_s: int) -> Response:
+    """Fast-429 for the top ladder rung: tiny JSON body, Retry-After so
+    well-behaved clients back off instead of hammering the retry path."""
+    return Response(
+        429,
+        {"error": "overloaded", "retry_after_s": retry_after_s},
+        content_type="application/json",
+        headers={"Retry-After": str(retry_after_s)},
+    )
+
+
+def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, sp):
+    """Route one request through the shed ladder (docs/overload.md).
+
+    The admission decision picks the *intended* stage; this function
+    reports the stage the request was *actually* served at — a stale-rung
+    request that misses the answer cache falls through to a reduced-probe
+    scan, and a full-quality request that finds the batcher queue full is
+    shed at the door. The served stage is stamped on the response header,
+    the request span, and the per-stage counters, so loadgen's achieved-
+    quality accounting always reflects reality, not intent."""
+    from oryx_tpu.serving.batcher import BatcherOverloadedError
+
+    admission = layer.admission
+    decision = (
+        admission.decide(req.method, req.path) if admission is not None else None
+    )
+    served = None  # stage name actually used; None = full quality
+    response = None
+    if decision is not None and decision.stage >= _overload.STAGE_SHED:
+        served = "shed"
+        response = _shed_response(decision.retry_after_s)
+    elif (
+        decision is not None
+        and decision.stage >= _overload.STAGE_STALE
+        and req.method == "GET"
+    ):
+        cached = admission.cache.get(cache_key, admission.generation())
+        if cached is not None:
+            served = "stale"
+            response = Response(cached.status, cached.payload, cached.content_type)
+    if response is None:
+        try:
+            if decision is not None and decision.probe_fraction is not None:
+                with _overload.probe_override(decision.probe_fraction):
+                    response = layer.router.dispatch(ctx, req)
+                if getattr(response, "status", 200) == 200:
+                    served = "reduced-probe"
+            else:
+                response = layer.router.dispatch(ctx, req)
+        except BatcherOverloadedError:
+            # bounded-queue rejection (oryx.serving.overload.max-queue):
+            # an immediate shed decision instead of unbounded queueing,
+            # taken even when the admission controller is disabled
+            served = "shed"
+            retry_after = (
+                layer.overload_config.retry_after_s
+                if layer.overload_config is not None
+                else 1
+            )
+            response = _shed_response(retry_after)
+        else:
+            if (
+                decision is not None
+                and decision.stage == _overload.STAGE_FULL
+                and req.method == "GET"
+                and getattr(response, "status", 200) == 200
+                and admission.generation() is not None
+            ):
+                # feed the stale-answer cache with full-quality answers
+                # only, stamped with the champion generation
+                admission.cache.put(
+                    cache_key,
+                    _overload.CachedAnswer(
+                        admission.generation(),
+                        response.status,
+                        response.body,
+                        response.content_type,
+                    ),
+                )
+    if served is not None:
+        _overload.count_shed(served, layer.instance_metrics)
+        headers = getattr(response, "headers", None)
+        if headers is not None:
+            headers[_overload.SHED_HEADER] = served
+        if sp is not None:
+            sp.set("shed_stage", served)
+    return response
+
+
 def _make_handler(layer: ServingLayer, ctx: ServingContext):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -966,6 +1104,9 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 headers={k: v for k, v in self.headers.items()},
                 body=body,
             )
+            # answer-cache key: path + raw query, i.e. the full request
+            # identity for the GET data plane the stale rung serves
+            cache_key = path + ("?" + split.query if split.query else "")
             # request-lifecycle span: a sampled incoming traceparent is
             # honored (the loadgen client's span becomes this span's
             # parent, joined by trace id); header-less requests roll the
@@ -977,7 +1118,7 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                         "serving.request",
                         attrs={"path": path, "method": req.method},
                     ) as sp:
-                        response = layer.router.dispatch(ctx, req)
+                        response = _admit_and_route(layer, ctx, req, cache_key, sp)
                         sp.set("status", getattr(response, "status", 200))
             else:
                 with tracing.span(
@@ -985,7 +1126,7 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                     attrs={"path": path, "method": req.method},
                     root=True,
                 ) as sp:
-                    response = layer.router.dispatch(ctx, req)
+                    response = _admit_and_route(layer, ctx, req, cache_key, sp)
                     sp.set("status", getattr(response, "status", 200))
             return render(response, self.headers.get("Accept", "application/json"))
 

@@ -109,6 +109,7 @@ class ServingContext:
         registry=None,
         rollback_publisher=None,
         instance_metrics=None,
+        admission=None,
     ) -> None:
         self.model_manager = model_manager
         self.input_producer = input_producer
@@ -125,6 +126,9 @@ class ServingContext:
         # this replica's own MetricsRegistry (per-replica truth when many
         # ServingLayers share one process); None in bare router tests
         self.instance_metrics = instance_metrics
+        # AdmissionController (oryx_tpu/serving/overload.py) when overload
+        # control is enabled under a full ServingLayer; None otherwise
+        self.admission = admission
 
 
 # ---------------------------------------------------------------------------

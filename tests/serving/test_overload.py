@@ -1,0 +1,329 @@
+"""Overload control: shed-ladder hysteresis, stale-answer cache, bounded
+batcher queue, and the predictive/reactive autoscaler policy — all driven
+with scripted signals and injected clocks (no servers, no sleeping)."""
+
+import math
+
+from oryx_tpu.common import metrics
+from oryx_tpu.serving import overload
+from oryx_tpu.serving.autoscale import (
+    AutoscaleConfig,
+    AutoscaleSignals,
+    FleetAutoscaler,
+    fit_raised_cosine,
+)
+from oryx_tpu.serving.overload import (
+    STAGE_FULL,
+    STAGE_NAMES,
+    STAGE_REDUCED_PROBE,
+    STAGE_SHED,
+    STAGE_STALE,
+    AdmissionController,
+    AnswerCache,
+    CachedAnswer,
+    OverloadConfig,
+    active_probe_fraction,
+    probe_override,
+)
+
+
+def test_loadgen_mirrors_serving_constants():
+    # loadgen must not import oryx_tpu.serving (package __init__ drags
+    # jax), so engine.py mirrors the header/stage constants locally; this
+    # is the assertion that keeps the two from drifting
+    from oryx_tpu.loadgen import engine
+
+    assert engine.SHED_HEADER == overload.SHED_HEADER
+    assert engine.SHED_STAGES == overload.STAGE_NAMES
+
+
+def test_exempt_paths():
+    assert overload.exempt("/healthz")
+    assert overload.exempt("/metrics")
+    assert overload.exempt("/model/rollback/123")
+    assert not overload.exempt("/probe/recommend/u1")
+    assert not overload.exempt("/recommend/u1")
+
+
+def test_probe_override_scopes_to_context():
+    assert active_probe_fraction() is None
+    with probe_override(0.25):
+        assert active_probe_fraction() == 0.25
+    assert active_probe_fraction() is None
+
+
+# -- ladder ------------------------------------------------------------------
+
+
+def _controller(sig, now, **cfg_kw):
+    kw = dict(alpha=1.0, hold_s=1.0, control_interval_ms=0.0)
+    kw.update(cfg_kw)
+    cfg = OverloadConfig(**kw)
+    return AdmissionController(cfg, signals=lambda: sig[0], clock=lambda: now[0])
+
+
+def test_ladder_engages_one_rung_per_hold_interval():
+    sig = [(10_000.0, 0, 0)]  # queue wait 200x over budget: max pressure
+    now = [0.0]
+    c = _controller(sig, now)
+    assert c.evaluate() == STAGE_REDUCED_PROBE  # first move is free
+    now[0] = 0.5
+    assert c.evaluate() == STAGE_REDUCED_PROBE  # hold-s not elapsed
+    now[0] = 1.1
+    assert c.evaluate() == STAGE_STALE
+    now[0] = 2.2
+    assert c.evaluate() == STAGE_SHED
+    now[0] = 3.3
+    assert c.evaluate() == STAGE_SHED  # ladder tops out, no overflow
+    # every transition moved exactly one rung
+    assert [(f, t) for _, f, t, _ in c.transitions] == [(0, 1), (1, 2), (2, 3)]
+
+
+def test_ladder_releases_with_hysteresis():
+    sig = [(100.0, 0, 0)]  # 2.0 pressure: past engage-shed
+    now = [0.0]
+    c = _controller(sig, now)
+    for t in (0.0, 1.1, 2.2):
+        now[0] = t
+        c.evaluate()
+    assert c.stage == STAGE_SHED
+    # inside the hysteresis band: below engage (1.3) but above
+    # release = engage * 0.75 — the rung must hold, not flap
+    sig[0] = (55.0, 0, 0)  # pressure 1.1 > 1.3 * 0.75 = 0.975
+    now[0] = 3.3
+    assert c.evaluate() == STAGE_SHED
+    # below the release line: walks back down one rung per hold-s
+    sig[0] = (0.0, 0, 0)
+    for t, want in ((4.4, STAGE_STALE), (5.5, STAGE_REDUCED_PROBE), (6.6, STAGE_FULL)):
+        now[0] = t
+        assert c.evaluate() == want
+    assert c.stage == STAGE_FULL
+
+
+def test_pressure_is_max_of_normalised_signals():
+    now = [0.0]
+    # inflight dominates: wait and depth are calm
+    sig = [(0.0, 0, 20)]
+    c = _controller(sig, now, inflight_target=10)
+    c.evaluate()
+    assert c.pressure == 2.0
+    # queue depth dominates when max-queue is the bottleneck
+    sig[0] = (0.0, 300, 0)
+    now[0] = 10.0
+    c2 = _controller(sig, now, max_queue=100)
+    c2.evaluate()
+    assert c2.pressure == 3.0
+
+
+def test_decide_carries_stage_payload_and_exemptions():
+    sig = [(10_000.0, 0, 0)]
+    now = [0.0]
+    c = _controller(sig, now, probe_fraction=0.2, retry_after_s=3)
+    assert c.decide("GET", "/healthz") is None  # control plane never sheds
+    d = c.decide("GET", "/probe/recommend/u1")
+    assert d.stage == STAGE_REDUCED_PROBE and d.probe_fraction == 0.2
+    now[0] = 1.1
+    d = c.decide("GET", "/probe/recommend/u1")
+    assert d.stage == STAGE_STALE and d.probe_fraction == 0.2
+    now[0] = 2.2
+    d = c.decide("GET", "/probe/recommend/u1")
+    assert d.stage == STAGE_SHED and d.retry_after_s == 3
+    assert d.name == "shed"
+
+
+def test_count_shed_per_stage():
+    for stage_name in STAGE_NAMES[1:]:
+        counter = metrics.registry.counter("serving.overload.shed." + stage_name)
+        before = counter.value
+        overload.count_shed(stage_name)
+        assert counter.value == before + 1
+
+
+# -- stale-answer cache ------------------------------------------------------
+
+
+def test_answer_cache_hits_only_current_champion():
+    cache = AnswerCache(max_entries=4)
+    cache.put("/probe/recommend/u1", CachedAnswer("100", 200, {"a": 1}, None))
+    hit = cache.get("/probe/recommend/u1", "100")
+    assert hit is not None and hit.payload == {"a": 1}
+    # promotion/rollback moves the champion: the whole cache goes cold
+    assert cache.get("/probe/recommend/u1", "200") is None
+    # no champion yet (pre-first-model): never serve stale
+    assert cache.get("/probe/recommend/u1", None) is None
+    assert cache.hits == 1 and cache.misses == 2
+
+
+def test_answer_cache_is_bounded_lru():
+    cache = AnswerCache(max_entries=2)
+    for i in range(3):
+        cache.put(f"k{i}", CachedAnswer("g", 200, i, None))
+    assert len(cache) == 2
+    assert cache.get("k0", "g") is None  # oldest evicted
+    assert cache.get("k2", "g").payload == 2
+
+
+# -- bounded batcher queue ---------------------------------------------------
+
+
+def test_bounded_queue_rejects_instead_of_queueing():
+    import pytest
+
+    from oryx_tpu.serving import batcher as batcher_mod
+    from oryx_tpu.serving.batcher import (
+        BatcherClosedError,
+        BatcherOverloadedError,
+        TopNBatcher,
+    )
+
+    import numpy as np
+
+    rejected = metrics.registry.counter("serving.batcher.queue.rejected")
+    before = rejected.value
+    b = TopNBatcher(max_queue=0)  # every enqueue is over the bound
+    try:
+        with pytest.raises(BatcherOverloadedError):
+            b.score(None, np.zeros(4, dtype=np.float32), 3)
+    finally:
+        b.close()
+    assert rejected.value == before + 1
+    # overload is NOT a closed-batcher retry: score_default must surface
+    # it to the admission layer, not spin on a full queue
+    assert not issubclass(BatcherOverloadedError, BatcherClosedError)
+    # signals helper never lazily constructs a batcher
+    wait_ms, depth = batcher_mod.default_batcher_signals()
+    assert wait_ms >= 0.0 and depth >= 0
+
+
+def test_queue_wait_ewma_decays_when_idle():
+    import time as _time
+
+    from oryx_tpu.serving.batcher import TopNBatcher
+
+    b = TopNBatcher(max_queue=8)
+    try:
+        with b._flight_cv:
+            b._queue_wait_ewma_ms = 100.0
+            b._last_wait_obs = _time.monotonic() - 2.0  # idle past the grace
+        assert b.queue_wait_ewma_ms() < 100.0
+    finally:
+        b.close()
+
+
+# -- autoscaler policy -------------------------------------------------------
+
+
+def _diurnal(base, swing, period):
+    return lambda t: base + swing * (1.0 - math.cos(2.0 * math.pi * t / period))
+
+
+def test_fit_raised_cosine_recovers_the_curve():
+    period = 100.0
+    rate = _diurnal(50.0, 22.5, period)
+    ts = [2.0 * i for i in range(20)]
+    predict = fit_raised_cosine(ts, [rate(t) for t in ts], period)
+    assert predict is not None
+    for t in (10.0, 50.0, 90.0, 130.0):
+        assert abs(predict(t) - rate(t)) < 1e-6
+    # degenerate inputs return None instead of a junk fit
+    assert fit_raised_cosine([0.0, 1.0], [1.0, 2.0], period) is None
+    assert fit_raised_cosine([5.0] * 10, [1.0] * 10, period) is None
+
+
+class _FakeActuator:
+    def __init__(self, n=1):
+        self.n = n
+        self.refuse_in = False
+
+    def replica_count(self):
+        return self.n
+
+    def scale_out(self):
+        self.n += 1
+        return True
+
+    def scale_in(self):
+        if self.refuse_in:
+            return False
+        self.n -= 1
+        return True
+
+
+def test_autoscaler_scales_out_before_the_peak_and_in_after():
+    period = 100.0
+    rate = _diurnal(50.0, 45.0, period)  # trough 50, peak 140 at t=50
+    cfg = AutoscaleConfig(
+        enabled=True,
+        min_replicas=1,
+        max_replicas=4,
+        lead_s=10.0,
+        period_s=period,
+        per_replica_rate=100.0,
+        cooldown_s=0.0,
+        scale_in_quiet_evals=3,
+        min_fit_samples=8,
+    )
+    actuator = _FakeActuator(n=1)
+    sig = {"t": 0.0}
+
+    def signals():
+        return AutoscaleSignals(
+            rate=rate(sig["t"]), queue_wait_ms=0.0, burn_short=0.0, burn_long=0.0
+        )
+
+    policy = FleetAutoscaler(actuator, signals, cfg)
+    for t in [2.0 * i for i in range(50)]:  # one full diurnal period
+        sig["t"] = t
+        policy.step(now=t)
+    outs = [e for e in policy.events if e.direction == "out"]
+    ins = [e for e in policy.events if e.direction == "in"]
+    assert len(outs) == 1 and outs[0].reason == "predictive"
+    # the whole point of the lead: capacity lands BEFORE the peak (t=50),
+    # while observed demand is still under one replica's worth
+    assert outs[0].t < 50.0
+    assert rate(outs[0].t) < 100.0
+    # and drains back down after the peak passes, on quiet evals only
+    assert len(ins) >= 1 and ins[0].reason == "quiet" and ins[0].t > 50.0
+    assert actuator.n == 1
+
+
+def test_autoscaler_reactive_override_and_refused_scale_in():
+    cfg = AutoscaleConfig(
+        enabled=True,
+        min_replicas=1,
+        max_replicas=4,
+        per_replica_rate=100.0,
+        cooldown_s=0.0,
+        burn_hi=2.0,
+        scale_in_quiet_evals=2,
+        min_fit_samples=10_000,  # keep the fit out of this test
+    )
+    actuator = _FakeActuator(n=1)
+    sig = {"burn": 5.0}
+
+    def signals():
+        return AutoscaleSignals(
+            rate=10.0, queue_wait_ms=0.0, burn_short=sig["burn"], burn_long=sig["burn"]
+        )
+
+    policy = FleetAutoscaler(actuator, signals, cfg)
+    policy.step(now=0.0)
+    assert actuator.n == 2
+    assert policy.events[-1].reason == "reactive"
+    # one slow window alone must not trigger (multi-window rule)
+    sig["burn"] = 0.0
+    one_sided = AutoscaleSignals(rate=10.0, queue_wait_ms=0.0, burn_short=5.0, burn_long=0.0)
+    policy2 = FleetAutoscaler(_FakeActuator(n=1), lambda: one_sided, cfg)
+    policy2.step(now=0.0)
+    assert policy2.actuator.n == 1
+    # calm signals: scale-in waits for consecutive quiet evals, and a
+    # refused drain (actuator False) leaves the fleet alone
+    actuator.refuse_in = True
+    for t in (1.0, 2.0, 3.0, 4.0):
+        policy.step(now=t)
+    assert actuator.n == 2  # refused every attempt
+    actuator.refuse_in = False
+    policy.step(now=5.0)
+    policy.step(now=6.0)
+    assert actuator.n == 1
+    assert policy.events[-1].direction == "in"

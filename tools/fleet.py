@@ -23,10 +23,20 @@ Scenario actions (oryx_tpu/loadgen/scenario.py format):
   restart   {replica, drain_s}      — drain-aware rolling restart of one
                                       replica (readiness 503 -> in-flight
                                       drain -> close -> fresh replica)
+  scale     {direction, drain_s}    — scale the fleet out (fresh replica,
+                                      routed once ready) or in (drain-first
+                                      retirement; the slot is tombstoned)
+
+The harness is also an autoscaler actuator: ``start_autoscaler()`` runs
+the predictive/reactive policy (oryx_tpu/serving/autoscale.py) on a
+control thread that sizes the fleet from observed arrival rate, queue
+wait, and SLO burn. Scale-in always drains before close, so elasticity
+never fails a request.
 
 Usage:
     python tools/fleet.py --replicas 3 --rate 150 --seconds 10
     python tools/fleet.py --replicas 3 --scenario scenario.json
+    python tools/fleet.py --replicas 2 --autoscale --rate 150 --seconds 20
 """
 
 from __future__ import annotations
@@ -54,6 +64,12 @@ from oryx_tpu.loadgen import (
     evaluate_slo,
 )
 from oryx_tpu.registry.tracking import record_fleet_skew
+from oryx_tpu.serving.autoscale import (
+    AutoscaleConfig,
+    AutoscalerThread,
+    AutoscaleSignals,
+    FleetAutoscaler,
+)
 from oryx_tpu.serving.layer import ServingLayer
 
 UPDATE_TOPIC = "OryxUpdate"
@@ -80,6 +96,7 @@ class FleetHarness:
         bus_name: str = "fleet",
         chaos_seed: int = 7,
         skew_poll_s: float = 0.25,
+        overlay: str | None = None,
     ) -> None:
         self.n_replicas = int(n_replicas)
         self.work_dir = str(work_dir)
@@ -99,11 +116,26 @@ class FleetHarness:
         self._skew_thread: threading.Thread | None = None
         self._skew_stop = threading.Event()
         self.skew_samples: list[tuple[float, list[str | None], int]] = []
+        # extra HOCON overlay applied on top of every replica config
+        # (tests tune overload knobs / scripted probe latency through it)
+        self.overlay = overlay
+        # slots retired by scale_in: the replica is drained+closed but its
+        # Target stays in self.targets (ready=False) so the engine's
+        # round-robin index math never races a shrinking list
+        self._retired: set[int] = set()
+        self._fleet_lock = threading.Lock()
+        self._autoscaler: AutoscalerThread | None = None
+        self.autoscaler: FleetAutoscaler | None = None
+        # trailing window for the observed-arrival-rate signal, and the
+        # latency threshold the burn signals are computed against (the
+        # scenario's SLO p99 when driven via run_scenario)
+        self.rate_window_s = 2.0
+        self.slo_p99_ms = 1000.0
 
     # -- replica lifecycle ---------------------------------------------------
 
     def _replica_config(self, metric: float = 1.0):
-        return C.get_default().with_overlay(
+        cfg = C.get_default().with_overlay(
             f"""
             oryx {{
               id = "Fleet"
@@ -124,6 +156,9 @@ class FleetHarness:
             }}
             """
         )
+        if self.overlay:
+            cfg = cfg.with_overlay(self.overlay)
+        return cfg
 
     def _start_replica(self) -> ServingLayer:
         layer = ServingLayer(self._replica_config())
@@ -153,12 +188,15 @@ class FleetHarness:
         self._skew_thread.start()
 
     def stop(self) -> None:
+        self.stop_autoscaler()
         self._skew_stop.set()
         t, self._skew_thread = self._skew_thread, None
         if t is not None:
             t.join(timeout=self._skew_poll_s + 2.0)
-        replicas, self.replicas = list(self.replicas), []
-        self.targets.clear()
+        with self._fleet_lock:
+            replicas, self.replicas = list(self.replicas), []
+            self.targets.clear()
+            self._retired.clear()
         errors = []
         for layer in replicas:
             try:
@@ -177,10 +215,27 @@ class FleetHarness:
 
     # -- observation ---------------------------------------------------------
 
+    def _live_indices_locked(self) -> list[int]:
+        return [i for i in range(len(self.replicas)) if i not in self._retired]
+
+    def live_indices(self) -> list[int]:
+        """Slot indices still serving (scale_in tombstones, never pops)."""
+        with self._fleet_lock:
+            return self._live_indices_locked()
+
+    def replica_count(self) -> int:
+        """Live replica count — the autoscaler actuator's view of size."""
+        return len(self.live_indices())
+
+    def _live_replicas(self) -> list[ServingLayer]:
+        with self._fleet_lock:
+            return [self.replicas[i] for i in self._live_indices_locked()]
+
     def replica_generations(self) -> list[str | None]:
-        """Each replica's live generation, straight from the trackers (the
-        /healthz body reports the same value over HTTP)."""
-        return [layer.health.live_generation for layer in self.replicas]
+        """Each live replica's generation, straight from the trackers (the
+        /healthz body reports the same value over HTTP). Retired slots are
+        skipped — a closed replica's last generation is not fleet skew."""
+        return [layer.health.live_generation for layer in self._live_replicas()]
 
     def _watch_skew(self) -> None:
         t0 = time.monotonic()
@@ -266,8 +321,107 @@ class FleetHarness:
             # and the slot would point at a half-drained layer
             old.close()
         fresh = self._start_replica()
-        self.replicas[replica] = fresh
-        self.targets[replica].base_url = f"http://127.0.0.1:{fresh.port}"
+        with self._fleet_lock:
+            self.replicas[replica] = fresh
+            self.targets[replica].base_url = f"http://127.0.0.1:{fresh.port}"
+
+    # -- elastic capacity (autoscaler actuator) ------------------------------
+
+    def scale_out(self) -> bool:
+        """Start one fresh replica and add it to the routable set. The new
+        Target starts ready=False: the engine's readiness poller flips it
+        once /readyz goes 200 (model replayed), so a cold replica never
+        catches a request it cannot answer."""
+        with self._fleet_lock:
+            layer = self._start_replica()
+            i = len(self.replicas)
+            target = Target(f"replica-{i}", f"http://127.0.0.1:{layer.port}")
+            target.ready = False
+            self.replicas.append(layer)
+            self.targets.append(target)
+        return True
+
+    def scale_in(self, drain_s: float = 5.0) -> bool:
+        """Retire the newest live replica, drain-first: readiness flips to
+        503, the router stops sending within its poll interval, in-flight
+        requests complete, then the replica closes. The slot is tombstoned
+        (Target stays in the list, ready=False) so concurrent round-robin
+        picks never index a shrinking list. Returns False when only one
+        live replica remains — the fleet never scales to zero."""
+        with self._fleet_lock:
+            live = self._live_indices_locked()
+            if len(live) <= 1:
+                return False
+            i = live[-1]
+            self._retired.add(i)
+            layer = self.replicas[i]
+            target = self.targets[i]
+        try:
+            layer.begin_drain()
+            # let readiness pollers observe the 503 before tearing down
+            time.sleep(0.6)
+            layer.drain(drain_s)
+        finally:
+            layer.close()
+            target.ready = False
+        return True
+
+    def scale(self, direction: str = "out", drain_s: float = 5.0) -> bool:
+        """Scenario-action form: {"do": "scale", "direction": "in"}."""
+        if direction == "out":
+            return self.scale_out()
+        return self.scale_in(drain_s)
+
+    def autoscale_signals(self) -> AutoscaleSignals:
+        """Snapshot the policy inputs from the load targets' client-side
+        SLOWindows (arrival rate, latency burn vs. the scenario p99) and
+        the replicas' admission controllers (queue-wait pressure)."""
+        rate = sum(
+            t.slo.count(self.rate_window_s) for t in self.targets
+        ) / max(self.rate_window_s, 1e-9)
+        threshold_s = self.slo_p99_ms / 1000.0
+        burn_short = burn_long = 0.0
+        cfg = self.autoscaler.cfg if self.autoscaler is not None else None
+        w_short = cfg.burn_window_short_s if cfg else 5.0
+        w_long = cfg.burn_window_long_s if cfg else 30.0
+        for t in self.targets:
+            burn_short = max(t.slo.latency_burn_rate(w_short, threshold_s, 0.01), burn_short)
+            burn_long = max(t.slo.latency_burn_rate(w_long, threshold_s, 0.01), burn_long)
+        queue_wait_ms = 0.0
+        for layer in self._live_replicas():
+            wait_ms, _depth, _inflight = layer._overload_signals()
+            queue_wait_ms = max(queue_wait_ms, wait_ms)
+        return AutoscaleSignals(
+            rate=rate,
+            queue_wait_ms=queue_wait_ms,
+            burn_short=burn_short,
+            burn_long=burn_long,
+        )
+
+    def start_autoscaler(self, cfg: AutoscaleConfig | None = None) -> FleetAutoscaler:
+        """Run the predictive/reactive sizing policy against this harness
+        on a control thread. cfg defaults to the replica config's
+        oryx.fleet.autoscale block (force enabled — calling this IS the
+        opt-in)."""
+        if self._autoscaler is not None:
+            raise RuntimeError("autoscaler already running")
+        if cfg is None:
+            import dataclasses
+
+            cfg = dataclasses.replace(
+                AutoscaleConfig.from_config(self._replica_config()), enabled=True
+            )
+        self.autoscaler = FleetAutoscaler(
+            actuator=self, signals=self.autoscale_signals, cfg=cfg
+        )
+        self._autoscaler = AutoscalerThread(self.autoscaler)
+        self._autoscaler.start()
+        return self.autoscaler
+
+    def stop_autoscaler(self) -> None:
+        t, self._autoscaler = self._autoscaler, None
+        if t is not None:
+            t.stop()
 
     def handlers(self) -> dict:
         return {
@@ -275,6 +429,7 @@ class FleetHarness:
             "rollback": self.rollback,
             "chaos": self.chaos,
             "restart": self.restart,
+            "scale": self.scale,
         }
 
 
@@ -286,6 +441,8 @@ def run_scenario(
 ):
     """Drive one scripted scenario: traffic + action timeline + verdict.
     Returns (LoadResult, SLOVerdict, ScenarioRunner)."""
+    # the autoscaler's burn signals judge against the scenario's own SLO
+    harness.slo_p99_ms = scenario.slo.p99_ms
     engine = OpenLoopEngine(
         harness.targets,
         template=scenario.template,
@@ -349,6 +506,11 @@ def main() -> int:
     ap.add_argument("--scenario", default=None, help="scenario JSON file")
     ap.add_argument("--work-dir", default=None, help="model/data dir (default: temp)")
     ap.add_argument("--max-inflight", type=int, default=128)
+    ap.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="run the predictive/reactive autoscaler during the scenario",
+    )
     args = ap.parse_args()
 
     import tempfile
@@ -365,9 +527,12 @@ def main() -> int:
             if not fleet.wait_converged(first, timeout=15.0):
                 print("fleet: replicas never converged on the first generation")
                 return 2
+            if args.autoscale:
+                fleet.start_autoscaler()
             result, verdict, runner = run_scenario(
                 fleet, scenario, max_inflight=args.max_inflight
             )
+            fleet.stop_autoscaler()
             settled = fleet.wait_converged(fleet.generations[-1], timeout=10.0)
             final_skew = record_fleet_skew(fleet.replica_generations())
             report = {
@@ -376,6 +541,12 @@ def main() -> int:
                 "generations": fleet.generations,
                 "converged": settled,
                 "final_skew": final_skew,
+                "replica_count": fleet.replica_count(),
+                "scale_events": [
+                    {"t": round(e.t, 2), "direction": e.direction, "reason": e.reason,
+                     "replicas": e.replicas}
+                    for e in (fleet.autoscaler.events if fleet.autoscaler else [])
+                ],
                 "max_skew_observed": max((s for _, _, s in fleet.skew_samples), default=0),
                 "slo": {
                     "passed": verdict.passed,
