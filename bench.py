@@ -1227,6 +1227,172 @@ def bench_lock_watchdog_overhead() -> None:
         raise RuntimeError("lock watchdog overhead above envelope: " + "; ".join(failures))
 
 
+def bench_experiment_overhead() -> None:
+    """Online-experiment cost acceptance rows (docs/experiments.md): the
+    champion/challenger A/B machinery — sticky arm routing, the
+    per-request observe hook, per-arm instance metrics, and the attached
+    evaluator consumer thread — must cost <= 2% on the serving hot path
+    when an experiment is ACTIVE. Same protocol as the lock-watchdog
+    rows: two live layers in one process (one with a 10% challenger
+    split and the evaluator attached, one with experiments bypassed
+    entirely), >= 3 closed-loop trials per arm INTERLEAVED in
+    alternating order so host drift cancels pairwise.
+
+    vs_baseline = attached/bypassed median qps ratio; a row whose median
+    AND best trial both land below the 0.98 envelope hard-fails, a
+    median-only miss is flagged `noise-suspect`. A second row pins the
+    realized challenger share against the configured 10% split — if
+    routing were silently inactive the overhead row would measure
+    nothing, so a share outside [0.05, 0.20] hard-fails too."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from oryx_tpu.common import config as C
+    from oryx_tpu.serving.layer import ServingLayer
+    from tools.load_benchmark import build_model
+    from tools.traffic import worker
+
+    envelope = float(os.environ.get("ORYX_BENCH_EXPERIMENT_ENVELOPE", 0.98))
+    failures: list[str] = []
+
+    items = int(os.environ.get("ORYX_BENCH_EXPERIMENT_ITEMS", 200_000))
+    users = 10_000
+    seconds = float(os.environ.get("ORYX_BENCH_EXPERIMENT_SECONDS", 4.0))
+    model_dir = tempfile.mkdtemp(prefix="oryx-bench-exp-")
+
+    def overlay(ab_fraction: float, with_registry: bool) -> object:
+        registry = (
+            f'batch.storage.model-dir = "{model_dir}"' if with_registry else ""
+        )
+        return C.get_default().with_overlay(
+            f"""
+            oryx {{
+              id = "BenchExperimentOverhead"
+              input-topic.broker = "inproc://benchexp"
+              update-topic.broker = "inproc://benchexp"
+              {registry}
+              serving {{
+                api.port = 0
+                api.read-only = true
+                model-manager-class = "tools.load_benchmark:LoadTestModelManager"
+                application-resources = "oryx_tpu.app.als.endpoints"
+                ab.fraction = {ab_fraction}
+              }}
+            }}
+            """
+        )
+
+    def make_layer(cfg) -> tuple:
+        layer = ServingLayer(cfg)
+        layer.start()
+        layer.model_manager.model = build_model(users, items, 50)
+        base = f"http://127.0.0.1:{layer.port}"
+        urllib.request.urlopen(f"{base}/recommend/u0", timeout=300).read()
+        return layer, base
+
+    def serving_trial(base: str) -> float:
+        lats: list = []
+        stop = threading.Event()
+        deadline = time.perf_counter() + seconds
+        t1 = time.perf_counter()
+        worker(base, "/recommend/u%d", users, deadline, lats, [], stop)
+        if not lats:
+            raise RuntimeError("experiment-overhead serving: no requests")
+        return len(lats) / (time.perf_counter() - t1)
+
+    off_layer, off_base = make_layer(overlay(0.0, with_registry=False))
+    try:
+        on_layer, on_base = make_layer(overlay(0.10, with_registry=True))
+        try:
+            # make the experiment genuinely ACTIVE: champion pointer set,
+            # a challenger generation live in the tracker, so every
+            # request pays arm assignment + observe + per-arm metrics
+            # (the load-test manager serves both arms identically)
+            on_layer.registry_store.set_champion("1970010100000000")
+            on_layer.generation_tracker._set_live("1970010100000000")
+            on_layer.generation_tracker._set_challenger("1970010100000001")
+            if on_layer.experiments is None or not on_layer.experiments.active:
+                raise RuntimeError(
+                    "experiment-overhead: experiments failed to activate"
+                )
+            srv_on: list = []
+            srv_off: list = []
+            for pair in range(_TRIALS):
+                for mode_on in (True, False) if pair % 2 == 0 else (False, True):
+                    r = serving_trial(on_base if mode_on else off_base)
+                    (srv_on if mode_on else srv_off).append(r)
+            with urllib.request.urlopen(f"{on_base}/experiments", timeout=30) as resp:
+                report = json.loads(resp.read())
+        finally:
+            on_layer.close()
+    finally:
+        off_layer.close()
+        shutil.rmtree(model_dir, ignore_errors=True)
+
+    med_on = statistics.median(srv_on)
+    med_off = max(statistics.median(srv_off), 1e-9)
+    ratio = med_on / med_off
+    best = max(srv_on) / med_off
+    detail = (
+        f"experiment active {med_on:.0f} vs bypassed {med_off:.0f} "
+        f"queries/sec (medians of {len(srv_on)}/{len(srv_off)} trials), "
+        f"overhead {100 * (1 - ratio):.2f}%, envelope <= "
+        f"{100 * (1 - envelope):.0f}%"
+    )
+    print(f"bench[experiment-overhead serving]: {detail}", file=sys.stderr)
+    _emit(
+        "online experiment overhead, serving closed-loop, 10% challenger "
+        f"split + evaluator attached vs bypassed (vs_baseline = on/off "
+        f"ratio, floor {envelope})",
+        med_on,
+        "queries/sec",
+        ratio,
+        order=46,
+        detail=detail,
+        off_value=round(med_off, 2),
+        overhead_pct=round(100 * (1 - ratio), 3),
+        noise_suspect=ratio < envelope <= best,
+        spread=[round(float(min(srv_on)), 2), round(float(max(srv_on)), 2)],
+        trials=len(srv_on),
+    )
+    if ratio < envelope and best < envelope:
+        failures.append(f"serving closed-loop: on/off {ratio:.4f} < {envelope}")
+
+    arms = (report.get("report") or {}).get("arms") or {}
+    champ_serves = int((arms.get("champion") or {}).get("serves") or 0)
+    chal_serves = int((arms.get("challenger") or {}).get("serves") or 0)
+    total = champ_serves + chal_serves
+    share = chal_serves / total if total else 0.0
+    detail = (
+        f"challenger served {chal_serves}/{total} assigned requests "
+        f"(share {share:.4f}) under ab.fraction = 0.10; sticky blake2b "
+        f"bucketing over {users} uniform users"
+    )
+    print(f"bench[experiment-overhead split]: {detail}", file=sys.stderr)
+    _emit(
+        "online experiment realized challenger share, 10% configured split "
+        "(vs_baseline = share/0.10)",
+        round(share, 4),
+        "fraction",
+        round(share / 0.10, 4),
+        order=47,
+        detail=detail,
+        trials=total,
+    )
+    if total == 0 or not 0.05 <= share <= 0.20:
+        failures.append(
+            f"challenger share {share:.4f} outside [0.05, 0.20] "
+            f"({chal_serves}/{total} serves) — routing not active?"
+        )
+
+    if failures:
+        raise RuntimeError(
+            "experiment overhead above envelope: " + "; ".join(failures)
+        )
+
+
 def bench_ledger_overhead() -> None:
     """Resource-ledger cost acceptance rows (docs/static-analysis.md):
     the weakref live-resource accounting every layer registers into must
@@ -1922,6 +2088,7 @@ BENCHES = [
     ("speed", bench_speed),
     ("tracing-overhead", bench_tracing_overhead),
     ("lock-watchdog", bench_lock_watchdog_overhead),
+    ("experiment-overhead", bench_experiment_overhead),
     ("resource-ledger", bench_ledger_overhead),
     ("overload", bench_overload),
     ("rdf", bench_rdf),

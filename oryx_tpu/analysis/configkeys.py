@@ -10,9 +10,12 @@ of truth for each knob set) does not declare.
 
 Linted prefixes:
   oryx.serving.scan.ann   — ANN tier of the serving scan
+  oryx.serving.ab         — online experiment traffic split (docs/experiments.md)
   oryx.serving.overload   — admission control / shed ladder
   oryx.fleet.autoscale    — predictive fleet autoscaler
   oryx.bus.shm            — shared-memory ring transport
+  oryx.ml.gate.online     — evidence-gated online promotion
+  oryx.speed.parse        — native columnar input parse stage
   oryx.speed.pipeline     — three-stage speed-layer pipeline
   oryx.tracing            — distributed tracer (common/tracing.py)
 """
@@ -36,6 +39,8 @@ LINTED_PREFIXES = (
     ANN_PREFIX,
     "oryx.bus.shm",
     "oryx.fleet.autoscale",
+    "oryx.ml.gate.online",
+    "oryx.serving.ab",
     "oryx.serving.overload",
     "oryx.speed.parse",
     "oryx.speed.pipeline",

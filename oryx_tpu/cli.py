@@ -34,7 +34,7 @@ log = logging.getLogger(__name__)
 
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
-    "bus-input", "config", "health", "models", "trace", "lint",
+    "bus-input", "config", "health", "models", "trace", "experiments", "lint",
 )
 
 MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
@@ -441,6 +441,35 @@ def run_trace(cfg: Config, trace_id: str | None = None, out=None) -> int:
     return 0
 
 
+def run_experiments(cfg: Config, out=None) -> int:
+    """Fetch and pretty-print the serving layer's GET /experiments body
+    (docs/experiments.md): arm split config, champion/challenger
+    generations, per-arm online metrics, and the standing online-gate
+    decision. Exit 0 when the endpoint answered, 1 when unreachable."""
+    import json
+    from urllib.error import URLError
+    from urllib.request import urlopen
+
+    out = out or sys.stdout
+    scheme = "https" if cfg.get_optional_string("oryx.serving.api.keystore-file") else "http"
+    port = cfg.get_int(
+        "oryx.serving.api.secure-port" if scheme == "https" else "oryx.serving.api.port"
+    )
+    ctx_path = cfg.get_string("oryx.serving.api.context-path").rstrip("/")
+    url = f"{scheme}://localhost:{port}{ctx_path}/experiments"
+    try:
+        with urlopen(url, timeout=10) as resp:
+            body = resp.read().decode("utf-8", "replace")
+    except URLError as e:
+        print(f"/experiments: unreachable ({e})", file=out)
+        return 1
+    try:
+        print(json.dumps(json.loads(body), indent=2, sort_keys=True), file=out)
+    except ValueError:
+        print(body, file=out)
+    return 0
+
+
 def run_config_dump(cfg: Config, out=None) -> None:
     """ConfigToProperties analogue: dump the resolved oryx.* tree as
     key=value lines for shell consumption (used at oryx-run.sh:87)."""
@@ -512,6 +541,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_models(cfg, args.subcommand, args.generation)
     elif args.command == "trace":
         return run_trace(cfg, args.subcommand)
+    elif args.command == "experiments":
+        return run_experiments(cfg)
     elif args.command == "lint":
         return run_lint(cfg)
     return 0

@@ -28,6 +28,7 @@ and burn-rate definitions are documented in docs/traffic-harness.md.
 
 from oryx_tpu.loadgen.arrivals import DiurnalRampProcess, PoissonProcess
 from oryx_tpu.loadgen.engine import LoadResult, OpenLoopEngine, Target
+from oryx_tpu.loadgen.feedback import ScriptedFeedback
 from oryx_tpu.loadgen.scenario import Action, Scenario, ScenarioRunner
 from oryx_tpu.loadgen.skew import PowerLawUsers
 from oryx_tpu.loadgen.slo import SLOSpec, SLOVerdict, evaluate_slo
@@ -41,6 +42,7 @@ __all__ = [
     "PowerLawUsers",
     "Scenario",
     "ScenarioRunner",
+    "ScriptedFeedback",
     "SLOSpec",
     "SLOVerdict",
     "Target",

@@ -49,6 +49,9 @@ __all__ = ["LoadResult", "OpenLoopEngine", "RequestRecord", "Target", "classify_
 # asserts the two stay in sync.
 SHED_HEADER = "X-Oryx-Shed-Stage"
 SHED_STAGES = ("full", "reduced-probe", "stale", "shed")
+# Mirrors oryx_tpu.experiments.routing.ARM_HEADER the same way;
+# tests/experiments/test_routing.py asserts the two stay in sync.
+ARM_HEADER = "X-Oryx-Experiment-Arm"
 
 
 def classify_error(exc: Exception) -> str:
@@ -98,6 +101,12 @@ class RequestRecord:
     # the X-Oryx-Shed-Stage response header: which overload-ladder rung
     # actually served the answer ("full" when absent)
     shed_stage: str = "full"
+    # the X-Oryx-Experiment-Arm response header: which experiment arm
+    # served the answer (None when no experiment attributed the request)
+    arm: str | None = None
+    # the user the request was issued for (arm-stickiness assertions
+    # group records by user)
+    user: int | None = None
 
 
 @dataclass
@@ -192,6 +201,7 @@ class OpenLoopEngine:
         max_inflight: int = 128,
         timeout_s: float = 10.0,
         readiness_poll_s: float = 0.2,
+        on_response=None,
     ) -> None:
         if not targets:
             raise ValueError("need at least one target")
@@ -200,6 +210,11 @@ class OpenLoopEngine:
         self.max_inflight = int(max_inflight)
         self.timeout_s = float(timeout_s)
         self.readiness_poll_s = float(readiness_poll_s)
+        # callable(user:int, status:int, headers, body:bytes) invoked for
+        # every 2xx response — the hook scripted interaction feedback
+        # (oryx_tpu/loadgen/feedback.py) uses to close the loop. Errors
+        # are swallowed: feedback must never fail the load run.
+        self.on_response = on_response
         self._rr = 0
         self._lock = threading.Lock()
         self._inflight = 0
@@ -242,6 +257,7 @@ class OpenLoopEngine:
         ok = False
         kind = "ok"
         shed_stage = "full"
+        arm = None
         # client root span: sampled requests ship their context as a
         # traceparent header, so the server's serving.request (and the
         # queue-wait/scan/rescore spans under it) land in the same trace
@@ -255,11 +271,17 @@ class OpenLoopEngine:
                 if ctx is not None:
                     req.add_header("traceparent", ctx.traceparent())
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                    resp.read()
+                    data = resp.read()
                     ok = 200 <= resp.status < 300
                     shed_stage = resp.headers.get(SHED_HEADER) or "full"
+                    arm = resp.headers.get(ARM_HEADER)
                     if not ok:  # non-2xx that didn't raise (3xx)
                         kind = f"http-{resp.status // 100}xx"
+                    elif self.on_response is not None:
+                        try:
+                            self.on_response(user, resp.status, resp.headers, data)
+                        except Exception:  # noqa: BLE001
+                            pass
             except urllib.error.HTTPError as e:
                 # a 429 stamped by the shed ladder is the overload
                 # controller doing its job — account it as shed load,
@@ -288,6 +310,8 @@ class OpenLoopEngine:
             kind=kind,
             trace_id=ctx.trace_id if ctx is not None else None,
             shed_stage=shed_stage,
+            arm=arm,
+            user=user,
         )
         with self._lock:
             sink.append(rec)

@@ -48,6 +48,7 @@ from oryx_tpu.ml import param as hp
 from oryx_tpu.registry.gate import ChampionGate
 from oryx_tpu.registry.manifest import (
     GENERATION_EXTENSION,
+    ONLINE_PENDING,
     PARENT_EXTENSION,
     STATUS_GATED,
     STATUS_PUBLISHED,
@@ -279,6 +280,19 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                     shutil.rmtree(final_dir)
                 shutil.move(str(best_path), str(final_dir))
 
+            # online (evidence-gated) promotion: when the online gate is
+            # enabled and a champion already exists, a publish-worthy
+            # candidate goes out WITHOUT moving the CHAMPION pointer —
+            # serving classifies it as the challenger arm and the online
+            # gate moves the pointer only once live evidence clears the
+            # bars (docs/experiments.md). Bootstrap (no champion yet)
+            # promotes immediately, as offline mode does.
+            online_pending = (
+                decision.publish
+                and self.gate.online.enabled
+                and store.champion_id() is not None
+            )
+
             store.write_manifest(
                 GenerationManifest(
                     generation_id=generation_id,
@@ -292,6 +306,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                     content_hash=content_hash_of(pmml_bytes),
                     created_at_ms=timestamp_ms,
                     gate_reason=None if decision.publish else decision.reason,
+                    online_status=ONLINE_PENDING if online_pending else None,
                 )
             )
 
@@ -301,7 +316,14 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 )
                 return
 
-            store.set_champion(generation_id, now_ms=timestamp_ms)
+            if online_pending:
+                log.info(
+                    "generation %s published as online challenger: champion "
+                    "pointer stays until the online gate promotes it",
+                    generation_id,
+                )
+            else:
+                store.set_champion(generation_id, now_ms=timestamp_ms)
 
             if model_update_topic is None:
                 log.info("not publishing model to update topic since none is configured")

@@ -27,6 +27,12 @@ PARENT_EXTENSION = "parent-generation"
 STATUS_PUBLISHED = "published"
 STATUS_GATED = "gated"
 
+# online-experiment lifecycle of a published generation
+# (oryx.ml.gate.online, docs/experiments.md)
+ONLINE_PENDING = "pending"  # serving as challenger, accumulating evidence
+ONLINE_PROMOTED = "promoted"  # online gate moved the CHAMPION pointer here
+ONLINE_REFUSED = "refused"  # online gate dropped it from routing
+
 
 @dataclass
 class GenerationManifest:
@@ -45,6 +51,14 @@ class GenerationManifest:
     content_hash: str | None = None
     created_at_ms: int | None = None
     gate_reason: str | None = None
+    # online-gate lineage: null for generations promoted offline,
+    # pending/promoted/refused for evidence-gated ones, plus the
+    # decision evidence the gate acted on
+    online_status: str | None = None
+    online_reason: str | None = None
+    online_samples: dict | None = None
+    online_lift: float | None = None
+    online_confidence: float | None = None
 
     def to_json(self) -> str:
         d = asdict(self)

@@ -17,6 +17,7 @@ importable module path.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
 from typing import Iterator
@@ -24,6 +25,7 @@ from typing import Iterator
 from oryx_tpu.api.serving import AbstractServingModelManager, ServingModel
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common import pmml as pmml_io
+from oryx_tpu.experiments import routing as experiments_routing
 from oryx_tpu.ml.update import MLUpdate
 from oryx_tpu.serving.web import OryxServingException, Request, Response, ServingContext, resource
 
@@ -67,13 +69,23 @@ class PMMLProbeModel(ServingModel):
 
 class PMMLProbeServingModelManager(AbstractServingModelManager):
     """Swaps in whatever PMML generation arrives; counts swaps so dedupe
-    tests can assert a duplicate MODEL never re-triggered one."""
+    tests can assert a duplicate MODEL never re-triggered one.
+
+    Generation-aware: recent generations are retained by id, and
+    ``get_model`` honors the per-request override the experiment router
+    sets (oryx_tpu/experiments/routing.py), so a challenger-arm request
+    is really answered by the challenger generation's model while the
+    champion stays live for everyone else."""
+
+    _RETAIN_GENERATIONS = 4
 
     def __init__(self, config) -> None:
         super().__init__(config)
         self._lock = threading.Lock()
         self._model: PMMLProbeModel | None = None
+        self._by_generation: dict[str, PMMLProbeModel] = {}
         self.model_swaps = 0
+        self.challenger_loads = 0
 
     def consume(self, update_iterator: Iterator[KeyMessage]) -> None:
         from oryx_tpu.app import pmml as app_pmml
@@ -91,14 +103,32 @@ class PMMLProbeServingModelManager(AbstractServingModelManager):
                 for e in pmml_io.findall(pmml, "Extension")
                 if e.get("name")
             }
+            model = PMMLProbeModel(extensions.get(GENERATION_EXTENSION), extensions)
+            challenger = experiments_routing.consuming_challenger()
             with self._lock:
-                self._model = PMMLProbeModel(
-                    extensions.get(GENERATION_EXTENSION), extensions
-                )
-                self.model_swaps += 1
+                if model.generation_id is not None:
+                    self._by_generation[model.generation_id] = model
+                    while len(self._by_generation) > self._RETAIN_GENERATIONS:
+                        self._by_generation.pop(next(iter(self._by_generation)))
+                if (
+                    model.generation_id is not None
+                    and model.generation_id == challenger
+                ):
+                    # an online-gate challenger: loaded and servable via
+                    # the per-request override, but the live default stays
+                    # the champion until the gate promotes it
+                    self.challenger_loads += 1
+                else:
+                    self._model = model
+                    self.model_swaps += 1
 
     def get_model(self) -> PMMLProbeModel | None:
+        requested = experiments_routing.requested_generation()
         with self._lock:
+            if requested is not None:
+                retained = self._by_generation.get(requested)
+                if retained is not None:
+                    return retained
             return self._model
 
 
@@ -127,5 +157,27 @@ def probe_recommend(ctx: ServingContext, req: Request) -> Response:
     work_ms = ctx.config.get_optional_float("oryx.test.probe-work-ms") if ctx.config else None
     if work_ms:
         time.sleep(work_ms / 1000.0)
-    body = {"user": req.params["userID"], "generation_id": model.generation_id}
+    user = req.params["userID"]
+    body = {
+        "user": user,
+        "generation_id": model.generation_id,
+        # deterministic per-(generation, user) ranked item list: stable
+        # across replicas and runs, different across generations — the
+        # recommendation surface the experiment evaluator joins
+        # interaction events against (docs/experiments.md)
+        "items": probe_items(model.generation_id, user),
+    }
     return Response(200, body, content_type="application/json")
+
+
+def probe_items(generation_id: str | None, user: str, n: int = 3) -> list[str]:
+    """The ranked items /probe/recommend serves for (generation, user) —
+    exported so scripted feedback (loadgen) and tests can recompute the
+    exact list without parsing responses."""
+    seed = int.from_bytes(
+        hashlib.blake2b(
+            f"{generation_id}:{user}".encode("utf-8"), digest_size=4
+        ).digest(),
+        "big",
+    )
+    return [f"i{(seed + k) % 1000}" for k in range(n)]

@@ -19,6 +19,16 @@ through, which is exactly what rollback needs.
 Records without a parseable generation (legacy inline PMML, foreign
 paths) pass through untouched and reset tracking to "unknown" — never
 dropped, so a registry-less producer keeps working.
+
+With online experiments attached (``oryx.serving.ab``, docs/
+experiments.md) the tracker holds TWO generations at once: the live
+(champion) one and a challenger. A new-generation MODEL record is
+classified against the registry's CHAMPION pointer — it becomes the
+challenger when the pointer names a different generation (the online
+gate published it without moving the pointer), and a live swap
+otherwise (bootstrap, rollback republish, or an offline-promoted
+generation). Duplicate suppression covers both ids, and a champion
+swap mid-experiment keeps the challenger in place.
 """
 
 from __future__ import annotations
@@ -37,6 +47,7 @@ log = logging.getLogger(__name__)
 _MODEL_KEYS = (b"MODEL", b"MODEL-REF")
 
 LIVE_GENERATION_GAUGE = "serving.model.live-generation"
+CHALLENGER_GENERATION_GAUGE = "serving.model.challenger-generation"
 DUPLICATES_COUNTER = "serving.model.duplicates-suppressed"
 FLEET_SKEW_GAUGE = "serving.model.generation-skew"
 
@@ -77,9 +88,13 @@ class GenerationTracker:
     """Tracks the live generation over a stream of update RecordBlocks and
     filters duplicate deliveries of the live generation's MODEL record."""
 
-    def __init__(self, health=None) -> None:
+    def __init__(self, health=None, experiments=None) -> None:
         self.live_generation: str | None = None
+        self.challenger_generation: str | None = None
         self._health = health
+        # ExperimentCoordinator (or any object with wants_challenger /
+        # on_challenger); None keeps the single-generation behavior
+        self._experiments = experiments
 
     def _set_live(self, generation_id: str | None) -> None:
         self.live_generation = generation_id
@@ -87,6 +102,29 @@ class GenerationTracker:
             self._health.live_generation = generation_id
         if generation_id is not None and generation_id.isdigit():
             metrics.registry.gauge(LIVE_GENERATION_GAUGE).set(int(generation_id))
+
+    def _set_challenger(self, generation_id: str | None) -> None:
+        self.challenger_generation = generation_id
+        if self._health is not None:
+            self._health.challenger_generation = generation_id
+        if generation_id is not None and generation_id.isdigit():
+            metrics.registry.gauge(CHALLENGER_GENERATION_GAUGE).set(int(generation_id))
+        if self._experiments is not None:
+            self._experiments.on_challenger(generation_id)
+
+    def promote_challenger(self) -> None:
+        """The online gate promoted the challenger: it becomes the live
+        generation for all traffic on this replica."""
+        generation = self.challenger_generation
+        if generation is None:
+            return
+        self._set_challenger(None)
+        self._set_live(generation)
+
+    def drop_challenger(self) -> None:
+        """The online gate refused the challenger: stop routing to it
+        (the loaded model stays in the manager, unreferenced)."""
+        self._set_challenger(None)
 
     def filter_block(self, block: RecordBlock | None) -> RecordBlock | None:
         """Apply tracking to one polled block; returns the block with
@@ -109,6 +147,22 @@ class GenerationTracker:
                 keep[i] = False
                 metrics.registry.counter(DUPLICATES_COUNTER).inc()
                 log.info("suppressed duplicate %s for live generation %s", key, generation)
+            elif generation is not None and generation == self.challenger_generation:
+                keep[i] = False
+                metrics.registry.counter(DUPLICATES_COUNTER).inc()
+                log.info(
+                    "suppressed duplicate %s for challenger generation %s", key, generation
+                )
+            elif (
+                self._experiments is not None
+                and generation is not None
+                and self.live_generation is not None
+                and self._experiments.wants_challenger(generation)
+            ):
+                # record still reaches the manager so the challenger
+                # model is loaded and servable behind the arm router
+                self._set_challenger(generation)
+                log.info("tracking challenger generation %s (%s)", generation, key)
             else:
                 self._set_live(generation)
         if bool(keep.all()):

@@ -37,6 +37,7 @@ from oryx_tpu.common import metrics, profiling, tracing
 from oryx_tpu.common.config import Config
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
+from oryx_tpu.experiments import routing as _exp_routing
 from oryx_tpu.serving import overload as _overload
 from oryx_tpu.serving.web import (
     OryxServingException,
@@ -164,6 +165,7 @@ class ServingHealth:
         self.consume_thread: SupervisedThread | None = None
         self._draining: bool = False
         self._live_generation: str | None = None
+        self._challenger_generation: str | None = None
 
     @property
     def stream_healthy(self) -> bool | None:
@@ -200,6 +202,18 @@ class ServingHealth:
     def live_generation(self, value: str | None) -> None:
         with self._mu:
             self._live_generation = value
+
+    # generation id of the challenger arm while an online experiment is
+    # active (docs/experiments.md); None otherwise
+    @property
+    def challenger_generation(self) -> str | None:
+        with self._mu:
+            return self._challenger_generation
+
+    @challenger_generation.setter
+    def challenger_generation(self, value: str | None) -> None:
+        with self._mu:
+            self._challenger_generation = value
 
     def mark_stream_ok(self) -> None:
         with self._mu:
@@ -280,6 +294,7 @@ def _healthz(ctx: ServingContext, req: Request) -> Response:
         "stream_healthy": health.stream_healthy,
         "staleness_seconds": health.staleness(),
         "live_generation": health.live_generation,
+        "challenger_generation": health.challenger_generation,
     }
     return Response(200 if health.alive else 503, body, content_type="application/json")
 
@@ -451,6 +466,22 @@ def _model_rollback(ctx: ServingContext, req: Request) -> Response:
     return Response(200, body, content_type="application/json")
 
 
+@resource("GET", "/experiments")
+def _experiments_report(ctx: ServingContext, req: Request) -> Response:
+    """Online-experiment report (docs/experiments.md): arm assignment
+    config, champion/challenger generations, per-arm online metrics and
+    the standing online-gate decision. Always answers — with experiments
+    disabled the body just says so, which keeps `cli experiments` and
+    fleet dashboards probe-safe."""
+    if ctx.experiments is None:
+        return Response(
+            200,
+            {"enabled": False, "active": False},
+            content_type="application/json",
+        )
+    return Response(200, ctx.experiments.report(), content_type="application/json")
+
+
 def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
     dt = time.perf_counter() - t0
     metrics.registry.counter(f"serving.requests.{method}").inc()
@@ -469,6 +500,10 @@ def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
     im.histogram("serving.request.seconds").observe(dt)
     generation = layer.health.live_generation or "none"
     im.counter(f"serving.requests.generation.{generation}").inc()
+    # generation-labeled latency: per-generation dashboards (and the
+    # per-arm comparison while an experiment runs) need the latency
+    # distribution split the same way the request counter is
+    im.histogram(f"serving.request.seconds.generation.{generation}").observe(dt)
 
 
 def observe_block_freshness(raw_trace, instance_metrics=None):
@@ -624,7 +659,27 @@ class ServingLayer:
 
         model_dir = config.get_optional_string("oryx.batch.storage.model-dir")
         self.registry_store = RegistryStore(model_dir) if model_dir else None
-        self.generation_tracker = GenerationTracker(self.health)
+
+        # online experiments (docs/experiments.md): arm router + online
+        # evaluator + evidence-gated promotion loop. Built only when
+        # oryx.serving.ab.fraction > 0 AND a registry is configured (the
+        # CHAMPION pointer is what classifies challenger publishes), so
+        # the request path pays nothing with experiments off.
+        self.experiments = None
+        if (
+            self.registry_store is not None
+            and config.get_float("oryx.serving.ab.fraction") > 0
+        ):
+            from oryx_tpu.experiments.coordinator import ExperimentCoordinator
+
+            self.experiments = ExperimentCoordinator(
+                config, self.registry_store, instance_metrics=self.instance_metrics
+            )
+        self.generation_tracker = GenerationTracker(
+            self.health, experiments=self.experiments
+        )
+        if self.experiments is not None:
+            self.experiments.attach_tracker(self.generation_tracker)
         self._rollback_producer = None
         self._rollback_lock = threading.Lock()
 
@@ -683,6 +738,16 @@ class ServingLayer:
                     input_topic, cfg.get_optional_int("oryx.input-topic.message.partitions") or 1
                 )
             self.input_producer = broker.producer(input_topic)
+
+        if self.experiments is not None and input_broker_loc and input_topic:
+            # online evaluator: follow the input topic live (new events
+            # only — historical interactions can't join future serves)
+            broker = get_broker(input_broker_loc)
+            if not self.no_init_topics:
+                broker.create_topic(
+                    input_topic, cfg.get_optional_int("oryx.input-topic.message.partitions") or 1
+                )
+            self.experiments.start(broker.consumer(input_topic))
 
         if self.model_manager_class:
             self.model_manager = load_instance_of(self.model_manager_class, cfg)
@@ -745,6 +810,7 @@ class ServingLayer:
             rollback_publisher=rollback_publisher,
             instance_metrics=self.instance_metrics,
             admission=self.admission,
+            experiments=self.experiments,
         )
         handler_cls = _make_handler(self, ctx)
         threads = self.config.get_optional_int("oryx.serving.api.threads") or 64
@@ -805,6 +871,11 @@ class ServingLayer:
             # live generation's MODEL before the manager sees the block
             block = self.generation_tracker.filter_block(block)
             if block is not None and len(block) > 0:
+                # generation-aware managers read this during consume to
+                # load a challenger model without swapping it live
+                challenger_ctx = _exp_routing.consume_challenger(
+                    self.generation_tracker.challenger_generation
+                )
                 info = observe_block_freshness(
                     raw_trace, self.instance_metrics
                 )
@@ -814,7 +885,8 @@ class ServingLayer:
                     else None
                 )
                 if apply_ctx is None:
-                    yield block
+                    with challenger_ctx:
+                        yield block
                 else:
                     name = (
                         "serving.model.apply"
@@ -839,7 +911,8 @@ class ServingLayer:
                                         time.time() * 1000 - info.ingest_ms, 3
                                     ),
                                 )
-                            yield block
+                            with challenger_ctx:
+                                yield block
                             if self.health.live_generation is not None:
                                 sp.set(
                                     "generation", self.health.live_generation
@@ -931,6 +1004,8 @@ class ServingLayer:
                 metrics.registry.counter("layer.threads.leaked").inc()
         if self.model_manager is not None:
             self.model_manager.close()
+        if self.experiments is not None:
+            self.experiments.close()
         if self.input_producer is not None:
             self.input_producer.close()
         if self._rollback_producer is not None:
@@ -969,8 +1044,40 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
     scan, and a full-quality request that finds the batcher queue full is
     shed at the door. The served stage is stamped on the response header,
     the request span, and the per-stage counters, so loadgen's achieved-
-    quality accounting always reflects reality, not intent."""
+    quality accounting always reflects reality, not intent.
+
+    While an online experiment is active (docs/experiments.md) the
+    request is first assigned an arm: challenger-arm dispatch runs under
+    a generation override so generation-aware managers serve the
+    challenger model, the arm lands on the X-Oryx-Experiment-Arm header
+    and the request span, and the serve is recorded with the evaluator
+    for the interaction-event join."""
     from oryx_tpu.serving.batcher import BatcherOverloadedError
+
+    t_arrive = time.perf_counter()
+    experiments = layer.experiments
+    assignment = (
+        experiments.assign_request(req.path, req.headers)
+        if experiments is not None
+        else None
+    )
+
+    def _dispatch():
+        if experiments is not None:
+            # pin every request to the tracker's generation for its arm
+            # (challenger for the challenger arm, live for everything
+            # else). With a generation-aware manager this keeps the
+            # champion default intact while a challenger is loaded, and
+            # flips all traffic the moment a promotion swaps the tracker;
+            # managers without per-generation retention ignore it.
+            generation = (
+                assignment[1]
+                if assignment is not None
+                else layer.health.live_generation
+            )
+            with _exp_routing.serve_generation(generation):
+                return layer.router.dispatch(ctx, req)
+        return layer.router.dispatch(ctx, req)
 
     admission = layer.admission
     decision = (
@@ -994,11 +1101,11 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
         try:
             if decision is not None and decision.probe_fraction is not None:
                 with _overload.probe_override(decision.probe_fraction):
-                    response = layer.router.dispatch(ctx, req)
+                    response = _dispatch()
                 if getattr(response, "status", 200) == 200:
                     served = "reduced-probe"
             else:
-                response = layer.router.dispatch(ctx, req)
+                response = _dispatch()
         except BatcherOverloadedError:
             # bounded-queue rejection (oryx.serving.overload.max-queue):
             # an immediate shed decision instead of unbounded queueing,
@@ -1017,6 +1124,9 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
                 and req.method == "GET"
                 and getattr(response, "status", 200) == 200
                 and admission.generation() is not None
+                # challenger answers must never enter the stale cache:
+                # it is stamped with the champion generation
+                and (assignment is None or assignment[0] != _exp_routing.ARM_CHALLENGER)
             ):
                 # feed the stale-answer cache with full-quality answers
                 # only, stamped with the champion generation
@@ -1030,13 +1140,62 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
                     ),
                 )
     if served is not None:
-        _overload.count_shed(served, layer.instance_metrics)
+        _overload.count_shed(
+            served,
+            layer.instance_metrics,
+            generation=(
+                assignment[1] if assignment is not None else layer.health.live_generation
+            ),
+        )
         headers = getattr(response, "headers", None)
         if headers is not None:
             headers[_overload.SHED_HEADER] = served
         if sp is not None:
             sp.set("shed_stage", served)
+    if assignment is not None:
+        arm, generation, user = assignment
+        headers = getattr(response, "headers", None)
+        if headers is not None:
+            headers[_exp_routing.ARM_HEADER] = arm
+        if sp is not None:
+            sp.set("experiment_arm", arm)
+            if generation is not None:
+                sp.set("experiment_generation", generation)
+        items = (
+            _served_items(getattr(response, "body", None))
+            if getattr(response, "status", 200) == 200
+            else ()
+        )
+        experiments.observe_request(
+            user,
+            arm,
+            generation,
+            items,
+            latency_s=time.perf_counter() - t_arrive,
+            shed_stage=served,
+        )
     return response
+
+
+def _served_items(body):
+    """Item ids in a recommendation response body, in rank order, for
+    the online join. Understands the two shapes the app endpoints
+    produce: a dict with an ``items`` list, and a ranked list of
+    item / (item, score) entries."""
+    if isinstance(body, dict):
+        items = body.get("items")
+        if isinstance(items, list):
+            return [str(i) for i in items]
+        return ()
+    if isinstance(body, list):
+        out = []
+        for entry in body:
+            if isinstance(entry, (list, tuple)) and entry:
+                out.append(str(entry[0]))
+            elif isinstance(entry, (str, int)):
+                out.append(str(entry))
+        return out
+    return ()
 
 
 def _make_handler(layer: ServingLayer, ctx: ServingContext):

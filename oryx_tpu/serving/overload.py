@@ -53,7 +53,16 @@ SHED_HEADER = "X-Oryx-Shed-Stage"
 
 # Control-plane paths are exempt from shedding: health and drain signals
 # must stay accurate precisely when the data plane is overloaded.
-_EXEMPT_PREFIXES = ("/healthz", "/readyz", "/ready", "/metrics", "/trace", "/model/", "/debug/")
+_EXEMPT_PREFIXES = (
+    "/healthz",
+    "/readyz",
+    "/ready",
+    "/metrics",
+    "/trace",
+    "/model/",
+    "/debug/",
+    "/experiments",
+)
 
 
 def exempt(path: str) -> bool:
@@ -141,12 +150,19 @@ class OverloadConfig:
 _SHED_COUNTER_PREFIX = "serving.overload.shed."
 
 
-def count_shed(stage_name: str, instance_metrics=None) -> None:
-    """Count one answer served below full quality at `stage_name`."""
+def count_shed(stage_name: str, instance_metrics=None, generation=None) -> None:
+    """Count one answer served below full quality at `stage_name`.
+
+    When the generation that would have served the request is known, a
+    generation-labeled twin is counted alongside, so per-generation (and
+    per-experiment-arm) dashboards see *which* model's traffic was
+    degraded."""
     name = _SHED_COUNTER_PREFIX + stage_name
     metrics.registry.counter(name).inc()
     if instance_metrics is not None:
         instance_metrics.counter(name).inc()
+        if generation is not None:
+            instance_metrics.counter(f"{name}.generation.{generation}").inc()
 
 
 # -- stale-answer cache ------------------------------------------------------

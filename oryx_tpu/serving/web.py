@@ -110,6 +110,7 @@ class ServingContext:
         rollback_publisher=None,
         instance_metrics=None,
         admission=None,
+        experiments=None,
     ) -> None:
         self.model_manager = model_manager
         self.input_producer = input_producer
@@ -129,6 +130,9 @@ class ServingContext:
         # AdmissionController (oryx_tpu/serving/overload.py) when overload
         # control is enabled under a full ServingLayer; None otherwise
         self.admission = admission
+        # ExperimentCoordinator (oryx_tpu/experiments/coordinator.py)
+        # when online experiments are enabled; backs GET /experiments
+        self.experiments = experiments
 
 
 # ---------------------------------------------------------------------------

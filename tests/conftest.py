@@ -165,3 +165,9 @@ def pytest_configure(config):
         "publish hand-off); runs under the OrderedLock watchdog, select "
         "with -m pipeline",
     )
+    config.addinivalue_line(
+        "markers",
+        "experiments: online champion/challenger experiment tests (sticky "
+        "arm routing, interleaved evaluation joins, evidence-gated "
+        "promotion); fast and tier-1-safe, select with -m experiments",
+    )

@@ -1,0 +1,134 @@
+"""Interleaved online evaluation: serve/event joins, window expiry, and
+the per-arm evidence the online gate consumes (docs/experiments.md)."""
+
+import json
+
+import pytest
+
+from oryx_tpu.experiments.evaluator import ExperimentEvaluator, parse_event
+from oryx_tpu.experiments.routing import ABConfig, ARM_CHALLENGER, ARM_CHAMPION
+
+pytestmark = pytest.mark.experiments
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(join_window_s=10.0, max_tracked_users=100):
+    clock = FakeClock()
+    ev = ExperimentEvaluator(
+        ABConfig(
+            fraction=0.1,
+            join_window_s=join_window_s,
+            max_tracked_users=max_tracked_users,
+        ),
+        clock=clock,
+    )
+    return ev, clock
+
+
+def test_parse_event():
+    assert parse_event("u1,i5") == ("u1", "i5")
+    assert parse_event("u1,i5,4.5") == ("u1", "i5")
+    assert parse_event(" u1 , i5 ") == ("u1", "i5")
+    assert parse_event("not-an-event") is None
+    assert parse_event("u1,") is None
+    assert parse_event("") is None
+
+
+def test_join_within_window_scores_reciprocal_rank():
+    ev, clock = make()
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i1", "i2", "i3"])
+    clock.t += 1.0
+    assert ev.observe_event("u1,i2") is True  # rank 2 -> outcome 0.5
+    stats = ev.arms[ARM_CHAMPION]
+    assert stats.serves == 1 and stats.resolved == 1 and stats.hits == 1
+    assert stats.hit_rate == 1.0
+    assert stats.mrr == pytest.approx(0.5)
+
+
+def test_event_for_unserved_item_is_joined_miss():
+    ev, clock = make()
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i1", "i2"])
+    assert ev.observe_event("u1,i99") is True  # joined, but not in the list
+    stats = ev.arms[ARM_CHAMPION]
+    assert stats.resolved == 1 and stats.hits == 0
+    assert stats.hit_rate == 0.0 and stats.mrr == 0.0
+
+
+def test_window_expiry_resolves_as_miss():
+    ev, clock = make(join_window_s=5.0)
+    ev.observe_serve("u1", ARM_CHALLENGER, "200", ["i1"])
+    clock.t += 6.0
+    ev.tick()
+    stats = ev.arms[ARM_CHALLENGER]
+    assert stats.resolved == 1 and stats.hits == 0
+    # a late event no longer joins anything
+    assert ev.observe_event("u1,i1") is False
+
+
+def test_events_join_oldest_pending_serve_first():
+    ev, clock = make()
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i1"])
+    clock.t += 1.0
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i2"])
+    assert ev.observe_event("u1,i2") is True  # resolves the i1 serve: miss
+    assert ev.observe_event("u1,i2") is True  # resolves the i2 serve: hit
+    stats = ev.arms[ARM_CHAMPION]
+    assert stats.resolved == 2 and stats.hits == 1
+
+
+def test_itemless_serves_count_traffic_but_never_pend():
+    ev, clock = make()
+    ev.observe_serve("u1", ARM_CHAMPION, "100", [], latency_s=0.01, shed_stage="deadline")
+    stats = ev.arms[ARM_CHAMPION]
+    assert stats.serves == 1 and stats.shed == {"deadline": 1}
+    assert ev.observe_event("u1,i1") is False
+    assert stats.resolved == 0
+
+
+def test_lru_eviction_resolves_as_miss():
+    ev, clock = make(max_tracked_users=2)
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i1"])
+    ev.observe_serve("u2", ARM_CHAMPION, "100", ["i1"])
+    ev.observe_serve("u3", ARM_CHAMPION, "100", ["i1"])  # evicts u1
+    stats = ev.arms[ARM_CHAMPION]
+    assert stats.resolved == 1 and stats.hits == 0
+    assert ev.snapshot()["pending_serves"] == 2
+
+
+def test_pair_counts_index_paired():
+    ev, clock = make()
+    # champion: hit, miss; challenger: hit@1, hit@1, miss
+    for arm, item_lists, events in (
+        (ARM_CHAMPION, [["a"], ["b"]], ["a", "x"]),
+        (ARM_CHALLENGER, [["a"], ["b"], ["c"]], ["a", "b", "x"]),
+    ):
+        for i, (items, event_item) in enumerate(zip(item_lists, events)):
+            user = f"{arm}-u{i}"
+            ev.observe_serve(user, arm, "g", items)
+            ev.observe_event(f"{user},{event_item}")
+    pos, neg, ties = ev.pair_counts()
+    # pairs: (champ 1.0 vs chal 1.0) tie, (champ 0.0 vs chal 1.0) win;
+    # challenger's third outcome has no champion partner yet
+    assert (pos, neg, ties) == (1, 0, 1)
+
+
+def test_snapshot_serializable_and_reset():
+    ev, clock = make()
+    ev.observe_serve("u1", ARM_CHAMPION, "100", ["i1"], latency_s=0.02)
+    ev.observe_event("u1,i1")
+    snap = ev.snapshot()
+    json.dumps(snap)  # must be JSON-serializable: it is the /experiments body
+    assert snap["arms"][ARM_CHAMPION]["resolved"] == 1
+    assert snap["arms"][ARM_CHAMPION]["latency"]["samples"] == 1
+    assert snap["events_seen"] == 1 and snap["events_joined"] == 1
+    ev.reset()
+    fresh = ev.snapshot()
+    assert fresh["arms"][ARM_CHAMPION]["serves"] == 0
+    assert fresh["events_seen"] == 0 and fresh["pending_serves"] == 0

@@ -1,0 +1,216 @@
+"""Online-experiment acceptance (-m fleet): 3-replica fleet under
+open-loop load runs a 10% champion/challenger split with scripted
+interaction feedback closing the loop (docs/experiments.md).
+
+The two scenarios the evidence-gated promotion story stands on:
+
+- a genuinely-better challenger (scripted engagement 0.85 vs the
+  champion's 0.35) accumulates >= min-samples per arm and is PROMOTED —
+  the CHAMPION pointer moves, every replica flips live to it, and the
+  decision lands in its manifest;
+- a seeded-worse challenger (0.08 vs 0.55) is REFUSED — the pointer
+  never moves, the manifest records the refusal, and every replica
+  stops routing to it.
+
+Both run with zero failed requests, sticky per-user arms, and per-arm
+metrics visible on /metrics and GET /experiments throughout."""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+from oryx_tpu.experiments.routing import ARM_CHALLENGER, ARM_CHAMPION
+from oryx_tpu.loadgen import OpenLoopEngine, PoissonProcess, PowerLawUsers
+from oryx_tpu.registry.manifest import ONLINE_PROMOTED, ONLINE_REFUSED
+from oryx_tpu.registry.store import RegistryStore
+
+from fleet import FleetHarness  # noqa: E402
+
+pytestmark = [pytest.mark.fleet, pytest.mark.experiments]
+
+# 10% challenger split; small join window + sample bars so an 8-second
+# run resolves enough outcomes per replica to conclude the experiment
+OVERLAY = """
+oryx {
+  serving.ab { fraction = 0.10, join-window-s = 1.5 }
+  ml.gate.online {
+    enabled = true
+    min-samples = 8
+    min-lift = 0.0
+    max-harm = 0.05
+    confidence = 0.9
+    check-interval-s = 0.2
+  }
+}
+"""
+
+
+def _run_split_traffic(fleet, feedback, seconds=8.0, rate=150.0, seed=11):
+    engine = OpenLoopEngine(
+        fleet.targets,
+        template="/probe/recommend/u%d",
+        readiness_poll_s=0.1,
+        on_response=feedback.on_response,
+    )
+    return engine.run(
+        PoissonProcess(rate=rate, seed=seed),
+        # near-uniform users: every run exercises many distinct
+        # experiment units in both arms
+        PowerLawUsers(600, exponent=0.2, seed=seed),
+        seconds,
+    )
+
+
+def _assert_sticky_arms(result) -> dict:
+    """Every user that saw an arm header saw exactly one arm; returns
+    user -> arm for further assertions."""
+    by_user: dict = {}
+    for r in result.records:
+        if r.arm is not None and r.user is not None:
+            by_user.setdefault(r.user, set()).add(r.arm)
+    assert by_user, "no arm-attributed responses recorded"
+    for user, arms in by_user.items():
+        assert len(arms) == 1, f"user {user} bounced between arms: {arms}"
+    return {user: next(iter(arms)) for user, arms in by_user.items()}
+
+
+def _assert_per_arm_observability(fleet, challenger_expected: bool) -> None:
+    """Per-arm metrics are visible on every replica's /metrics and its
+    GET /experiments report."""
+    for i in fleet.live_indices():
+        snap = fleet.metrics_snapshot(i)
+        assert f"serving.experiment.requests.{ARM_CHAMPION}" in snap, f"replica {i}"
+        if challenger_expected:
+            assert (
+                f"serving.experiment.requests.{ARM_CHALLENGER}" in snap
+            ), f"replica {i}"
+        report = fleet.experiment_report(i)
+        assert report["enabled"] and report["fraction"] == pytest.approx(0.10)
+        arms = report["report"]["arms"]
+        assert arms[ARM_CHAMPION]["serves"] > 0, f"replica {i}"
+        if challenger_expected:
+            assert arms[ARM_CHALLENGER]["serves"] > 0, f"replica {i}"
+
+
+def _wait(predicate, timeout: float, poll: float = 0.1) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def test_fleet_promotes_genuinely_better_challenger(tmp_path):
+    with FleetHarness(
+        3, str(tmp_path), bus_name="fleet-exp-promote", overlay=OVERLAY
+    ) as fleet:
+        gen_a = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(gen_a, timeout=15.0)
+        store = RegistryStore(fleet.model_dir)
+        assert store.champion_id() == gen_a
+
+        # scripted ground truth: champion engages at 0.35, anything else
+        # (the challenger) at 0.85 — the challenger IS better online
+        feedback = fleet.attach_feedback({gen_a: 0.35}, default=0.85)
+
+        # online gate on + champion present: publish does NOT move the
+        # pointer; every replica classifies the new generation challenger
+        gen_b = fleet.publish(metric=0.92)
+        assert fleet.wait_challenger(gen_b, timeout=10.0)
+        assert store.champion_id() == gen_a
+        assert all(g == gen_a for g in fleet.replica_generations())
+
+        result = _run_split_traffic(fleet, feedback)
+
+        # zero-downtime bar: the split+observe path failed no request
+        assert result.failed == 0, dict(result.error_kinds)
+        assert result.ok > 0 and feedback.sent > 0
+
+        arm_of = _assert_sticky_arms(result)
+        assert ARM_CHALLENGER in arm_of.values(), "split routed nobody"
+        assert ARM_CHAMPION in arm_of.values()
+        _assert_per_arm_observability(fleet, challenger_expected=True)
+
+        # evidence-gated promotion: the pointer moves, every replica
+        # flips live to the promoted generation and clears its challenger
+        assert _wait(lambda: store.champion_id() == gen_b, timeout=20.0), (
+            "online gate never promoted: "
+            f"{[fleet.experiment_report(i).get('decision') for i in fleet.live_indices()]}"
+        )
+        assert fleet.wait_converged(gen_b, timeout=10.0)
+        assert _wait(
+            lambda: all(g is None for g in fleet.challenger_generations()),
+            timeout=10.0,
+        )
+
+        # the decision is durable evidence in the generation manifest
+        manifest = store.read_manifest(gen_b)
+        assert manifest.online_status == ONLINE_PROMOTED
+        assert manifest.online_samples[ARM_CHAMPION] >= 8
+        assert manifest.online_samples[ARM_CHALLENGER] >= 8
+        assert manifest.online_lift is not None and manifest.online_lift > 0
+        assert manifest.online_confidence is not None
+        assert manifest.online_confidence >= 0.9
+
+        # promoted generation actually serves now (per-request evidence)
+        import json
+        import urllib.request
+
+        for i in fleet.live_indices():
+            body = json.loads(
+                urllib.request.urlopen(
+                    f"{fleet.targets[i].base_url}/probe/recommend/u3", timeout=5
+                ).read()
+            )
+            assert body["generation_id"] == gen_b, f"replica {i}"
+
+
+def test_fleet_refuses_seeded_worse_challenger(tmp_path):
+    with FleetHarness(
+        3, str(tmp_path), bus_name="fleet-exp-refuse", overlay=OVERLAY
+    ) as fleet:
+        gen_a = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(gen_a, timeout=15.0)
+        store = RegistryStore(fleet.model_dir)
+
+        # seeded-worse challenger: engagement 0.08 vs the champion's 0.55
+        feedback = fleet.attach_feedback({gen_a: 0.55}, default=0.08)
+        gen_b = fleet.publish(metric=0.92)
+        assert fleet.wait_challenger(gen_b, timeout=10.0)
+
+        result = _run_split_traffic(fleet, feedback, seed=13)
+        assert result.failed == 0, dict(result.error_kinds)
+
+        arm_of = _assert_sticky_arms(result)
+        assert ARM_CHALLENGER in arm_of.values()
+        _assert_per_arm_observability(fleet, challenger_expected=True)
+
+        # the gate refuses: manifest records it, pointer never moves
+        def _refused() -> bool:
+            m = store.read_manifest(gen_b)
+            return m is not None and m.online_status == ONLINE_REFUSED
+
+        assert _wait(_refused, timeout=20.0), (
+            "online gate never refused: "
+            f"{[fleet.experiment_report(i).get('decision') for i in fleet.live_indices()]}"
+        )
+        manifest = store.read_manifest(gen_b)
+        assert manifest.online_status == ONLINE_REFUSED
+        assert manifest.online_lift is not None and manifest.online_lift < 0
+        assert store.champion_id() == gen_a
+
+        # every replica stops routing to the refused challenger and keeps
+        # serving the champion
+        assert _wait(
+            lambda: all(g is None for g in fleet.challenger_generations()),
+            timeout=10.0,
+        )
+        assert all(g == gen_a for g in fleet.replica_generations())

@@ -1,0 +1,124 @@
+"""Evidence-gated promotion: the paired sign test and the online
+promote/refuse/continue bars (docs/experiments.md)."""
+
+import math
+
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.registry.gate import (
+    ChampionGate,
+    OnlineGateConfig,
+    sign_test_confidence,
+)
+
+pytestmark = pytest.mark.experiments
+
+
+def make_gate(**overrides) -> ChampionGate:
+    lines = "\n".join(f"{k} = {v}" for k, v in overrides.items())
+    return ChampionGate(
+        C.get_default().with_overlay(
+            f"oryx.ml.gate.online {{ enabled = true\n{lines} }}"
+        )
+    )
+
+
+def test_sign_test_math():
+    assert sign_test_confidence(0, 0) == 0.0
+    # symmetric: even split carries no evidence either way
+    assert sign_test_confidence(5, 5) == sign_test_confidence(5, 5)
+    assert sign_test_confidence(5, 5) < 0.5
+    # exact binomial tails
+    assert sign_test_confidence(10, 0) == pytest.approx(1.0 - 1.0 / 2**10)
+    n, wins = 50, 40
+    tail = sum(math.comb(n, k) for k in range(wins, n + 1)) / 2.0**n
+    assert sign_test_confidence(40, 10) == pytest.approx(1.0 - tail)
+    # monotone in wins at fixed n
+    assert sign_test_confidence(30, 20) < sign_test_confidence(40, 10)
+
+
+def test_online_config_defaults_and_overlay():
+    cfg = OnlineGateConfig.from_config(C.get_default())
+    assert cfg.enabled is False
+    assert cfg.min_samples == 50
+    assert cfg.max_harm == 0.05
+    assert cfg.confidence == 0.95
+    on = OnlineGateConfig.from_config(
+        C.get_default().with_overlay(
+            "oryx.ml.gate.online { enabled = true, min-samples = 8 }"
+        )
+    )
+    assert on.enabled is True and on.min_samples == 8
+
+
+def test_continue_until_min_samples():
+    gate = make_gate(**{"min-samples": 20})
+    d = gate.decide_online(
+        champion_samples=19,
+        challenger_samples=100,
+        champion_hit_rate=0.1,
+        challenger_hit_rate=0.9,
+        challenger_wins=50,
+        champion_wins=0,
+    )
+    assert d.verdict == "continue" and not d.concluded
+    assert "insufficient samples" in d.reason
+
+
+def test_promotes_confidently_better_challenger():
+    gate = make_gate(**{"min-samples": 20, "confidence": 0.95})
+    d = gate.decide_online(
+        champion_samples=60,
+        challenger_samples=60,
+        champion_hit_rate=0.20,
+        challenger_hit_rate=0.45,
+        challenger_wins=30,
+        champion_wins=8,
+    )
+    assert d.verdict == "promote" and d.concluded
+    assert d.lift == pytest.approx(0.25)
+    assert d.confidence >= 0.95
+
+
+def test_refuses_confidently_worse_challenger():
+    gate = make_gate(**{"min-samples": 20, "max-harm": 0.05})
+    d = gate.decide_online(
+        champion_samples=60,
+        challenger_samples=60,
+        champion_hit_rate=0.45,
+        challenger_hit_rate=0.20,
+        challenger_wins=8,
+        champion_wins=30,
+    )
+    assert d.verdict == "refuse" and d.concluded
+    assert d.lift == pytest.approx(-0.25)
+
+
+def test_small_harm_within_tolerance_keeps_running():
+    # worse, but inside max-harm: neither promoted nor refused
+    gate = make_gate(**{"min-samples": 20, "max-harm": 0.10})
+    d = gate.decide_online(
+        champion_samples=60,
+        challenger_samples=60,
+        champion_hit_rate=0.42,
+        challenger_hit_rate=0.38,
+        challenger_wins=10,
+        champion_wins=20,
+    )
+    assert d.verdict == "continue"
+
+
+def test_inconclusive_wins_keep_running():
+    # big observed lift but near-even pairs: confidence bar not met
+    gate = make_gate(**{"min-samples": 20, "confidence": 0.95})
+    d = gate.decide_online(
+        champion_samples=60,
+        challenger_samples=60,
+        champion_hit_rate=0.30,
+        challenger_hit_rate=0.40,
+        challenger_wins=16,
+        champion_wins=14,
+    )
+    assert d.verdict == "continue"
+    assert "inconclusive" in d.reason

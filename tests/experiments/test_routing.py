@@ -1,0 +1,96 @@
+"""Arm routing: deterministic sticky bucketing, user extraction, and the
+per-request generation override (docs/experiments.md)."""
+
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.experiments.routing import (
+    ABConfig,
+    ARM_CHALLENGER,
+    ARM_CHAMPION,
+    ARM_HEADER,
+    ArmRouter,
+    bucket_of,
+    requested_generation,
+    serve_generation,
+)
+
+pytestmark = pytest.mark.experiments
+
+
+def test_bucket_deterministic_and_stable():
+    # stable across calls AND across processes/runs (blake2b, not the
+    # per-process-salted builtin hash) — pinned values guard that
+    assert bucket_of("u1", "oryx-ab") == bucket_of("u1", "oryx-ab")
+    assert bucket_of("u1", "oryx-ab") != bucket_of("u1", "other-salt")
+    assert 0.0 <= bucket_of("u1", "oryx-ab") < 1.0
+    assert bucket_of("u1", "oryx-ab") == pytest.approx(0.0179041451, abs=1e-9)
+    assert bucket_of("u2", "oryx-ab") == pytest.approx(0.5502204657, abs=1e-9)
+
+
+def test_bucket_is_roughly_uniform():
+    buckets = [bucket_of(f"u{i}", "oryx-ab") for i in range(4000)]
+    share = sum(1 for b in buckets if b < 0.10) / len(buckets)
+    assert 0.07 < share < 0.13
+
+
+def test_assignment_sticky_and_fraction_bounded():
+    router = ArmRouter(ABConfig(fraction=0.10))
+    arms = {u: router.assign(u) for u in (f"u{i}" for i in range(2000))}
+    # sticky: re-assigning never changes the arm
+    for user, arm in arms.items():
+        assert router.assign(user) == arm
+    share = sum(1 for a in arms.values() if a == ARM_CHALLENGER) / len(arms)
+    assert 0.06 < share < 0.14
+
+    # fraction boundaries: 0 -> nobody, 1 -> everybody
+    all_champion = ArmRouter(ABConfig(fraction=0.0))
+    all_challenger = ArmRouter(ABConfig(fraction=1.0))
+    for user in list(arms)[:50]:
+        assert all_champion.assign(user) == ARM_CHAMPION
+        assert all_challenger.assign(user) == ARM_CHALLENGER
+
+
+def test_user_extraction_header_beats_path():
+    router = ArmRouter(ABConfig())
+    assert router.user_of("/recommend/u7") == "u7"
+    assert router.user_of("/api/recommend/u7?howMany=3") == "u7"
+    assert router.user_of("/probe/recommendToMany/u9") == "u9"
+    assert router.user_of("/metrics") is None
+    # the explicit attribution header wins over the path
+    assert router.user_of("/recommend/u7", {"X-Oryx-User": "alice"}) == "alice"
+    assert router.user_of("/recommend/u7", {"x-oryx-user": "alice"}) == "alice"
+    # empty header falls back to the path
+    assert router.user_of("/recommend/u7", {"X-Oryx-User": ""}) == "u7"
+
+
+def test_abconfig_from_default_config():
+    cfg = ABConfig.from_config(C.get_default())
+    assert cfg.fraction == 0.0
+    assert not cfg.enabled
+    assert cfg.salt == "oryx-ab"
+    assert cfg.join_window_s > 0
+    assert cfg.max_tracked_users > 0
+    on = ABConfig.from_config(
+        C.get_default().with_overlay("oryx.serving.ab.fraction = 0.25")
+    )
+    assert on.enabled and on.fraction == 0.25
+
+
+def test_serve_generation_override_scoped():
+    assert requested_generation() is None
+    with serve_generation("123"):
+        assert requested_generation() == "123"
+        with serve_generation("456"):
+            assert requested_generation() == "456"
+        assert requested_generation() == "123"
+    assert requested_generation() is None
+
+
+def test_engine_mirrors_arm_header_constant():
+    # oryx_tpu/loadgen/engine.py keeps a copy of the header name so the
+    # loadgen client stays importable without the experiments package;
+    # this pins the two constants together
+    from oryx_tpu.loadgen import engine
+
+    assert engine.ARM_HEADER == ARM_HEADER

@@ -126,3 +126,38 @@ def test_zero_test_fraction_forces_single_candidate(tmp_path):
     # single candidate trained on everything, NaN eval accepted
     assert update.train_counts == [10]
     assert (tmp_path / "model" / "2" / "model.pmml").exists()
+
+
+def test_online_gate_publishes_challenger_without_champion_move(tmp_path):
+    """With oryx.ml.gate.online enabled, an offline-passing candidate is
+    published and manifested `online_status = pending` but the CHAMPION
+    pointer stays put — the online gate owns promotion from live
+    evidence (docs/experiments.md). Bootstrap still promotes."""
+    from oryx_tpu.registry.manifest import ONLINE_PENDING
+    from oryx_tpu.registry.store import RegistryStore
+
+    cfg = make_config(tmp_path, candidates=1).with_overlay(
+        "oryx.ml.gate.online.enabled = true"
+    )
+    update = MockMLUpdate(cfg)
+    broker = bus.get_broker("inproc://ml-test-online")
+    broker.create_topic("OryxUpdate", 1)
+    tail = broker.consumer("OryxUpdate", from_beginning=True)
+    model_dir = str(tmp_path / "model")
+    store = RegistryStore(model_dir)
+
+    # bootstrap: no champion yet -> immediate promotion, no pending mark
+    with broker.producer("OryxUpdate") as producer:
+        update.run_update(100, data(20), [], model_dir, producer)
+    assert store.champion_id() == "100"
+    assert store.read_manifest("100").online_status is None
+
+    # champion exists -> the new generation publishes as the challenger
+    with broker.producer("OryxUpdate") as producer:
+        update.run_update(200, data(20), [], model_dir, producer)
+    assert store.champion_id() == "100"  # pointer NOT moved
+    manifest = store.read_manifest("200")
+    assert manifest.online_status == ONLINE_PENDING
+    # ...but the MODEL record still went out so serving can load it
+    keys = [m.key for m in tail.poll(timeout=1.0) if m.key != tracing.TRACE_KEY]
+    assert keys.count("MODEL") == 2

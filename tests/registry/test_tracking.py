@@ -88,3 +88,115 @@ def test_tracker_fast_paths():
     assert tracker.filter_block(None) is None
     no_models = block(KeyMessage("UP", "x"), KeyMessage(None, "y"))
     assert tracker.filter_block(no_models) is no_models
+
+
+# --- two-generation (online experiment) mode --------------------------------
+
+
+class FakeExperiments:
+    """Stands in for ExperimentCoordinator: classifies a new generation
+    as challenger whenever the (fake) CHAMPION pointer names another."""
+
+    def __init__(self, champion: str | None):
+        self.champion = champion
+        self.challenger_events: list = []
+
+    def wants_challenger(self, generation: str) -> bool:
+        return self.champion is not None and generation != self.champion
+
+    def on_challenger(self, generation: str | None) -> None:
+        self.challenger_events.append(generation)
+
+
+def test_tracker_classifies_challenger_and_record_passes_through():
+    health = ServingHealth()
+    exp = FakeExperiments(champion=None)
+    tracker = GenerationTracker(health, experiments=exp)
+
+    # bootstrap: no champion pointer yet -> plain live swap
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    assert tracker.live_generation == "100"
+    assert tracker.challenger_generation is None
+
+    # online gate published 200 WITHOUT moving the pointer -> challenger,
+    # and the record must still reach the manager so the model loads
+    exp.champion = "100"
+    out = tracker.filter_block(block(KeyMessage("MODEL", model_message("200"))))
+    assert out is not None and len(out) == 1
+    assert tracker.live_generation == "100"
+    assert tracker.challenger_generation == "200"
+    assert health.challenger_generation == "200"
+    assert exp.challenger_events == ["200"]
+
+
+def test_tracker_dedupes_both_live_and_challenger():
+    exp = FakeExperiments(champion="100")
+    tracker = GenerationTracker(experiments=exp)
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("200"))))
+    assert tracker.challenger_generation == "200"
+    # redelivery of EITHER tracked generation is swallowed
+    assert tracker.filter_block(block(KeyMessage("MODEL", model_message("100")))) is None
+    assert tracker.filter_block(block(KeyMessage("MODEL", model_message("200")))) is None
+    assert tracker.live_generation == "100"
+    assert tracker.challenger_generation == "200"
+
+
+def test_tracker_rollback_mid_experiment_is_live_swap():
+    exp = FakeExperiments(champion="150")
+    tracker = GenerationTracker(experiments=exp)
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("150"))))
+    exp.champion = "150"
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("200"))))
+    assert tracker.challenger_generation == "200"
+
+    # rollback to 100: the endpoint moves the CHAMPION pointer FIRST,
+    # then republishes -> classified as a live swap, experiment intact
+    exp.champion = "100"
+    out = tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    assert out is not None and len(out) == 1
+    assert tracker.live_generation == "100"
+    assert tracker.challenger_generation == "200"
+
+
+def test_tracker_champion_swap_keeps_challenger():
+    exp = FakeExperiments(champion="100")
+    tracker = GenerationTracker(experiments=exp)
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("200"))))
+    assert tracker.challenger_generation == "200"
+
+    # an offline-promoted 300 moves the pointer before publishing: live
+    # swaps under the challenger, which keeps receiving its traffic
+    exp.champion = "300"
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("300"))))
+    assert tracker.live_generation == "300"
+    assert tracker.challenger_generation == "200"
+
+
+def test_tracker_promote_and_drop_challenger():
+    health = ServingHealth()
+    exp = FakeExperiments(champion="100")
+    tracker = GenerationTracker(health, experiments=exp)
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("200"))))
+
+    tracker.promote_challenger()
+    assert tracker.live_generation == "200"
+    assert tracker.challenger_generation is None
+    assert health.live_generation == "200"
+    assert health.challenger_generation is None
+    # on_challenger(None) fired so the coordinator can clear its state
+    assert exp.challenger_events[-1] is None
+
+    # refuse path: drop without touching live
+    exp.champion = "200"
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("300"))))
+    assert tracker.challenger_generation == "300"
+    tracker.drop_challenger()
+    assert tracker.challenger_generation is None
+    assert tracker.live_generation == "200"
+
+    # promote with no challenger is a no-op
+    tracker.promote_challenger()
+    assert tracker.live_generation == "200"

@@ -73,6 +73,7 @@ from oryx_tpu.serving.autoscale import (
 from oryx_tpu.serving.layer import ServingLayer
 
 UPDATE_TOPIC = "OryxUpdate"
+INPUT_TOPIC = "OryxInput"
 
 
 def _http(method: str, url: str, timeout: float = 10.0):
@@ -131,6 +132,8 @@ class FleetHarness:
         # scenario's SLO p99 when driven via run_scenario)
         self.rate_window_s = 2.0
         self.slo_p99_ms = 1000.0
+        # scripted-feedback producer on the input topic (attach_feedback)
+        self._feedback_producer = None
 
     # -- replica lifecycle ---------------------------------------------------
 
@@ -203,6 +206,12 @@ class FleetHarness:
                 layer.close()
             except Exception as e:  # close the rest before surfacing
                 errors.append(e)
+        producer, self._feedback_producer = self._feedback_producer, None
+        if producer is not None:
+            try:
+                producer.close()
+            except Exception as e:
+                errors.append(e)
         if errors:
             raise errors[0]
 
@@ -260,6 +269,51 @@ class FleetHarness:
                 return True
             time.sleep(0.05)
         return False
+
+    # -- online experiments (docs/experiments.md) ----------------------------
+
+    def challenger_generations(self) -> list[str | None]:
+        """Each live replica's challenger generation (None = no active
+        experiment on that replica)."""
+        return [layer.health.challenger_generation for layer in self._live_replicas()]
+
+    def wait_challenger(self, generation: str, timeout: float = 10.0) -> bool:
+        """True once every replica tracks `generation` as the challenger."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(g == generation for g in self.challenger_generations()):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def experiment_report(self, replica: int) -> dict:
+        """One replica's GET /experiments body."""
+        status, body = _http("GET", f"{self.targets[replica].base_url}/experiments")
+        if status != 200:
+            return {}
+        return json.loads(body)
+
+    def attach_feedback(self, hit_rates: dict, default: float = 0.0, seed: int = 7):
+        """Wire scripted interaction feedback into the fleet: returns a
+        ScriptedFeedback whose events land on the fleet's input topic
+        (raw inner broker — feedback is user behavior, not chaos target),
+        for use as OpenLoopEngine(..., on_response=fb.on_response).
+        `hit_rates` maps generation id -> engagement probability;
+        unknown generations engage at `default`."""
+        from oryx_tpu.loadgen import ScriptedFeedback
+
+        broker = bus.get_broker(self.inner_locator)
+        broker.create_topic(INPUT_TOPIC, 1)
+        if self._feedback_producer is None:
+            self._feedback_producer = broker.producer(INPUT_TOPIC)
+        producer = self._feedback_producer
+
+        def send(line: str) -> None:
+            producer.send(None, line)
+
+        return ScriptedFeedback(
+            send, lambda gen: hit_rates.get(gen, default), seed=seed
+        )
 
     # -- scenario actions ----------------------------------------------------
 
@@ -438,6 +492,7 @@ def run_scenario(
     scenario: Scenario,
     max_inflight: int = 128,
     timeout_s: float = 10.0,
+    on_response=None,
 ):
     """Drive one scripted scenario: traffic + action timeline + verdict.
     Returns (LoadResult, SLOVerdict, ScenarioRunner)."""
@@ -448,6 +503,7 @@ def run_scenario(
         template=scenario.template,
         max_inflight=max_inflight,
         timeout_s=timeout_s,
+        on_response=on_response,
     )
     runner = ScenarioRunner(scenario.actions, harness.handlers())
     runner.start()

@@ -183,6 +183,20 @@ def eval_bundled_digits() -> dict:
     }
 
 
+def skip_row(metric: str, dataset_path: str) -> dict:
+    """Explicit evidence that a real-dataset row was NOT measured, and
+    why — a sandbox with no egress cannot fetch the dataset. A skip row
+    in the evidence file is auditable; a silent stderr line is not."""
+    return {
+        "metric": metric,
+        "status": "SKIPPED: no-egress",
+        "reason": f"{dataset_path} absent; this sandbox has no network. "
+        "Run `python tools/fetch_datasets.py` where egress is allowed, "
+        "then re-run tools/real_data_eval.py — the eval path runs "
+        "unchanged once the files exist.",
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default="data/real")
@@ -197,24 +211,40 @@ def main() -> None:
     args = ap.parse_args()
     data_dir = Path(args.data)
     results = []
+    measured = 0
     if (data_dir / "ml-100k" / "u.data").exists():
         results.append(eval_ml100k(data_dir))
+        measured += 1
     else:
-        print("ml-100k missing — run tools/fetch_datasets.py first", file=sys.stderr)
+        results.append(
+            skip_row(
+                "ALS held-out RMSE, REAL MovieLens-100K (rank 25, lam 0.1, "
+                "time-ordered 90/10, 10 sweeps)",
+                str(data_dir / "ml-100k" / "u.data"),
+            )
+        )
     if (data_dir / "covtype.data").exists():
         results.append(eval_covtype(data_dir))
+        measured += 1
     else:
-        print("covtype missing — run tools/fetch_datasets.py first", file=sys.stderr)
+        results.append(
+            skip_row(
+                "RDF held-out accuracy, REAL UCI covtype (581K rows, 20 trees "
+                "depth 10)",
+                str(data_dir / "covtype.data"),
+            )
+        )
     if args.bundled:
         results.append(eval_bundled_iris())
         results.append(eval_bundled_digits())
+        measured += 2
     for r in results:
         print(json.dumps(r), flush=True)
     if args.out and results:
         with open(args.out, "a", encoding="utf-8") as f:
             for r in results:
                 f.write(json.dumps(r) + "\n")
-    if not results:
+    if not measured and not any(r.get("status") for r in results):
         sys.exit(2)
 
 
