@@ -2081,6 +2081,55 @@ def bench_overload() -> None:
         raise RuntimeError("overload bench failed: " + "; ".join(failures))
 
 
+def bench_crash_recovery() -> None:
+    """Crash-recovery row: 3 subprocess replicas under open-loop load, one
+    SIGKILLed mid-run (no drain). Value = SIGKILL->/readyz recovery time
+    of the killed slot (respawn + restage-cache repair + update-topic
+    replay); vs_baseline = budget/recovery (>1.0 = inside budget), gated
+    to 0.0 unless the surviving fleet held the SLO with zero failed
+    requests — the zero-downtime claim is part of the metric."""
+    import tempfile
+
+    from tools.fleet import run_crash_campaign
+
+    rate = float(os.environ.get("ORYX_BENCH_CRASH_RATE", 150.0))
+    seconds = float(os.environ.get("ORYX_BENCH_CRASH_SECONDS", 8.0))
+    budget_s = float(os.environ.get("ORYX_BENCH_CRASH_BUDGET_S", 30.0))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        report = run_crash_campaign(
+            3, rate, seconds, tmp, recovery_budget_s=budget_s
+        )
+    recovery_s = max(report["recovery_seconds"], default=float("nan"))
+    clean = report["failed"] == 0 and report["slo"]["passed"]
+    detail = (
+        f"one SIGKILL at 35% of a {seconds:.0f}s open-loop run, "
+        f"{report['offered_rate']:.0f} rps offered over 3 replicas: "
+        f"recovery {recovery_s:.2f}s (budget {budget_s:.0f}s), "
+        f"{report['failed']} failed request(s), {report['retried']} "
+        f"failed over to survivors, p99 {report['p99_ms']:.1f} ms, SLO "
+        f"{'PASS' if report['slo']['passed'] else 'FAIL ' + '; '.join(report['slo']['violations'])}"
+    )
+    print(f"bench[crash-recovery]: {detail}", file=sys.stderr)
+    _emit(
+        "crash-recovery, 3 replicas open-loop, one SIGKILL mid-run: "
+        "killed-slot SIGKILL->/readyz seconds, vs 30s budget "
+        "(vs_baseline = budget/recovery, 0.0 unless zero failed + SLO held)",
+        recovery_s,
+        "sec",
+        (budget_s / recovery_s) if clean and recovery_s > 0 else 0.0,
+        order=99,
+        detail=detail,
+        p99_ms=report["p99_ms"],
+        offered_rate=report["offered_rate"],
+        failed=report["failed"],
+        retried=report["retried"],
+        slo_passed=report["slo"]["passed"],
+        recovery_budget_s=budget_s,
+        replicas=3,
+    )
+
+
 BENCHES = [
     ("kmeans", bench_kmeans),
     ("als", bench_als),
@@ -2096,6 +2145,7 @@ BENCHES = [
     ("serving-ann", bench_serving_ann),
     ("serving-closed", bench_serving_closed_loop),
     ("serving-open", bench_serving_open_loop),
+    ("crash-recovery", bench_crash_recovery),
     ("serving-250", bench_serving_250),
     ("serving", bench_serving),
 ]

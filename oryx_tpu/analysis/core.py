@@ -114,6 +114,7 @@ def _load_builtin_passes() -> None:
     from oryx_tpu.analysis import (  # noqa: F401
         configkeys,
         deploymanifests,
+        durability,
         jaxhot,
         lifecycle,
         lockorder,

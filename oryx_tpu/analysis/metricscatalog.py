@@ -29,7 +29,9 @@ from oryx_tpu.analysis.core import (
 )
 
 DOC = REPO_ROOT / "docs" / "observability.md"
-SOURCE_ROOT = REPO_ROOT / "oryx_tpu"
+# tools/ emit operator-facing metrics too (fleet recovery.seconds) — the
+# catalog covers both trees
+SOURCE_ROOTS = (REPO_ROOT / "oryx_tpu", REPO_ROOT / "tools")
 _SELF_DIR = Path(__file__).resolve().parent
 
 # literal registration sites; f-strings deliberately don't match (their
@@ -42,7 +44,8 @@ _DOC_ROW = re.compile(r"^\|\s*`([^`]+)`")
 def _sources() -> list[tuple[Path, str]]:
     return [
         (f, f.read_text(encoding="utf-8"))
-        for f in sorted(SOURCE_ROOT.rglob("*.py"))
+        for root in SOURCE_ROOTS
+        for f in sorted(root.rglob("*.py"))
         if _SELF_DIR not in f.resolve().parents
     ]
 

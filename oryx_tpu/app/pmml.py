@@ -153,13 +153,31 @@ def read_pmml_from_update_message(key: str, message: str) -> Element | None:
         try:
             from oryx_tpu.registry.store import MODEL_FILE_NAME
 
-            in_dir = storage.join(message, MODEL_FILE_NAME)
+            ref = message
+            stager = _active_stager()
+            if stager is not None:
+                staged = stager.stage(ref)
+                if staged is not None:
+                    ref = str(staged)
+            in_dir = storage.join(ref, MODEL_FILE_NAME)
             if storage.exists(in_dir):
                 return pmml_io.from_string(storage.read_text(in_dir))
-            if not storage.exists(message):
+            if not storage.exists(ref):
                 return None
-            return pmml_io.from_string(storage.read_text(message))
+            return pmml_io.from_string(storage.read_text(ref))
         except Exception:
             log.warning("unresolvable MODEL-REF %r", message, exc_info=True)
             return None
     return None
+
+
+def _active_stager():
+    """The serving layer's restage cache, when one is registered
+    (oryx.serving.restage-dir). Lazy import: app must not pull the
+    serving package in at module load."""
+    try:
+        from oryx_tpu.serving import restage
+
+        return restage.active()
+    except Exception:  # pragma: no cover - serving package unavailable
+        return None

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import fcntl
 import json
+import logging
 import os
 import time
 from pathlib import Path
@@ -46,8 +47,56 @@ from oryx_tpu.bus.core import (
     partition_for,
     resolve_partitions,
 )
+from oryx_tpu.common import metrics, storage
+from oryx_tpu.common.crashpoints import crashpoint
+
+log = logging.getLogger(__name__)
 
 _OFFSETS_DIR = "__offsets__"
+
+_TAIL_SCAN_BYTES = 1 << 20
+
+
+def _repair_torn_tail(path: Path) -> int:
+    """Truncate a partition segment to its last newline-terminated record.
+
+    Every committed record ends in ``\\n`` (the producer writes whole
+    payloads under the partition flock), so bytes past the final newline
+    can only be the torn tail of a writer that died mid-append — never
+    acknowledged, safe to drop, and *necessary* to drop before fresh
+    appends land after them and weld two half-records into one corrupt
+    line. Caller holds the partition flock. Returns bytes dropped
+    (0 = intact); counted on ``bus.repair.truncated``."""
+    try:
+        size = path.stat().st_size
+    except OSError:
+        return 0
+    if size == 0:
+        return 0
+    with open(path, "rb+") as f:
+        f.seek(size - 1)
+        if f.read(1) == b"\n":
+            return 0
+        good = 0  # byte just past the last newline; 0 = no complete record
+        pos = size
+        while pos > 0:
+            step = min(_TAIL_SCAN_BYTES, pos)
+            f.seek(pos - step)
+            nl = f.read(step).rfind(b"\n")
+            if nl != -1:
+                good = pos - step + nl + 1
+                break
+            pos -= step
+        dropped = size - good
+        f.truncate(good)
+        f.flush()
+        os.fsync(f.fileno())
+    metrics.registry.counter("bus.repair.truncated").inc()
+    log.warning(
+        "bus repair: truncated %d torn byte(s) off %s (never acknowledged)",
+        dropped, path,
+    )
+    return dropped
 
 
 class _Flock:
@@ -86,7 +135,9 @@ class FileBroker(Broker):
         d.mkdir(parents=True, exist_ok=True)
         meta = self._meta_path(topic)
         if not meta.exists():
-            meta.write_text(json.dumps({"partitions": max(1, partitions), "config": config or {}}))
+            storage.commit_text(
+                meta, json.dumps({"partitions": max(1, partitions), "config": config or {}})
+            )
             for i in range(max(1, partitions)):
                 (d / f"partition-{i}.log").touch()
 
@@ -107,9 +158,7 @@ class FileBroker(Broker):
                         data = {}
                     if topic in data:
                         del data[topic]
-                        tmp = ledger.with_suffix(".tmp")
-                        tmp.write_text(json.dumps(data))
-                        os.replace(tmp, ledger)
+                        storage.commit_text(ledger, json.dumps(data))
 
     def _num_partitions(self, topic: str) -> int:
         try:
@@ -137,9 +186,7 @@ class FileBroker(Broker):
 
     def _set_active_base(self, topic: str, i: int, base: int) -> None:
         side = self._topic_dir(topic) / f"partition-{i}.base"
-        tmp = side.with_suffix(".base.tmp")
-        tmp.write_text(json.dumps({"base": base}))
-        os.replace(tmp, side)
+        storage.commit_text(side, json.dumps({"base": base}))
 
     def _segments(self, topic: str, i: int) -> list[tuple[int, Path]]:
         """(base, path) of every live segment, archived first, active last."""
@@ -192,6 +239,25 @@ class FileBroker(Broker):
         d.mkdir(parents=True, exist_ok=True)
         return d / f"{group}.json"
 
+    def _quarantine_ledger(self, ledger: Path) -> None:
+        """A ledger that no longer parses is moved aside (forensics, not
+        deletion) — consumers then resume from the earliest retained
+        offset, which is the at-least-once answer: replayed work, never
+        lost acknowledged input. Caller holds the ledger flock."""
+        aside = ledger.with_name(f"{ledger.name}.corrupt-{os.getpid()}")
+        try:
+            os.replace(ledger, aside)
+        except OSError:
+            return
+        # the quarantine must survive the next crash too, or the group
+        # replays its earliest-offset reset against a resurrected ledger
+        storage.fsync_dir(ledger.parent)
+        metrics.registry.counter("bus.repair.ledger-quarantined").inc()
+        log.warning(
+            "bus repair: quarantined unreadable offset ledger %s -> %s "
+            "(group resumes from earliest retained offsets)", ledger, aside,
+        )
+
     def get_offsets(self, group: str, topic: str) -> dict[int, int]:
         ledger = self._ledger_path(group)
         if not ledger.exists():
@@ -200,7 +266,13 @@ class FileBroker(Broker):
             try:
                 data = json.loads(ledger.read_text() or "{}")
             except json.JSONDecodeError:
-                return {}
+                self._quarantine_ledger(ledger)
+                # the group HAD commits we can no longer read. Answering
+                # {} would drop it into fresh-group-starts-at-latest and
+                # silently skip everything since those commits; pinning
+                # it to the earliest retained offsets is the at-least-
+                # once answer (replayed work, never lost input).
+                return self.earliest_offsets(topic)
         return {int(k): int(v) for k, v in data.get(topic, {}).items()}
 
     def set_offsets(self, group: str, topic: str, offsets: dict[int, int]) -> None:
@@ -209,11 +281,12 @@ class FileBroker(Broker):
             try:
                 data = json.loads(ledger.read_text() or "{}") if ledger.exists() else {}
             except json.JSONDecodeError:
+                self._quarantine_ledger(ledger)
                 data = {}
             data.setdefault(topic, {}).update({str(k): int(v) for k, v in offsets.items()})
-            tmp = ledger.with_suffix(".tmp")
-            tmp.write_text(json.dumps(data))
-            os.replace(tmp, ledger)
+            crashpoint("bus.file.offsets.pre")
+            storage.commit_text(ledger, json.dumps(data))
+            crashpoint("bus.file.offsets.post")
 
     def latest_offsets(self, topic: str) -> dict[int, int]:
         out: dict[int, int] = {}
@@ -228,6 +301,89 @@ class FileBroker(Broker):
                 out[i] = base + (_count_lines(p) if p.exists() else 0)
         return out
 
+    # -- fsck / repair -------------------------------------------------------
+
+    def _repair_partition(self, topic: str, i: int, report: dict) -> None:
+        """One partition's fsck, under its flock: torn active tail is
+        truncated to the last complete record, and a base sidecar that is
+        unreadable — or *behind* the archived segment chain — is rebuilt
+        from the chain. A stale base is what a producer killed mid-roll
+        leaves (the active segment archived, the new base never
+        committed); left alone it would shadow every record in the
+        freshly archived segment, silently losing acknowledged input.
+        Found by the kill-point sweep at ``bus.file.roll.mid``."""
+        path = self._active_path(topic, i)
+        with _Flock(path.with_suffix(".lock")):
+            if _repair_torn_tail(path):
+                report["truncated"] += 1
+            side = self._topic_dir(topic) / f"partition-{i}.base"
+            stored = 0
+            parseable = True
+            if side.exists():
+                try:
+                    stored = int(json.loads(side.read_text())["base"])
+                except (OSError, json.JSONDecodeError, KeyError, ValueError):
+                    parseable = False
+            # the archived chain's end; the active base can legitimately
+            # EXCEED it (retention deleted every archived segment) but can
+            # never trail it
+            chain_end = 0
+            for seg_base, seg_path in self._segments(topic, i)[:-1]:
+                try:
+                    chain_end = max(chain_end, seg_base + _count_lines(seg_path))
+                except OSError:
+                    continue
+            if not parseable or stored < chain_end:
+                self._set_active_base(topic, i, chain_end)
+                report["bases-rebuilt"] += 1
+                metrics.registry.counter("bus.repair.base-rebuilt").inc()
+                log.warning(
+                    "bus repair: rebuilt %s base sidecar for "
+                    "%s/partition-%d (%d -> %d)",
+                    "unreadable" if not parseable else "stale",
+                    topic, i, stored, chain_end,
+                )
+
+    def repair(self, topic: str | None = None) -> dict:
+        """fsck-style sweep over the bus directory: torn segment tails,
+        unreadable base sidecars, stale commit temp litter, unreadable
+        offset ledgers. Safe against live writers (every mutation runs
+        under the same flocks the producers take). Run automatically on
+        consumer open and via ``oryx-tpu repair``. Returns a count
+        report; every action also lands on a bus.repair.* counter."""
+        report = {
+            "truncated": 0, "bases-rebuilt": 0,
+            "tmp-swept": 0, "ledgers-quarantined": 0,
+        }
+        topics = (
+            [topic]
+            if topic is not None
+            else [
+                d.name
+                for d in sorted(self.root.iterdir())
+                if d.is_dir() and d.name != _OFFSETS_DIR and (d / ".meta.json").exists()
+            ]
+        )
+        for t in topics:
+            if not self.topic_exists(t):
+                continue
+            report["tmp-swept"] += storage.sweep_tmp(self._topic_dir(t))
+            for i in range(self._num_partitions(t)):
+                self._repair_partition(t, i, report)
+        off_dir = self.root / _OFFSETS_DIR
+        if topic is None and off_dir.is_dir():
+            report["tmp-swept"] += storage.sweep_tmp(off_dir)
+            for ledger in sorted(off_dir.glob("*.json")):
+                with _Flock(ledger.with_suffix(".lock")):
+                    try:
+                        json.loads(ledger.read_text() or "{}")
+                    except json.JSONDecodeError:
+                        self._quarantine_ledger(ledger)
+                        report["ledgers-quarantined"] += 1
+        if report["tmp-swept"]:
+            metrics.registry.counter("bus.repair.tmp-swept").inc(report["tmp-swept"])
+        return report
+
     # -- produce/consume ----------------------------------------------------
 
     def producer(self, topic: str) -> TopicProducer:
@@ -241,14 +397,20 @@ class FileBroker(Broker):
     ) -> TopicConsumer:
         if not self.topic_exists(topic):
             self.create_topic(topic, 1)
+        # repair-on-open: a consumer whose offsets were computed against a
+        # torn tail (e.g. latest_offsets counting a half-record) would sit
+        # one record in the future forever; fsck the topic first
+        self.repair(topic)
         return _FileConsumer(self, topic, group, from_beginning, partitions)
 
 
 def _count_lines(path: Path) -> int:
+    # only newline-terminated lines are records: a torn final line (writer
+    # died mid-append) was never acknowledged and must not shift offsets
     n = 0
     with open(path, "rb") as f:
-        for _ in f:
-            n += 1
+        for line in f:
+            n += line.endswith(b"\n")
     return n
 
 
@@ -359,13 +521,20 @@ class _FileProducer(TopicProducer):
     def _append_lines(self, p: int, payload: str) -> None:
         path = self._broker._topic_dir(self._topic) / f"partition-{p}.log"
         with _Flock(path.with_suffix(".lock")):
+            # a writer that died mid-append left a torn (un-acknowledged)
+            # tail; it MUST go before fresh bytes land after it, or the
+            # two half-records weld into one corrupt line
+            _repair_torn_tail(path)
             try:
                 if path.stat().st_size >= self._segment_bytes:
                     self._roll(p, path)
             except OSError:
                 pass
+            crashpoint("bus.file.append.pre")
             with open(path, "a", encoding="utf-8") as f:
                 f.write(payload)
+                f.flush()
+            crashpoint("bus.file.append.post")
 
     def _roll(self, partition: int, path: Path) -> None:
         """Archive the full active segment and start a fresh one (under
@@ -377,7 +546,29 @@ class _FileProducer(TopicProducer):
         if n == 0:
             return
         archived = path.with_name(f"partition-{partition}.seg{base:020d}.log")
+        if archived.exists():
+            # the sidecar is stale — a writer died mid-roll (segment
+            # archived, new base never committed) and we are about to
+            # archive a fresh active over its segment, destroying
+            # acknowledged records. Re-anchor the base past the archived
+            # chain first; the active's records shift to the repaired
+            # offsets, the archive keeps its own.
+            for seg_base, seg_path in broker._segments(self._topic, partition)[:-1]:
+                try:
+                    base = max(base, seg_base + _count_lines(seg_path))
+                except OSError:
+                    continue
+            broker._set_active_base(self._topic, partition, base)
+            metrics.registry.counter("bus.repair.base-rebuilt").inc()
+            log.warning(
+                "bus repair: roll found stale base for %s/partition-%d; "
+                "re-anchored to %d past the archived chain",
+                self._topic, partition, base,
+            )
+            archived = path.with_name(f"partition-{partition}.seg{base:020d}.log")
         os.replace(path, archived)
+        storage.fsync_dir(path.parent)
+        crashpoint("bus.file.roll.mid")
         broker._set_active_base(self._topic, partition, base + n)
         path.touch()
         if self._has_retention:

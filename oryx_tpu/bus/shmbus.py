@@ -56,6 +56,7 @@ once resume behaves exactly like the file bus.
 from __future__ import annotations
 
 import json
+import logging
 import mmap
 import os
 import struct
@@ -75,7 +76,10 @@ from oryx_tpu.bus.core import (
     resolve_partitions,
 )
 from oryx_tpu.bus.filebus import FileBroker, _Flock
-from oryx_tpu.common import metrics, tracing
+from oryx_tpu.common import metrics, storage, tracing
+from oryx_tpu.common.crashpoints import crashpoint
+
+log = logging.getLogger(__name__)
 
 RING_FILE_MAGIC = 0x31676E5278797230  # b"0ryxRng1" little-endian
 
@@ -139,9 +143,45 @@ class _Ring:
             self.close()
             raise OSError(f"not a shm ring file: {path}")
         self.ring_bytes = self.u64(_OFF_RING_BYTES)
+        if (
+            self.ring_bytes <= 0
+            or _HEADER_PAGE + self.ring_bytes > os.fstat(self._f.fileno()).st_size
+        ):
+            # the size word itself is garbled: nothing downstream can be
+            # trusted and nothing in-file can rebuild it — refuse loudly
+            # (ShmBroker.repair recreates the ring from topic meta)
+            self.close()
+            raise OSError(f"corrupt shm ring header (ring_bytes) in {path}")
+        # repair-on-open: a torn multi-word header update (or external
+        # corruption) shows up as impossible head/tail/seqno geometry
+        if self._header_insane():
+            with _Flock(self.lock_path):
+                if self._header_insane():
+                    self._reset_empty()
         from oryx_tpu.common import ledger
 
         ledger.register("ring", self, live=lambda r: not r._closed)
+
+    def _header_insane(self) -> bool:
+        head, tail = self.u64(_OFF_HEAD), self.u64(_OFF_TAIL)
+        nxt, base = self.u64(_OFF_NEXT_SEQNO), self.u64(_OFF_BASE_SEQNO)
+        return tail > head or head - tail > self.ring_bytes or base > nxt
+
+    def _reset_empty(self) -> None:
+        """Loud last-resort repair: empty the ring at a consistent seqno.
+        Unconsumed frames are lost — upstream layers replay from their
+        offset ledgers (at-least-once), nothing is served silently wrong.
+        Caller holds the writer flock."""
+        seq = max(self.u64(_OFF_NEXT_SEQNO), self.u64(_OFF_BASE_SEQNO))
+        self.set_u64(_OFF_HEAD, 0)
+        self.set_u64(_OFF_TAIL, 0)
+        self.set_u64(_OFF_NEXT_SEQNO, seq)
+        self.set_u64(_OFF_BASE_SEQNO, seq)
+        metrics.registry.counter("bus.repair.shm-reset").inc()
+        log.warning(
+            "bus repair: reset shm ring %s to empty at seqno %d "
+            "(impossible head/tail geometry)", self.path, seq,
+        )
 
     # -- header words -------------------------------------------------------
 
@@ -271,7 +311,9 @@ class _Ring:
             mm[body + len(payload) : off + wire] = b"\x00" * pad
         if kind != blockcodec.KIND_PAD:
             self.set_u64(_OFF_NEXT_SEQNO, seq + count)
+        crashpoint("bus.shm.publish.pre")
         self.set_u64(_OFF_HEAD, head + wire)  # publish last: torn = invisible
+        crashpoint("bus.shm.publish.post")
         return head + wire
 
     def _write_pad(self, head, rem, seq, deadline):
@@ -344,6 +386,66 @@ class _Ring:
             return tail + wire, None
         return tail + wire, seqno + count
 
+    # -- fsck ----------------------------------------------------------------
+
+    def fsck(self, repair: bool = False) -> dict:
+        """Walk the published region [tail, head) validating every frame
+        header and payload CRC. A break in the chain — garbled header,
+        frame reaching past head, CRC mismatch — marks the durable
+        frontier: everything before it is intact, everything after is
+        suspect (a torn multi-byte head publish, or corruption under an
+        already-published head). With ``repair=True`` the head rolls back
+        to the frontier (``bus.repair.shm-head-rollback``) and impossible
+        header geometry empties the ring loudly (``bus.repair.shm-reset``)
+        — consumers then replay from upstream ledgers rather than decode
+        garbage. Returns {"frames", "head-rollback", "reset"} where the
+        action counts are 1 when taken, -1 when needed but repair=False."""
+        report = {"frames": 0, "head-rollback": 0, "reset": 0}
+        with _Flock(self.lock_path):
+            if self._header_insane():
+                if repair:
+                    self._reset_empty()
+                    report["reset"] = 1
+                else:
+                    report["reset"] = -1
+                return report
+            rb = self.ring_bytes
+            head, pos = self.u64(_OFF_HEAD), self.u64(_OFF_TAIL)
+            seq_frontier = None
+            while pos < head:
+                rem = rb - pos % rb
+                if rem < blockcodec.HEADER_BYTES:
+                    pos += rem
+                    continue
+                off = _HEADER_PAGE + pos % rb
+                magic, kind, _flags, seqno, count, length, crc = (
+                    blockcodec.HEADER.unpack_from(self.mm, off)
+                )
+                wire = blockcodec.HEADER_BYTES + blockcodec.pad8(length)
+                if magic != blockcodec.MAGIC or wire > rem or pos + wire > head:
+                    break
+                if kind != blockcodec.KIND_PAD:
+                    body = off + blockcodec.HEADER_BYTES
+                    if zlib.crc32(self.mm[body : body + length]) != crc:
+                        break
+                    seq_frontier = seqno + count
+                report["frames"] += 1
+                pos += wire
+            if pos < head:
+                if repair:
+                    self.set_u64(_OFF_HEAD, pos)
+                    if seq_frontier is not None:
+                        self.set_u64(_OFF_NEXT_SEQNO, seq_frontier)
+                    report["head-rollback"] = 1
+                    metrics.registry.counter("bus.repair.shm-head-rollback").inc()
+                    log.warning(
+                        "bus repair: rolled shm ring %s head back %d byte(s) "
+                        "to the last intact frame", self.path, head - pos,
+                    )
+                else:
+                    report["head-rollback"] = -1
+        return report
+
 
 class ShmBroker(Broker):
     """`shm:` scheme broker. Locator: ``shm:/dir[?ring_mb=N&...]``."""
@@ -414,14 +516,15 @@ class ShmBroker(Broker):
         meta = self._meta_path(topic)
         with _Flock(d / ".meta.lock"):
             if not meta.exists():
-                meta.write_text(
+                storage.commit_text(
+                    meta,
                     json.dumps(
                         {
                             "partitions": max(1, partitions),
                             "config": config or {},
                             "ring-bytes": self.ring_bytes,
                         }
-                    )
+                    ),
                 )
         for i in range(self._num_partitions(topic)):
             self._ensure_ring_file(topic, i)
@@ -472,7 +575,10 @@ class ShmBroker(Broker):
             with open(tmp, "wb") as f:
                 f.write(header)
                 f.truncate(_HEADER_PAGE + ring_bytes)  # sparse data region
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)  # appears fully initialized or not at all
+            storage.fsync_dir(path.parent)
 
     def _ring(self, topic: str, i: int) -> _Ring:
         ring = self._rings.get((topic, i))
@@ -480,6 +586,48 @@ class ShmBroker(Broker):
             self._ensure_ring_file(topic, i)
             ring = self._rings[(topic, i)] = _Ring(self._ring_path(topic, i))
         return ring
+
+    def repair(self, topic: str | None = None) -> dict:
+        """fsck-style sweep: every partition ring's frame chain is CRC
+        validated and repaired (_Ring.fsck), an unopenable ring file —
+        bad magic, garbled size word — is recreated empty from the topic
+        meta (``bus.repair.shm-recreated``; the upstream layer replays),
+        and the shared offset-ledger machinery is swept via the file
+        broker. Returns a count report."""
+        report = {
+            "frames": 0, "head-rollback": 0, "reset": 0,
+            "recreated": 0, "tmp-swept": 0,
+        }
+        topics = (
+            [topic]
+            if topic is not None
+            else [
+                d.name
+                for d in sorted(self.root.iterdir())
+                if d.is_dir() and (d / ".meta.json").exists()
+            ]
+        )
+        for t in topics:
+            if not self.topic_exists(t):
+                continue
+            report["tmp-swept"] += storage.sweep_tmp(self._topic_dir(t))
+            for i in range(self._num_partitions(t)):
+                path = self._ring_path(t, i)
+                try:
+                    sub = self._ring(t, i).fsck(repair=True)
+                except OSError:
+                    # unopenable ring: recreate from topic meta (loud)
+                    self._rings.pop((t, i), None)
+                    with _Flock(path.with_suffix(".lock")):
+                        path.unlink(missing_ok=True)
+                    self._ensure_ring_file(t, i)
+                    report["recreated"] += 1
+                    metrics.registry.counter("bus.repair.shm-recreated").inc()
+                    log.warning("bus repair: recreated unopenable shm ring %s", path)
+                    continue
+                for k, v in sub.items():
+                    report[k] += v
+        return report
 
     # -- offsets ------------------------------------------------------------
 

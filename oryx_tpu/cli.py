@@ -35,6 +35,7 @@ log = logging.getLogger(__name__)
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
     "bus-input", "config", "health", "models", "trace", "experiments", "lint",
+    "repair",
 )
 
 MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
@@ -343,6 +344,61 @@ def run_lint(cfg: Config, out=None) -> int:
     return res.rc
 
 
+def run_repair(cfg: Config, out=None) -> int:
+    """Offline fsck across every durable store the config names
+    (docs/durability.md): bus topic logs (torn tails, unreadable offset
+    ledgers, garbled shm frames), the model registry layout (stale
+    commit temps, half-written generations, an unusable CHAMPION), and
+    the serving restage cache. The same audits run automatically on
+    consumer open / MLUpdate start / stager construction; this command
+    runs them all at once, with everything down, and prints what was
+    repaired. Run it with the layers stopped — a registry fsck racing an
+    in-flight promote mistakes a generation mid-upload for a torn one.
+
+    Exit 0 when every store is clean or repaired; repairs are also
+    visible on the bus.repair.* / registry.repair.* counters."""
+    out = out or sys.stdout
+    repaired_anything = False
+
+    seen: set[str] = set()
+    for key in ("oryx.input-topic.broker", "oryx.update-topic.broker"):
+        loc = cfg.get_optional_string(key)
+        if not loc or loc in seen:
+            continue
+        seen.add(loc)
+        from oryx_tpu.bus.core import get_broker
+
+        broker = get_broker(loc)
+        if not hasattr(broker, "repair"):
+            print(f"bus {loc}: no repairable on-disk state ({type(broker).__name__})", file=out)
+            continue
+        report = broker.repair()
+        # "frames" counts intact frames walked, not repairs
+        repaired_anything |= any(v for k, v in report.items() if k != "frames")
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(report.items()) if v)
+        print(f"bus {loc}: {summary or 'clean'}", file=out)
+
+    model_dir = cfg.get_optional_string("oryx.batch.storage.model-dir")
+    if model_dir:
+        from oryx_tpu.registry.store import RegistryStore
+
+        report = RegistryStore(model_dir).fsck(repair=True)
+        repaired_anything |= any(report.values())
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(report.items()) if v)
+        print(f"registry {model_dir}: {summary or 'clean'}", file=out)
+
+    restage_dir = cfg.get_optional_string("oryx.serving.restage-dir")
+    if restage_dir and os.path.isdir(restage_dir):
+        from oryx_tpu.serving.restage import ModelStager
+
+        swept = ModelStager(restage_dir).swept_on_open
+        repaired_anything |= swept > 0
+        print(f"restage {restage_dir}: " + (f"swept={swept}" if swept else "clean"), file=out)
+
+    print("repair: " + ("repairs applied" if repaired_anything else "all stores clean"), file=out)
+    return 0
+
+
 def run_models(cfg: Config, subcommand: str | None, generation: str | None, out=None) -> int:
     """Registry operator surface (docs/model-registry.md):
 
@@ -545,6 +601,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_experiments(cfg)
     elif args.command == "lint":
         return run_lint(cfg)
+    elif args.command == "repair":
+        return run_repair(cfg)
     return 0
 
 

@@ -249,6 +249,12 @@ class OrderedLock:
             return False
         return True
 
+    # stdlib modules register module-level locks with os.register_at_fork
+    # (e.g. concurrent.futures.thread); without this they fail to import
+    # while the watchdog is installed
+    def _at_fork_reinit(self) -> None:
+        self._lk = _real_lock()
+
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"<OrderedLock {self._key} locked={self._lk.locked()}>"
 
@@ -340,6 +346,11 @@ class OrderedRLock:
         self._owner = owner
         if _active():
             _push(self._key)
+
+    def _at_fork_reinit(self) -> None:
+        self._lk = _real_rlock()
+        self._owner = None
+        self._count = 0
 
     def __repr__(self):  # pragma: no cover - debugging aid
         return f"<OrderedRLock {self._key} count={self._count}>"

@@ -23,11 +23,13 @@ import threading
 from pathlib import Path
 from typing import IO, Iterator
 
+from oryx_tpu.common.crashpoints import crashpoint
+
 __all__ = [
     "is_remote", "local_path", "open_read", "open_write", "open_gzip_read",
     "open_gzip_write", "exists", "list_names", "delete",
     "mkdirs", "size", "read_text", "write_text", "join",
-    "upload_dir",
+    "upload_dir", "commit_bytes", "commit_text", "fsync_dir", "sweep_tmp",
 ]
 
 
@@ -74,15 +76,50 @@ def open_read(uri: str | os.PathLike, mode: str = "rb") -> Iterator[IO]:
             yield f
 
 
+TMP_MARKER = ".tmp-"
+
+
+def _tmp_sibling(p: Path) -> Path:
+    # tmp name must be unique PER WRITER: concurrent writers of the
+    # same target sharing one tmp path race each other's atomic
+    # replace (writer A's replace unlinks the tmp writer B is about
+    # to replace -> FileNotFoundError; surfaced by concurrent
+    # /model/rollback requests moving the CHAMPION pointer). A sibling
+    # (never /tmp or tempfile.mkstemp) guarantees same-filesystem
+    # rename: cross-device "renames" degrade to copy+unlink, which is
+    # not atomic and can tear (ORX602).
+    return p.parent / f".{p.name}{TMP_MARKER}{os.getpid()}-{threading.get_ident()}"
+
+
+def fsync_dir(path: str | os.PathLike) -> None:
+    """fsync a directory so a completed rename inside it is durable —
+    without this the *entry* can vanish on power loss even though the
+    file's bytes survived. Platforms that refuse O_RDONLY fsync on
+    directories (some network filesystems) are skipped, not failed."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - fs without dir fsync support
+        pass
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def open_write(uri: str | os.PathLike, mode: str = "wb") -> Iterator[IO]:
-    """Atomic everywhere: local writes go through temp + rename; remote
+    """Atomic AND durable everywhere: local writes go through temp +
+    fsync + rename + parent-dir fsync (a rename can survive a crash
+    while its contents don't — fsync the temp file first — and a rename
+    itself isn't durable until the directory entry is synced); remote
     writes go to a temp key that is moved into place only on success —
     fsspec finalizes a blob on close() even when the with-body raised,
     so writing the final key directly would commit truncated data."""
     if is_remote(str(uri)):
         fs, path = _fs(str(uri))
-        tmp = f"{path}.tmp-{os.getpid()}"
+        tmp = f"{path}{TMP_MARKER}{os.getpid()}"
         try:
             with fs.open(tmp, mode) as f:
                 yield f
@@ -90,24 +127,93 @@ def open_write(uri: str | os.PathLike, mode: str = "wb") -> Iterator[IO]:
             with contextlib.suppress(Exception):
                 fs.rm(tmp)
             raise
+        crashpoint("storage.commit.pre")
         fs.mv(tmp, path)
+        crashpoint("storage.commit.post")
     else:
         p = _local(uri)
         p.parent.mkdir(parents=True, exist_ok=True)
-        # tmp name must be unique PER WRITER: concurrent writers of the
-        # same target sharing one tmp path race each other's atomic
-        # replace (writer A's replace unlinks the tmp writer B is about
-        # to replace -> FileNotFoundError; surfaced by concurrent
-        # /model/rollback requests moving the CHAMPION pointer)
-        tmp = p.parent / f".{p.name}.tmp-{os.getpid()}-{threading.get_ident()}"
+        tmp = _tmp_sibling(p)
         try:
             with open(tmp, mode, encoding="utf-8" if "b" not in mode else None) as f:
                 yield f
+                f.flush()
+                os.fsync(f.fileno())
         except BaseException:
             with contextlib.suppress(Exception):
                 tmp.unlink()
             raise
+        crashpoint("storage.commit.pre")
         tmp.replace(p)
+        crashpoint("storage.commit.post")
+        fsync_dir(p.parent)
+
+
+def commit_bytes(path: str | os.PathLike, data: bytes) -> None:
+    """THE recognized local commit helper (ORX601/ORX603): write a small
+    state file — CHAMPION pointer, offset ledger, segment-base sidecar,
+    topic meta — atomically and durably: sibling temp + fsync + rename +
+    parent-dir fsync, with crashpoints at each step boundary. Callers
+    that already hold a Path (filebus sidecars) use this instead of the
+    URI-level write_text."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = _tmp_sibling(p)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+    except BaseException:
+        with contextlib.suppress(Exception):
+            tmp.unlink()
+        raise
+    crashpoint("storage.commit.pre")
+    tmp.replace(p)
+    crashpoint("storage.commit.post")
+    fsync_dir(p.parent)
+
+
+def commit_text(path: str | os.PathLike, text: str) -> None:
+    commit_bytes(path, text.encode("utf-8"))
+
+
+def sweep_tmp(dir_uri: str | os.PathLike) -> int:
+    """Remove stale writer temp litter (crashed mid-commit) directly
+    under a directory: any ``.<name>.tmp-<pid>-...`` sibling left by
+    open_write/commit_bytes. A temp file is only ever garbage once its
+    writer is gone — renames happen in the writer's own lifetime — so
+    sweeping at repair/open time is safe for files whose writer pid is
+    dead (or foreign). Returns the number removed."""
+    if is_remote(str(dir_uri)):
+        return 0
+    d = _local(dir_uri)
+    if not d.is_dir():
+        return 0
+    removed = 0
+    for p in d.iterdir():
+        if not p.is_file() or TMP_MARKER not in p.name or not p.name.startswith("."):
+            continue
+        pid_part = p.name.split(TMP_MARKER, 1)[1].split("-", 1)[0]
+        try:
+            pid = int(pid_part)
+        except ValueError:
+            continue
+        if pid != os.getpid() and not _pid_alive(pid):
+            with contextlib.suppress(OSError):
+                p.unlink()
+                removed += 1
+    return removed
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - pid exists, other user
+        return True
+    return True
 
 
 @contextlib.contextmanager

@@ -26,6 +26,7 @@ import time
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common import metrics, profiling
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.crashpoints import crashpoint
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_ import data as data_store
 from oryx_tpu.lambda_.base import AbstractLayer
@@ -155,12 +156,14 @@ class BatchLayer(AbstractLayer):
                 producer.close()
 
         # 4. persist the micro-batch
+        crashpoint("batch.save.pre")
         with phase("save"):
             data_store.save_micro_batch(
                 self.data_dir, timestamp_ms, new_data, fmt=self.storage_format
             )
 
         # 5. commit offsets (UpdateOffsetsFn.java:57-65)
+        crashpoint("batch.commit.pre")
         if self.id:
             consumer.commit()
 

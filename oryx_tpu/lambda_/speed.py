@@ -28,6 +28,7 @@ import time
 from oryx_tpu.common.records import BlockRecords
 from oryx_tpu.common import metrics, profiling, tracing
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.crashpoints import crashpoint
 from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.lambda_.base import AbstractLayer, GuardedBlockFeed
 
@@ -321,8 +322,10 @@ class SpeedLayer(AbstractLayer):
                                 metrics_prefix="speed.publish",
                                 stop_event=self._stop_event,
                             ) - extra
+                crashpoint("speed.commit.pre")
                 if self.id:
                     self.input_consumer().commit()
+                crashpoint("speed.commit.post")
         # the micro-batch's deltas are now servable-visible to any replica
         # that polls: event-ingest -> published, the speed half of the
         # freshness chain (serving closes it with serving.freshness.seconds)

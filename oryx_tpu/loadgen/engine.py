@@ -126,6 +126,9 @@ class LoadResult:
     # by design, not an outage — "zero failed requests" stays assertable
     # through a spike while quality() reports what the shedding cost.
     shed: int = 0
+    # connection-refused attempts that failed over to a surviving replica
+    # (crash failover); nonzero during a SIGKILL campaign, not an error
+    retried: int = 0
 
     @property
     def offered_rate(self) -> float:
@@ -181,6 +184,7 @@ class LoadResult:
             "service_p99_ms": round(self.service_quantile(0.99) * 1000, 2),
             "queued_arrivals": self.queued_arrivals,
             "peak_inflight": self.peak_inflight,
+            "retried": self.retried,
             "per_target": {
                 name: {
                     "ok": t.ok,
@@ -202,6 +206,7 @@ class OpenLoopEngine:
         timeout_s: float = 10.0,
         readiness_poll_s: float = 0.2,
         on_response=None,
+        connect_retries: int = 1,
     ) -> None:
         if not targets:
             raise ValueError("need at least one target")
@@ -215,10 +220,15 @@ class OpenLoopEngine:
         # (oryx_tpu/loadgen/feedback.py) uses to close the loop. Errors
         # are swallowed: feedback must never fail the load run.
         self.on_response = on_response
+        # crash failover: a connection-refused attempt demotes its target
+        # and retries on a surviving replica up to this many times — the
+        # GET endpoints are idempotent, so failover cannot double-apply
+        self.connect_retries = int(connect_retries)
         self._rr = 0
         self._lock = threading.Lock()
         self._inflight = 0
         self._peak_inflight = 0
+        self._retried = 0
         self._stop = threading.Event()
 
     # -- readiness routing ---------------------------------------------------
@@ -250,6 +260,37 @@ class OpenLoopEngine:
 
     # -- request execution ---------------------------------------------------
 
+    def _attempt(self, target: Target, user: int, ctx) -> tuple[bool, str, str, str | None]:
+        """One HTTP attempt against one target: (ok, kind, shed_stage, arm)."""
+        path = self.template % user if "%d" in self.template else self.template
+        try:
+            req = urllib.request.Request(target.base_url + path)
+            if ctx is not None:
+                req.add_header("traceparent", ctx.traceparent())
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                data = resp.read()
+                ok = 200 <= resp.status < 300
+                shed_stage = resp.headers.get(SHED_HEADER) or "full"
+                arm = resp.headers.get(ARM_HEADER)
+                if not ok:  # non-2xx that didn't raise (3xx)
+                    return ok, f"http-{resp.status // 100}xx", shed_stage, arm
+                if self.on_response is not None:
+                    try:
+                        self.on_response(user, resp.status, resp.headers, data)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return ok, "ok", shed_stage, arm
+        except urllib.error.HTTPError as e:
+            # a 429 stamped by the shed ladder is the overload
+            # controller doing its job — account it as shed load,
+            # not as a failure
+            stage = e.headers.get(SHED_HEADER) if e.headers else None
+            if e.code == 429 and stage == "shed":
+                return False, "shed", "shed", None
+            return False, classify_error(e), "full", None
+        except Exception as e:  # noqa: BLE001 - classified, not swallowed
+            return False, classify_error(e), "full", None
+
     def _execute(self, t_run0: float, t_sched: float, user: int, sink: list) -> None:
         t_send = time.perf_counter()
         t_wall0 = time.time()
@@ -265,35 +306,28 @@ class OpenLoopEngine:
         if target is None:
             kind = "no-ready-replica"
         else:
-            path = self.template % user if "%d" in self.template else self.template
-            try:
-                req = urllib.request.Request(target.base_url + path)
-                if ctx is not None:
-                    req.add_header("traceparent", ctx.traceparent())
-                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                    data = resp.read()
-                    ok = 200 <= resp.status < 300
-                    shed_stage = resp.headers.get(SHED_HEADER) or "full"
-                    arm = resp.headers.get(ARM_HEADER)
-                    if not ok:  # non-2xx that didn't raise (3xx)
-                        kind = f"http-{resp.status // 100}xx"
-                    elif self.on_response is not None:
-                        try:
-                            self.on_response(user, resp.status, resp.headers, data)
-                        except Exception:  # noqa: BLE001
-                            pass
-            except urllib.error.HTTPError as e:
-                # a 429 stamped by the shed ladder is the overload
-                # controller doing its job — account it as shed load,
-                # not as a failure
-                stage = e.headers.get(SHED_HEADER) if e.headers else None
-                if e.code == 429 and stage == "shed":
-                    kind = "shed"
-                    shed_stage = "shed"
-                else:
-                    kind = classify_error(e)
-            except Exception as e:  # noqa: BLE001 - classified, not swallowed
-                kind = classify_error(e)
+            retries = 0
+            while True:
+                ok, kind, shed_stage, arm = self._attempt(target, user, ctx)
+                if kind != "connection" or retries >= self.connect_retries:
+                    break
+                # a replica refusing connections is GONE (SIGKILLed, not
+                # draining — a drain answers 503s). Demote it now instead
+                # of waiting out a readiness-poll tick, and fail the
+                # request over to a surviving replica; the poller
+                # re-promotes the slot when its /readyz answers 200 again
+                target.ready = False
+                nxt = self._pick_target()
+                if nxt is None:
+                    # no survivor to fail over to: keep the lone replica
+                    # routable (the failure is recorded either way) and
+                    # let the poller, if any, own its readiness
+                    target.ready = True
+                    break
+                with self._lock:
+                    self._retried += 1
+                retries += 1
+                target = nxt
         t_end = time.perf_counter()
         if ctx is not None:
             tracing.record_span(
@@ -392,4 +426,5 @@ class OpenLoopEngine:
             peak_inflight=self._peak_inflight,
             per_target={t.name: t for t in self.targets},
             shed=n_shed,
+            retried=self._retried,
         )

@@ -30,6 +30,7 @@ import abc
 import contextlib
 import logging
 import math
+import os
 import shutil
 import tempfile
 import time
@@ -41,6 +42,7 @@ from oryx_tpu.api.batch import BatchLayerUpdate
 from oryx_tpu.bus.core import KeyMessage, TopicProducer
 from oryx_tpu.common import pmml as pmml_io, rng, storage, tracing
 from oryx_tpu.common.config import Config
+from oryx_tpu.common.crashpoints import crashpoint
 from oryx_tpu.common.lang import collect_in_parallel
 from oryx_tpu.common.records import ChainRecords, ListRecords, as_records
 from oryx_tpu.common.resilience import RetryPolicy
@@ -225,6 +227,11 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
             )
 
         store = RegistryStore(str(model_dir))
+        # repair-on-open: quarantine half-written generations / torn
+        # pointers a killed predecessor left behind, BEFORE computing the
+        # parent lineage against them (no concurrent promote can be in
+        # flight — this process is the promoter)
+        store.fsck(repair=True)
         generation_id = str(timestamp_ms)
         parent_id = store.champion_id()
         if self.warm_start:
@@ -278,7 +285,19 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 final_dir.parent.mkdir(parents=True, exist_ok=True)
                 if final_dir.exists():
                     shutil.rmtree(final_dir)
-                shutil.move(str(best_path), str(final_dir))
+                # stage on the registry's OWN filesystem first, then one
+                # atomic rename: moving straight from the /tmp candidate
+                # dir can cross devices, where shutil.move degrades to
+                # copy+delete and a crash mid-copy leaves a half-written
+                # generation (ORX602). Dead .promote- litter is swept by
+                # RegistryStore.fsck.
+                promote_tmp = final_dir.parent / f".promote-{generation_id}-{os.getpid()}"
+                if promote_tmp.exists():
+                    shutil.rmtree(promote_tmp)
+                shutil.move(str(best_path), str(promote_tmp))
+                os.rename(promote_tmp, final_dir)
+                storage.fsync_dir(final_dir.parent)
+            crashpoint("ml.promote.mid")
 
             # online (evidence-gated) promotion: when the online gate is
             # enabled and a champion already exists, a publish-worthy
@@ -316,6 +335,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                 )
                 return
 
+            crashpoint("ml.champion.pre")
             if online_pending:
                 log.info(
                     "generation %s published as online challenger: champion "
@@ -351,6 +371,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                         records, _ = tracing.with_header(
                             [("MODEL-REF", ref)], ingest_ms=publish_ms
                         )
+                    crashpoint("ml.publish.pre")
                     self.publish_retry.call(
                         lambda: model_update_topic.send_many(records),
                         retry_on=(ConnectionError, OSError),
@@ -359,6 +380,7 @@ class MLUpdate(BatchLayerUpdate, abc.ABC):
                     self.publish_additional_model_data(
                         best_pmml, new_data, past_records, final_dir, model_update_topic
                     )
+                    crashpoint("ml.publish.post")
         finally:
             shutil.rmtree(candidates_root, ignore_errors=True)
         store.gc(self.retention_max_generations, never_delete={generation_id})

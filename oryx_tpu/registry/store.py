@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 import time
 
 from oryx_tpu.common import metrics, storage
+from oryx_tpu.common.crashpoints import crashpoint
 from oryx_tpu.registry.manifest import MANIFEST_FILE_NAME, GenerationManifest
 
 log = logging.getLogger(__name__)
@@ -32,6 +34,16 @@ CHAMPION_FILE_NAME = "CHAMPION"
 MODEL_FILE_NAME = "model.pmml"
 
 _GENERATION_RE = re.compile(r"^\d+$")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - pid exists, other user
+        return True
+    return True
 
 
 def is_generation_id(name: str) -> bool:
@@ -116,6 +128,7 @@ class RegistryStore:
 
     def set_champion(self, generation_id: str, now_ms: int | None = None) -> None:
         """Atomic-rename update of the CHAMPION pointer."""
+        crashpoint("registry.champion.pre")
         storage.write_text(
             storage.join(self.model_dir, CHAMPION_FILE_NAME),
             json.dumps(
@@ -125,6 +138,134 @@ class RegistryStore:
                 }
             ),
         )
+
+    # -- fsck / repair -------------------------------------------------------
+
+    def fsck(self, repair: bool = False) -> dict:
+        """Startup/operator audit of the registry layout: stale commit
+        temp litter, a CHAMPION pointer that doesn't parse or points at
+        a generation with no model.pmml, half-written generation dirs
+        (promoted but never given their model.pmml), and manifests that
+        no longer parse. Repair is recover-or-refuse, never silently
+        wrong: damaged files are quarantined aside (forensics, not
+        deletion) and the pointer falls back to the newest *intact*
+        generation — consumers re-resolve, nothing serves a torn model.
+
+        Must not run concurrently with an in-flight promote of the same
+        store (a generation mid-upload looks half-written); MLUpdate runs
+        it before promoting, the CLI runs it with the batch layer down.
+        Returns a count report; repairs also land on registry.repair.*
+        counters."""
+        report = {
+            "tmp-swept": 0, "champion-quarantined": 0, "champion-reset": 0,
+            "generations-quarantined": 0, "manifests-quarantined": 0,
+        }
+        local = not storage.is_remote(self.model_dir)
+        if local:
+            report["tmp-swept"] += storage.sweep_tmp(self.model_dir)
+            report["tmp-swept"] += self._sweep_promote_litter()
+        intact: list[str] = []
+        for gen in self.list_generations():
+            gen_dir = self.generation_dir(gen)
+            if local:
+                report["tmp-swept"] += storage.sweep_tmp(gen_dir)
+            manifest_uri = self.manifest_uri(gen)
+            if storage.exists(manifest_uri):
+                try:
+                    GenerationManifest.from_json(storage.read_text(manifest_uri))
+                except Exception:
+                    report["manifests-quarantined"] += 1
+                    if repair and local:
+                        self._quarantine(storage.local_path(manifest_uri))
+                        metrics.registry.counter(
+                            "registry.repair.manifest-quarantined"
+                        ).inc()
+            if self.has_generation(gen):
+                intact.append(gen)
+                continue
+            # a generation dir without model.pmml is a promote that died
+            # mid-copy: nothing can ever serve it
+            report["generations-quarantined"] += 1
+            if repair and local:
+                self._quarantine(storage.local_path(gen_dir))
+                metrics.registry.counter("registry.repair.generation-quarantined").inc()
+                log.warning(
+                    "registry repair: quarantined half-written generation %s", gen
+                )
+        report.update(self._fsck_champion(repair, intact))
+        return report
+
+    def _fsck_champion(self, repair: bool, intact: list[str]) -> dict:
+        report = {"champion-quarantined": 0, "champion-reset": 0}
+        uri = storage.join(self.model_dir, CHAMPION_FILE_NAME)
+        if not storage.exists(uri):
+            return report
+        champion: str | None = None
+        try:
+            champion = str(json.loads(storage.read_text(uri))["generation_id"])
+        except Exception:
+            report["champion-quarantined"] = 1
+            if repair:
+                if storage.is_remote(self.model_dir):
+                    storage.delete(uri)
+                else:
+                    self._quarantine(storage.local_path(uri))
+                metrics.registry.counter("registry.repair.champion-quarantined").inc()
+                log.warning(
+                    "registry repair: quarantined unreadable CHAMPION under %s",
+                    self.model_dir,
+                )
+        if champion is not None and champion not in intact:
+            # pointer at a missing/half-written generation: fall back to
+            # the newest intact one (lineage stays within published gens)
+            report["champion-reset"] = 1
+            if repair:
+                if intact:
+                    self.set_champion(intact[-1])
+                else:
+                    storage.delete(uri)
+                metrics.registry.counter("registry.repair.champion-reset").inc()
+                log.warning(
+                    "registry repair: CHAMPION pointed at unusable generation "
+                    "%s; reset to %s", champion, intact[-1] if intact else "(none)",
+                )
+        return report
+
+    def _sweep_promote_litter(self) -> int:
+        """Remove ``.promote-<gen>-<pid>`` staging dirs whose promoter is
+        dead (MLUpdate stages a candidate there before its atomic rename
+        into the generation slot; a kill mid-copy strands the dir)."""
+        import shutil
+
+        root = storage.local_path(self.model_dir)
+        if not root.is_dir():
+            return 0
+        removed = 0
+        for p in root.iterdir():
+            if not (p.is_dir() and p.name.startswith(".promote-")):
+                continue
+            try:
+                pid = int(p.name.rsplit("-", 1)[1])
+            except (IndexError, ValueError):
+                continue
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            shutil.rmtree(p, ignore_errors=True)
+            removed += 1
+            log.warning("registry repair: swept dead promote staging dir %s", p)
+        return removed
+
+    @staticmethod
+    def _quarantine(path) -> None:
+        aside = path.with_name(f".quarantine-{path.name}-{os.getpid()}")
+        try:
+            os.replace(path, aside)
+        except OSError:
+            log.warning("registry repair: could not quarantine %s", path, exc_info=True)
+            return
+        # durable quarantine: a crash right after fsck must not resurrect
+        # the corrupt file the repair just moved aside
+        storage.fsync_dir(path.parent)
 
     # -- retention GC --------------------------------------------------------
 
@@ -176,6 +317,7 @@ def publish_generation(
         key, payload = "MODEL", pmml_text
     else:
         key, payload = "MODEL-REF", store.generation_dir(generation_id)
+    crashpoint("registry.publish.pre")
     if retry_policy is not None:
         retry_policy.call(
             lambda: producer.send(key, payload),
@@ -184,4 +326,5 @@ def publish_generation(
         )
     else:
         producer.send(key, payload)
+    crashpoint("registry.publish.post")
     return key

@@ -660,6 +660,19 @@ class ServingLayer:
         model_dir = config.get_optional_string("oryx.batch.storage.model-dir")
         self.registry_store = RegistryStore(model_dir) if model_dir else None
 
+        # MODEL-REF restage cache (docs/durability.md): referenced
+        # generation dirs download locally through an atomic temp-dir +
+        # rename, so a crash mid-download never leaves a half-staged
+        # model. Registered process-wide; replicas sharing a process
+        # (tools/fleet.py) share one staged copy per generation.
+        self.model_stager = None
+        restage_dir = config.get_optional_string("oryx.serving.restage-dir")
+        if restage_dir:
+            from oryx_tpu.serving import restage
+
+            self.model_stager = restage.ModelStager(restage_dir)
+            restage.set_active(self.model_stager)
+
         # online experiments (docs/experiments.md): arm router + online
         # evaluator + evidence-gated promotion loop. Built only when
         # oryx.serving.ab.fraction > 0 AND a registry is configured (the
@@ -1015,6 +1028,13 @@ class ServingLayer:
             from oryx_tpu.serving.batcher import release_default_batcher
 
             release_default_batcher()
+        if self.model_stager is not None:
+            from oryx_tpu.serving import restage
+
+            # only clear the process-wide hook if it is still ours — a
+            # replica started after us may have re-registered it
+            if restage.active() is self.model_stager:
+                restage.set_active(None)
 
     def __enter__(self) -> "ServingLayer":
         self.start()

@@ -1,0 +1,46 @@
+"""Seeded durability bugs: each function below must be flagged by the
+durability pass (ORX601-ORX603) with the intended code, and the clean
+commit at the bottom must stay quiet. Never imported — the fixtures dir
+is excluded from real scans."""
+
+import os
+import shutil
+import tempfile
+from pathlib import Path
+
+
+def publish_unsynced(tmp: Path, final: Path):
+    os.replace(tmp, final)  # ORX601: no directory fsync anywhere
+
+
+def publish_unsynced_pathlib(tmp: Path, final: Path):
+    tmp.replace(final)  # ORX601: Path.replace spelling, same hole
+
+
+def publish_from_tempfile(final: Path, fsync_dir):
+    staging = Path(tempfile.mkdtemp(prefix="stage-"))
+    (staging / "model").write_bytes(b"x")  # ORX603 rides along
+    shutil.move(str(staging), str(final))  # ORX602: /tmp may be another fs
+    fsync_dir(final.parent)
+
+
+def raw_state_write(champion: Path):
+    champion.write_text('{"generation_id": "7"}')  # ORX603: torn under kill
+
+
+def clean_commit(p: Path, data: bytes, fsync_dir):
+    tmp = p.with_name(f".{p.name}.tmp-{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    tmp.replace(p)
+    fsync_dir(p.parent)
+
+
+def clean_string_ops(name: str, mapping):
+    # .replace/.rename with two args or keywords are not filesystem
+    # renames — the pass must not flag them
+    other = name.replace("-", "_")
+    frame = mapping.rename(columns={"a": "b"})
+    return other, frame

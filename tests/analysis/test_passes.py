@@ -95,12 +95,36 @@ def test_lifecycle_accepts_release_idioms(tmp_path):
     assert found == []
 
 
+# -- durability ----------------------------------------------------------------
+
+
+def test_durability_flags_rename_tempfile_and_raw_writes(tmp_path):
+    found = _scan(tmp_path, "durbad.py", select={"durability"})
+    by_code = {}
+    for f in found:
+        by_code.setdefault(f.code, []).append(f)
+    assert set(by_code) == {"ORX601", "ORX602", "ORX603"}
+    assert {f.symbol for f in by_code["ORX601"]} == {
+        "publish_unsynced",
+        "publish_unsynced_pathlib",
+    }
+    assert {f.symbol for f in by_code["ORX602"]} == {"publish_from_tempfile"}
+    assert {f.symbol for f in by_code["ORX603"]} == {
+        "publish_from_tempfile",
+        "raw_state_write",
+    }
+    # the commit-protocol function and string .replace/.rename stay quiet
+    assert not any("clean_" in f.symbol for f in found)
+
+
 # -- clean fixture -------------------------------------------------------------
 
 
 def test_clean_fixture_is_quiet(tmp_path):
     found = _scan(
-        tmp_path, "clean.py", select={"lockset", "lockorder", "jaxhot", "lifecycle"}
+        tmp_path,
+        "clean.py",
+        select={"lockset", "lockorder", "jaxhot", "lifecycle", "durability"},
     )
     assert found == []
 

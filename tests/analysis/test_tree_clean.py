@@ -13,6 +13,7 @@ def test_all_passes_registered():
         "lockorder",
         "jaxhot",
         "lifecycle",
+        "durability",
         "config-keys",
         "registry",
         "deploy",

@@ -1,0 +1,135 @@
+"""Torn-record tolerance in the file bus, record by record: a writer
+SIGKILLed mid-append leaves a partial final line that must be dropped
+(it was never acknowledged) BEFORE any fresh append lands after it, or
+two half-records weld into one corrupt line; a writer SIGKILLed mid-roll
+leaves a stale base sidecar that — left alone — would shadow every
+acknowledged record in the segment it just archived. Both recoveries
+are exercised here through the public produce/consume/repair surface.
+The end-to-end versions (kill a real subprocess at these sites) live in
+the crash sweep; these are the fast in-process regressions."""
+
+from __future__ import annotations
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.common import corruption, crashpoints, metrics
+
+
+def _counter(name: str) -> float:
+    return metrics.registry.counter(name).snapshot()["value"]
+
+
+def make_broker(tmp_path, segment_bytes=10_000):
+    broker = bus.get_broker(f"file:{tmp_path}/bus")
+    broker.create_topic("T", partitions=1, config={"segment-bytes": segment_bytes})
+    return broker
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    crashpoints.reset()
+    yield
+    crashpoints.reset()
+
+
+def test_append_after_torn_tail_does_not_weld_records(tmp_path):
+    broker = make_broker(tmp_path)
+    with broker.producer("T") as p:
+        for j in range(5):
+            p.send(None, f"m{j:04d}")
+    before = _counter("bus.repair.truncated")
+    # cut mid-record: the final line loses its newline and part of its body
+    corruption.tear_filebus_partition(tmp_path / "bus", "T", cut=3)
+    with broker.producer("T") as p:
+        p.send(None, "fresh")
+    assert _counter("bus.repair.truncated") == before + 1
+    got = [m.message for m in broker.consumer("T", from_beginning=True).poll(100, 1.0)]
+    # the torn record is gone (never acknowledged-readable), the intact
+    # prefix survives, and "fresh" did NOT weld onto the torn bytes
+    assert got == ["m0000", "m0001", "m0002", "m0003", "fresh"]
+
+
+def test_tear_destroying_every_newline_truncates_to_empty(tmp_path):
+    broker = make_broker(tmp_path)
+    with broker.producer("T") as p:
+        p.send(None, "only-record")
+    log = tmp_path / "bus" / "T" / "partition-0.log"
+    corruption.truncate_to(log, 4)  # no newline survives anywhere
+    report = broker.repair("T")
+    assert report["truncated"] == 1
+    assert log.stat().st_size == 0
+    assert broker.repair("T")["truncated"] == 0  # idempotent
+    with broker.producer("T") as p:
+        p.send(None, "reborn")
+    got = [m.message for m in broker.consumer("T", from_beginning=True).poll(100, 1.0)]
+    assert got == ["reborn"]
+    assert broker.latest_offsets("T") == {0: 1}
+
+
+def test_repair_truncates_torn_tail_without_a_producer(tmp_path):
+    broker = make_broker(tmp_path)
+    with broker.producer("T") as p:
+        for j in range(4):
+            p.send(None, f"m{j:04d}")
+    corruption.tear_filebus_partition(tmp_path / "bus", "T", cut=3)
+    assert broker.repair("T")["truncated"] == 1
+    got = [m.message for m in broker.consumer("T", from_beginning=True).poll(100, 1.0)]
+    assert got == ["m0000", "m0001", "m0002"]
+
+
+def _crash_one_roll(broker, start, segment_bytes=60):
+    """Send small records from ``start`` until a roll fires the armed
+    ``bus.file.roll.mid`` crashpoint; returns the acknowledged ids."""
+    crashpoints.arm("bus.file.roll.mid", action="raise")
+    acked = []
+    p = broker.producer("T")
+    try:
+        for j in range(start, start + 3 * segment_bytes):
+            p.send(None, f"m{j:04d}")
+            acked.append(j)
+        raise AssertionError("segment never rolled")
+    except crashpoints.CrashPointReached:
+        pass
+    finally:
+        crashpoints.reset()
+    return acked
+
+
+def test_mid_roll_crash_repair_rebuilds_stale_base(tmp_path):
+    """Regression: a producer dying between ``os.replace`` (segment
+    archived) and the base-sidecar commit leaves a base that trails the
+    archived chain. ``repair`` must re-anchor it, or the archived
+    records are shadowed — acknowledged input silently lost."""
+    broker = make_broker(tmp_path, segment_bytes=60)
+    acked = _crash_one_roll(broker, 0)
+    segs = list((tmp_path / "bus" / "T").glob("partition-0.seg*.log"))
+    assert acked
+    assert len(segs) == 1  # the crash archived the full first segment
+    # the stale base claims 0 while every acked record is in the archive
+    report = broker.repair("T")
+    assert report["bases-rebuilt"] == 1
+    assert broker.latest_offsets("T") == {0: len(acked)}
+    got = [m.message for m in broker.consumer("T", from_beginning=True).poll(100, 1.0)]
+    assert got == [f"m{j:04d}" for j in acked]
+    assert broker.repair("T")["bases-rebuilt"] == 0  # idempotent
+
+
+def test_mid_roll_crash_next_roll_self_heals_without_losing_records(tmp_path):
+    """Regression: even with no fsck run, the NEXT roll must notice the
+    archive-name collision the stale base would cause and re-anchor
+    instead of archiving the new active over the old segment."""
+    broker = make_broker(tmp_path, segment_bytes=60)
+    acked = _crash_one_roll(broker, 0)
+    before = _counter("bus.repair.base-rebuilt")
+    # keep producing through a second roll: without the collision guard
+    # this would os.replace the new active onto seg0, destroying the 10
+    # acknowledged records inside it
+    with broker.producer("T") as p:
+        for j in range(len(acked), len(acked) + 11):
+            p.send(None, f"m{j:04d}")
+            acked.append(j)
+    assert _counter("bus.repair.base-rebuilt") == before + 1
+    got = [m.message for m in broker.consumer("T", from_beginning=True).poll(100, 1.0)]
+    assert got == [f"m{j:04d}" for j in acked]  # every ack, exactly once
+    assert broker.latest_offsets("T") == {0: len(acked)}

@@ -1,0 +1,232 @@
+"""Torn-write corruption chaos: every injector in common/corruption.py
+drives its matching repair path, and every repair is observable on a
+bus.repair.* / registry.repair.* / serving.restage.* counter.
+
+The contract under test is recover-or-refuse: damaged state is
+truncated, quarantined aside, or reset loudly — a reader never sees a
+torn record, a half-written generation, or an insane ring geometry."""
+
+from __future__ import annotations
+
+import pytest
+
+from oryx_tpu import bus
+from oryx_tpu.common import corruption, metrics
+from oryx_tpu.registry.store import RegistryStore
+
+pytestmark = pytest.mark.chaos
+
+
+def _counter(name: str) -> float:
+    return metrics.registry.counter(name).snapshot()["value"]
+
+
+def _drain(broker, topic: str) -> list[str]:
+    c = broker.consumer(topic, from_beginning=True)
+    try:
+        out = []
+        while True:
+            batch = c.poll(timeout=0.05)
+            if not batch:
+                return out
+            out.extend(m.message for m in batch)
+    finally:
+        c.close()
+
+
+# -- filebus -----------------------------------------------------------------
+
+
+def test_torn_partition_tail_is_truncated_on_open(tmp_path):
+    broker = bus.get_broker(f"file:{tmp_path}/bus")
+    broker.create_topic("T", partitions=1)
+    with broker.producer("T") as p:
+        for j in range(5):
+            p.send(None, f"rec-{j}")
+    before = _counter("bus.repair.truncated")
+    desc = corruption.tear_filebus_partition(tmp_path / "bus", "T", cut=3)
+    assert "tore 3 byte" in desc
+    # repair-on-open: the torn final record is dropped, the intact prefix
+    # survives, and appends after repair extend cleanly (no welded record)
+    assert _drain(broker, "T") == [f"rec-{j}" for j in range(4)]
+    assert _counter("bus.repair.truncated") == before + 1
+    with broker.producer("T") as p:
+        p.send(None, "after-tear")
+    assert _drain(broker, "T") == [f"rec-{j}" for j in range(4)] + ["after-tear"]
+
+
+def test_garbled_offset_ledger_is_quarantined_and_group_replays(tmp_path):
+    broker = bus.get_broker(f"file:{tmp_path}/bus")
+    broker.create_topic("T", partitions=1)
+    with broker.producer("T") as p:
+        for j in range(6):
+            p.send(None, f"m{j}")
+    c = broker.consumer("T", group="g", from_beginning=True)
+    assert len(c.poll(max_records=100, timeout=1.0)) == 6
+    c.commit()
+    c.close()
+
+    before = _counter("bus.repair.ledger-quarantined")
+    corruption.garble_filebus_ledger(tmp_path / "bus", "g")
+    # the group cannot trust a torn ledger: it replays from earliest
+    # (at-least-once, never silent loss) and the ledger is set aside
+    c = broker.consumer("T", group="g")
+    try:
+        replayed = c.poll(max_records=100, timeout=1.0)
+    finally:
+        c.close()
+    assert [m.message for m in replayed] == [f"m{j}" for j in range(6)]
+    assert _counter("bus.repair.ledger-quarantined") == before + 1
+
+
+# -- shm ring ----------------------------------------------------------------
+
+
+def test_crc_garbled_shm_frame_rolls_head_back(tmp_path):
+    broker = bus.get_broker(f"shm:{tmp_path}/shm")
+    broker.create_topic("S", partitions=1)
+    with broker.producer("S") as p:
+        for j in range(3):
+            p.send(None, f"frame-{j}")
+    before = _counter("bus.repair.shm-head-rollback")
+    desc = corruption.garble_shm_frame(tmp_path / "shm" / "S" / "partition-0.ring")
+    assert "flipped" in desc
+    report = broker.repair()
+    assert report["head-rollback"] >= 1
+    assert _counter("bus.repair.shm-head-rollback") > before
+    # the frontier rolled back to the last intact frame; nothing torn is
+    # ever delivered, and the ring accepts appends again
+    assert _drain(broker, "S") == ["frame-0", "frame-1"]
+    with broker.producer("S") as p:
+        p.send(None, "after-repair")
+    assert _drain(broker, "S")[-1] == "after-repair"
+
+
+def test_insane_shm_header_resets_ring(tmp_path):
+    broker = bus.get_broker(f"shm:{tmp_path}/shm")
+    broker.create_topic("S", partitions=1)
+    with broker.producer("S") as p:
+        p.send(None, "doomed")
+    before = _counter("bus.repair.shm-reset")
+    corruption.garble_shm_header(tmp_path / "shm" / "S" / "partition-0.ring")
+    report = broker.repair()
+    assert report["reset"] >= 1
+    assert _counter("bus.repair.shm-reset") > before
+    # reset-empty, loudly — and usable again
+    with broker.producer("S") as p:
+        p.send(None, "reborn")
+    assert _drain(broker, "S") == ["reborn"]
+
+
+def test_garble_shm_frame_refuses_an_empty_ring(tmp_path):
+    broker = bus.get_broker(f"shm:{tmp_path}/shm")
+    broker.create_topic("S", partitions=1)
+    with pytest.raises(ValueError):
+        corruption.garble_shm_frame(tmp_path / "shm" / "S" / "partition-0.ring")
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def _make_generation(model_dir, gen: str) -> None:
+    d = model_dir / gen
+    d.mkdir(parents=True)
+    (d / "model.pmml").write_text(f"<PMML generation={gen}/>")
+
+
+def test_champion_at_missing_generation_resets_to_newest_intact(tmp_path):
+    model_dir = tmp_path / "model"
+    _make_generation(model_dir, "100")
+    _make_generation(model_dir, "101")
+    store = RegistryStore(str(model_dir))
+    store.set_champion("101")
+    before = _counter("registry.repair.champion-reset")
+    corruption.point_champion_at(model_dir, "424242")
+    report = store.fsck(repair=True)
+    assert report["champion-reset"] == 1
+    assert _counter("registry.repair.champion-reset") == before + 1
+    assert store.champion_id() == "101"
+
+
+def test_garbled_champion_pointer_is_quarantined(tmp_path):
+    model_dir = tmp_path / "model"
+    _make_generation(model_dir, "100")
+    store = RegistryStore(str(model_dir))
+    store.set_champion("100")
+    before = _counter("registry.repair.champion-quarantined")
+    corruption.garble_champion(model_dir)
+    report = store.fsck(repair=True)
+    assert report["champion-quarantined"] == 1
+    assert _counter("registry.repair.champion-quarantined") == before + 1
+    # the torn pointer went aside for forensics, not into a reader
+    assert store.champion_id() is None
+    assert any(p.name.startswith(".quarantine-") for p in model_dir.iterdir())
+    assert not store.fsck(repair=False)["champion-quarantined"]
+
+
+def test_amputated_generation_is_quarantined(tmp_path):
+    model_dir = tmp_path / "model"
+    _make_generation(model_dir, "100")
+    _make_generation(model_dir, "101")
+    store = RegistryStore(str(model_dir))
+    store.set_champion("100")
+    before = _counter("registry.repair.generation-quarantined")
+    corruption.amputate_generation(model_dir, "101")
+    report = store.fsck(repair=True)
+    assert report["generations-quarantined"] == 1
+    assert _counter("registry.repair.generation-quarantined") == before + 1
+    assert store.list_generations() == ["100"]
+    assert store.champion_id() == "100"
+
+
+def test_promote_litter_and_tmp_litter_are_swept(tmp_path):
+    model_dir = tmp_path / "model"
+    _make_generation(model_dir, "100")
+    store = RegistryStore(str(model_dir))
+    corruption.litter_promote(model_dir)
+    corruption.litter_tmp(model_dir, name="CHAMPION")
+    report = store.fsck(repair=True)
+    assert report["tmp-swept"] >= 2
+    assert not any(p.name.startswith((".promote-", ".CHAMPION.tmp")) for p in model_dir.iterdir())
+
+
+# -- cli repair: one sweep over every store ----------------------------------
+
+
+def test_cli_repair_sweeps_all_stores(tmp_path, capsys):
+    from oryx_tpu import cli
+    from oryx_tpu.common import config as config_utils
+
+    broker = bus.get_broker(f"file:{tmp_path}/bus")
+    broker.create_topic("OryxInput", partitions=1)
+    with broker.producer("OryxInput") as p:
+        for j in range(4):
+            p.send(None, f"x{j},y{j}")
+    corruption.tear_filebus_partition(tmp_path / "bus", "OryxInput", cut=2)
+
+    model_dir = tmp_path / "model"
+    _make_generation(model_dir, "100")
+    RegistryStore(str(model_dir)).set_champion("100")
+    corruption.point_champion_at(model_dir, "31337")
+    corruption.litter_promote(model_dir)
+
+    cfg = config_utils.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-topic.broker = "file:{tmp_path}/bus"
+          update-topic.broker = "file:{tmp_path}/bus"
+          batch.storage.model-dir = "{model_dir}/"
+          serving.restage-dir = "{tmp_path}/cache"
+        }}
+        """
+    )
+    assert cli.run_repair(cfg) == 0
+    out = capsys.readouterr().out
+    assert "repair: repairs applied" in out
+
+    # everything audits clean on the second pass
+    assert cli.run_repair(cfg) == 0
+    out = capsys.readouterr().out
+    assert "repair: all stores clean" in out
+    assert RegistryStore(str(model_dir)).champion_id() == "100"
+    assert _drain(broker, "OryxInput") == [f"x{j},y{j}" for j in range(3)]

@@ -1,0 +1,76 @@
+"""Unit tests for the crashpoint primitive itself: arming, nth-visit
+counting, the raise action for in-process drills, env parsing, and the
+disarmed fast path."""
+
+from __future__ import annotations
+
+import pytest
+
+from oryx_tpu.common import crashpoints
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    crashpoints.reset()
+    yield
+    crashpoints.reset()
+
+
+def test_disarmed_is_a_noop() -> None:
+    assert crashpoints.armed_site() is None
+    for site in crashpoints.CATALOG:
+        crashpoints.crashpoint(site)  # must not raise, must not count
+        assert crashpoints.hits(site) == 0
+
+
+def test_raise_action_fires_on_nth_visit() -> None:
+    crashpoints.arm("storage.commit.pre", nth=3, action="raise")
+    crashpoints.crashpoint("storage.commit.pre")
+    crashpoints.crashpoint("storage.commit.pre")
+    with pytest.raises(crashpoints.CrashPointReached) as exc:
+        crashpoints.crashpoint("storage.commit.pre")
+    assert exc.value.site == "storage.commit.pre"
+    assert crashpoints.hits("storage.commit.pre") == 3
+
+
+def test_only_the_armed_site_counts() -> None:
+    crashpoints.arm("bus.file.append.pre", action="raise")
+    crashpoints.crashpoint("bus.file.append.post")
+    crashpoints.crashpoint("storage.commit.pre")
+    assert crashpoints.hits("bus.file.append.post") == 0
+    with pytest.raises(crashpoints.CrashPointReached):
+        crashpoints.crashpoint("bus.file.append.pre")
+
+
+def test_crashpoint_reached_is_not_an_exception_subclass() -> None:
+    # `except Exception` recovery paths must never swallow a simulated
+    # death, or the drill would test the wrong recovery code
+    assert not issubclass(crashpoints.CrashPointReached, Exception)
+    assert issubclass(crashpoints.CrashPointReached, BaseException)
+
+
+def test_arm_rejects_unknown_action() -> None:
+    with pytest.raises(ValueError):
+        crashpoints.arm("storage.commit.pre", action="explode")
+
+
+def test_arm_from_env_parses_site_and_nth() -> None:
+    site = crashpoints.arm_from_env({"ORYX_CRASHPOINT": "speed.commit.pre:4"})
+    assert site == "speed.commit.pre"
+    assert crashpoints.armed_site() == "speed.commit.pre"
+    crashpoints.reset()
+    assert crashpoints.arm_from_env({}) is None
+    assert crashpoints.armed_site() is None
+    with pytest.raises(ValueError):
+        crashpoints.arm_from_env({"ORYX_CRASHPOINT": ":3"})
+
+
+def test_reset_disarms_and_clears_counts() -> None:
+    crashpoints.arm("ml.promote.mid", nth=99, action="raise")
+    crashpoints.crashpoint("ml.promote.mid")
+    assert crashpoints.hits("ml.promote.mid") == 1
+    crashpoints.reset()
+    assert crashpoints.armed_site() is None
+    assert crashpoints.hits("ml.promote.mid") == 0

@@ -1,0 +1,42 @@
+"""Fleet crash campaign in tier-1: three subprocess serving replicas on
+one file-backed update topic, open-loop traffic, one SIGKILL mid-run —
+no drain, no close() chain. The router must fail in-flight work over to
+the survivors (zero failed requests), p99 must hold within SLO, and the
+killed slot must respawn, re-repair its restage cache, replay the update
+topic, and answer /readyz within the recovery budget — the
+SIGKILL->/readyz interval is the recovery.seconds measurement."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+import fleet  # noqa: E402  (tools/ is not a package)
+
+pytestmark = [pytest.mark.chaos, pytest.mark.fleet]
+
+
+def test_crash_campaign_survives_one_sigkill(tmp_path):
+    report = fleet.run_crash_campaign(
+        replicas=3,
+        rate=60.0,
+        seconds=5.0,
+        work_dir=str(tmp_path),
+        recovery_budget_s=45.0,
+    )
+    assert report["crashes"] == 1, report
+    assert report["failed"] == 0, report
+    assert report["slo"]["passed"], report["slo"]
+    assert report["recovery_within_budget"], report
+    assert len(report["recovery_seconds"]) == 1
+    assert 0.0 < report["recovery_seconds"][0] <= 45.0
+    # the measurement also lands on the recovery.seconds gauge
+    from oryx_tpu.common import metrics
+
+    gauge = metrics.registry.gauge("recovery.seconds").snapshot()
+    assert gauge["value"] == pytest.approx(report["recovery_seconds"][0], abs=0.001)

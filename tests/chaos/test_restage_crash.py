@@ -1,0 +1,111 @@
+"""A serving replica SIGKILLed mid-MODEL-REF download must leave no
+trace the server could load: the stage commit is one atomic rename, so
+the crash leaves only a hidden ``.stage-*`` temp dir (with model.pmml
+deliberately absent — it copies last), the next stager sweeps it on
+open, and the restage then completes cleanly. Zero leaked resources is
+enforced by the chaos-marker ledger fixture."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from oryx_tpu.common import crashpoints, metrics
+from oryx_tpu.serving import restage
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+KILLED = (-int(signal.SIGKILL), 128 + int(signal.SIGKILL))
+
+
+def _counter(name: str) -> float:
+    return metrics.registry.counter(name).snapshot()["value"]
+
+
+def _make_generation(model_dir: Path, gen: str = "100") -> str:
+    """A registry-shaped generation dir: model.pmml plus a nested side
+    artifact, so the restage exercises subdir creation and the
+    model-last copy ordering."""
+    d = model_dir / gen
+    (d / "extra").mkdir(parents=True)
+    (d / "extra" / "ids.txt").write_text("u1\nu2\n")
+    (d / "model.pmml").write_text("<PMML>gen-%s</PMML>" % gen)
+    return str(d)
+
+
+def _stage_litter(root: Path) -> list[Path]:
+    return sorted(p for p in root.iterdir() if p.name.startswith(".stage-"))
+
+
+def test_raise_mid_download_aborts_without_half_staged_dir(tmp_path):
+    ref = _make_generation(tmp_path / "models")
+    stager = restage.ModelStager(tmp_path / "cache")
+    crashpoints.arm("serving.restage.mid", action="raise")
+    try:
+        with pytest.raises(crashpoints.CrashPointReached):
+            stager.stage(ref)
+    finally:
+        crashpoints.reset()
+    # the in-process abort path cleans its own temp; nothing half-staged
+    assert not stager.is_staged("100")
+    assert not stager.staged_path("100").exists()
+    assert _stage_litter(stager.root) == []
+    # disarmed, the same stager restages the generation whole
+    staged = stager.stage(ref)
+    assert staged == stager.staged_path("100")
+    assert (staged / "model.pmml").is_file()
+    assert (staged / "extra" / "ids.txt").read_text() == "u1\nu2\n"
+
+
+def test_sigkill_mid_download_sweeps_litter_then_restages(tmp_path):
+    ref = _make_generation(tmp_path / "models")
+    cache = tmp_path / "cache"
+    env = dict(os.environ)
+    env["ORYX_CRASHPOINT"] = "serving.restage.mid:1"
+    env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sys; from oryx_tpu.serving.restage import ModelStager; "
+            "ModelStager(sys.argv[1]).stage(sys.argv[2])",
+            str(cache),
+            ref,
+        ],
+        env=env,
+        timeout=60,
+        capture_output=True,
+    )
+    assert proc.returncode in KILLED, (proc.returncode, proc.stderr.decode())
+    # the dead replica left exactly its staging temp — side artifacts
+    # copied, model.pmml NOT (it copies last, so a visible model always
+    # implies complete siblings)
+    litter = _stage_litter(cache)
+    assert len(litter) == 1
+    assert litter[0].name.startswith(".stage-100-")
+    assert not (litter[0] / "model.pmml").exists()
+    assert (litter[0] / "extra" / "ids.txt").is_file()
+    assert not (cache / "100").exists()
+
+    # the replacement replica sweeps the dead stager's litter on open...
+    swept_before = _counter("serving.restage.swept")
+    staged_before = _counter("serving.restage.staged")
+    stager = restage.ModelStager(cache)
+    assert stager.swept_on_open == 1
+    assert _counter("serving.restage.swept") == swept_before + 1
+    assert _stage_litter(cache) == []
+    # ...and restages the generation cleanly
+    staged = stager.stage(ref)
+    assert stager.is_staged("100")
+    assert (staged / "model.pmml").read_text() == "<PMML>gen-100</PMML>"
+    assert (staged / "extra" / "ids.txt").is_file()
+    assert _counter("serving.restage.staged") == staged_before + 1
+    assert _stage_litter(cache) == []

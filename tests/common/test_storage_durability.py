@@ -1,0 +1,132 @@
+"""Durability contract of the storage commit helpers, verified through a
+fault-injecting fsync shim: the temp file is fsynced BEFORE the atomic
+rename, the parent directory AFTER it (a rename can survive a crash
+while its contents don't, and a rename isn't durable until the directory
+entry is synced), a failing fsync aborts the commit without touching the
+target, and a kill at either commit boundary leaves only sweepable
+litter."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+import pytest
+
+from oryx_tpu.common import crashpoints, storage
+
+
+@pytest.fixture()
+def fsync_log(monkeypatch):
+    """Shim os.fsync + Path.replace to record the commit sequence.
+    Entries: ("fsync", resolved-path) and ("replace", src, dst).
+    Path.replace is shimmed directly because pathlib binds os.replace at
+    class-creation time, out of reach of an os-module monkeypatch."""
+    events: list[tuple] = []
+    real_fsync, real_replace = os.fsync, pathlib.Path.replace
+
+    def shim_fsync(fd):
+        events.append(("fsync", os.path.realpath(f"/proc/self/fd/{fd}")))
+        return real_fsync(fd)
+
+    def shim_replace(self, target):
+        events.append(("replace", str(self), str(target)))
+        return real_replace(self, target)
+
+    monkeypatch.setattr(os, "fsync", shim_fsync)
+    monkeypatch.setattr(pathlib.Path, "replace", shim_replace)
+    return events
+
+
+def _commit_sequence(events, target):
+    """The (kind, path) shape of one commit: which files were fsynced on
+    either side of the rename onto `target`."""
+    seq = []
+    for e in events:
+        if e[0] == "replace" and e[2] == str(target):
+            seq.append(("replace",))
+        elif e[0] == "fsync":
+            seq.append(("fsync", e[1]))
+    return seq
+
+
+def test_commit_bytes_fsyncs_file_then_renames_then_fsyncs_dir(tmp_path, fsync_log):
+    target = tmp_path / "CHAMPION"
+    storage.commit_bytes(target, b'{"generation_id": "100"}')
+    assert target.read_bytes() == b'{"generation_id": "100"}'
+    seq = _commit_sequence(fsync_log, target)
+    replace_at = seq.index(("replace",))
+    # some fsync BEFORE the rename hit the temp sibling...
+    pre = [p for kind, *p in seq[:replace_at] if kind == "fsync"]
+    assert any(storage.TMP_MARKER in p for (p,) in pre), seq
+    # ...and some fsync AFTER it hit the parent directory
+    post = [p for kind, *p in seq[replace_at + 1 :] if kind == "fsync"]
+    assert any(p == str(tmp_path) for (p,) in post), seq
+
+
+def test_open_write_local_has_the_same_commit_sequence(tmp_path, fsync_log):
+    target = tmp_path / "meta.json"
+    with storage.open_write(target, "wb") as f:
+        f.write(b"{}")
+    seq = _commit_sequence(fsync_log, target)
+    replace_at = seq.index(("replace",))
+    assert any(
+        storage.TMP_MARKER in p for kind, p in seq[:replace_at] if kind == "fsync"
+    )
+    assert any(
+        p == str(tmp_path) for kind, p in seq[replace_at + 1 :] if kind == "fsync"
+    )
+
+
+def test_failing_fsync_aborts_commit_without_touching_target(tmp_path, monkeypatch):
+    target = tmp_path / "STATE"
+    storage.commit_bytes(target, b"durable v1")
+
+    def failing_fsync(fd):
+        raise OSError("injected: disk refused fsync")
+
+    monkeypatch.setattr(os, "fsync", failing_fsync)
+    with pytest.raises(OSError, match="injected"):
+        storage.commit_bytes(target, b"torn v2")
+    monkeypatch.undo()
+    # recover-or-refuse: the target still holds v1, and the aborted
+    # writer cleaned its own temp (nothing for sweep_tmp to find)
+    assert target.read_bytes() == b"durable v1"
+    assert [p.name for p in tmp_path.iterdir()] == ["STATE"]
+
+
+def test_kill_before_rename_leaves_only_sweepable_litter(tmp_path):
+    target = tmp_path / "STATE"
+    storage.commit_bytes(target, b"v1")
+    crashpoints.arm("storage.commit.pre", action="raise")
+    try:
+        with pytest.raises(crashpoints.CrashPointReached):
+            storage.commit_bytes(target, b"v2")
+    finally:
+        crashpoints.reset()
+    assert target.read_bytes() == b"v1"  # commit never happened
+    litter = [p for p in tmp_path.iterdir() if storage.TMP_MARKER in p.name]
+    assert len(litter) == 1  # the dead writer's temp, fully written
+    # our own pid is alive, so the litter is NOT swept (a live writer may
+    # still be mid-commit); a dead writer's litter is
+    assert storage.sweep_tmp(tmp_path) == 0
+    dead = litter[0].with_name(
+        litter[0].name.replace(f"{storage.TMP_MARKER}{os.getpid()}-", f"{storage.TMP_MARKER}999999999-")
+    )
+    litter[0].rename(dead)
+    assert storage.sweep_tmp(tmp_path) == 1
+    assert [p.name for p in tmp_path.iterdir()] == ["STATE"]
+
+
+def test_kill_after_rename_is_already_committed(tmp_path):
+    target = tmp_path / "STATE"
+    storage.commit_bytes(target, b"v1")
+    crashpoints.arm("storage.commit.post", action="raise")
+    try:
+        with pytest.raises(crashpoints.CrashPointReached):
+            storage.commit_bytes(target, b"v2")
+    finally:
+        crashpoints.reset()
+    # the rename is the commit point: v2 is visible, no litter remains
+    assert target.read_bytes() == b"v2"
+    assert [p.name for p in tmp_path.iterdir()] == ["STATE"]

@@ -203,3 +203,43 @@ def test_traced_requests_send_traceparent_and_record_client_spans(server):
         }
     finally:
         tracing.reset()
+
+
+def test_connection_failover_retries_on_surviving_replica(server):
+    """Crash failover: a target refusing connections (SIGKILLed, not
+    draining) is demoted immediately and the request retries on a
+    survivor — zero failed requests stays assertable through a kill."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    t_dead = Target("dead", f"http://127.0.0.1:{dead_port}")
+    t_ok = Target("ok", server.base)
+    engine = OpenLoopEngine(
+        [t_dead, t_ok], template="/probe/u%d", readiness_poll_s=0
+    )
+    result = _run(engine, rate=50.0, seconds=0.5)
+    assert result.failed == 0
+    assert result.ok == result.completed > 0
+    assert result.retried > 0  # the dead replica did catch picks
+    assert result.per_target["ok"].ok == result.ok
+    assert t_dead.ready is False  # demoted on first refusal
+
+
+def test_connection_failover_without_survivor_records_the_failure(server):
+    """A lone replica refusing connections is NOT silently demoted into
+    no-ready-replica limbo: the failure is recorded as `connection` and
+    the target stays routable for the poller to judge."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    t = Target("t0", f"http://127.0.0.1:{port}")
+    engine = OpenLoopEngine([t], template="/probe/u%d", readiness_poll_s=0)
+    result = _run(engine, rate=10.0, seconds=0.3)
+    assert result.failed == result.completed > 0
+    assert set(result.error_kinds) == {"connection"}
+    assert result.retried == 0
+    assert t.ready is True

@@ -43,6 +43,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -50,7 +53,8 @@ import urllib.error
 import urllib.request
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
 
 from oryx_tpu import bus
 from oryx_tpu.bus import faultbus
@@ -487,6 +491,286 @@ class FleetHarness:
         }
 
 
+# -- crash campaign: replicas as real processes, SIGKILL as the verb ---------
+
+
+def _process_replica_config(work_dir: str, slot_dir: str):
+    """Config for one subprocess replica: a file-backed bus both sides of
+    the process boundary can see (inproc cannot cross it), the shared
+    model dir, and a per-slot restage cache so the MODEL-REF download
+    path is part of what the kill interrupts."""
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "Fleet"
+          input-topic.broker = "file:{work_dir}/bus"
+          update-topic.broker = "file:{work_dir}/bus"
+          batch.storage {{ data-dir = "{work_dir}/data/"
+                           model-dir = "{work_dir}/model/" }}
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.registry.testing.PMMLProbeServingModelManager"
+            application-resources = "oryx_tpu.registry.testing"
+            restage-dir = "{slot_dir}/cache"
+          }}
+          ml {{
+            eval {{ candidates = 1, test-fraction = 0.5 }}
+            gate.max-regression = 0.05
+          }}
+          test.scripted-metric = 0.9
+        }}
+        """
+    )
+
+
+def serve_replica(work_dir: str, slot_dir: str) -> int:
+    """Child entry point (--serve-replica): run one ServingLayer until
+    SIGTERM (clean close) — or SIGKILL, which is the point."""
+    from oryx_tpu.common import storage
+
+    slot = Path(slot_dir)
+    slot.mkdir(parents=True, exist_ok=True)
+    layer = ServingLayer(_process_replica_config(work_dir, slot_dir))
+    layer.start()
+    # the port commit is the parent's only discovery channel — atomic, so
+    # the parent never reads a half-written port
+    storage.commit_text(slot / "port", str(layer.port))
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        layer.close()
+    return 0
+
+
+class ReplicaProcess:
+    """One serving replica as a child process: spawn, readiness, SIGKILL,
+    respawn — the crash campaign's unit of failure."""
+
+    def __init__(self, index: int, work_dir: str) -> None:
+        self.index = index
+        self.work_dir = str(work_dir)
+        self.slot_dir = Path(work_dir) / f"replica-{index}"
+        self.proc: subprocess.Popen | None = None
+        self.port: int | None = None
+
+    def spawn(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            raise RuntimeError(f"replica {self.index} is already running")
+        self.slot_dir.mkdir(parents=True, exist_ok=True)
+        (self.slot_dir / "port").unlink(missing_ok=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, str(Path(__file__).resolve()),
+                "--serve-replica", str(self.slot_dir), "--work-dir", self.work_dir,
+            ],
+            env=env,
+            cwd=str(REPO_ROOT),
+        )
+
+    def wait_ready(self, timeout: float = 60.0) -> float:
+        """Block until the replica answers /readyz 200; returns seconds
+        waited (the recovery-time measurement when called after a kill)."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        port_file = self.slot_dir / "port"
+        while time.monotonic() < deadline:
+            if self.proc is not None and self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica-{self.index} died during startup "
+                    f"(rc={self.proc.returncode})"
+                )
+            if self.port is None:
+                try:
+                    self.port = int(port_file.read_text())
+                except (OSError, ValueError):
+                    time.sleep(0.05)
+                    continue
+            try:
+                status, _ = _http("GET", f"{self.base_url}/readyz", timeout=2.0)
+                if status == 200:
+                    return time.monotonic() - t0
+            except Exception:  # noqa: BLE001 - server not up yet
+                pass
+            time.sleep(0.05)
+        raise TimeoutError(f"replica-{self.index} not ready within {timeout}s")
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def kill(self) -> None:
+        """SIGKILL — no drain, no close() chain, no atexit."""
+        if self.proc is not None:
+            self.proc.send_signal(signal.SIGKILL)
+            self.proc.wait(timeout=30)
+        self.port = None
+
+    def terminate(self, timeout: float = 15.0) -> None:
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+        self.proc = None
+        self.port = None
+
+
+class ProcessFleet:
+    """N subprocess replicas over one file-backed update topic, plus the
+    `crash` scenario verb (SIGKILL + respawn + recovery-time measurement).
+    Duck-types the FleetHarness surface run_scenario needs (targets,
+    handlers(), slo_p99_ms)."""
+
+    def __init__(self, n_replicas: int, work_dir: str) -> None:
+        self.n_replicas = int(n_replicas)
+        self.work_dir = str(work_dir)
+        self.model_dir = f"{self.work_dir}/model"
+        self.replicas = [ReplicaProcess(i, work_dir) for i in range(self.n_replicas)]
+        self.targets: list[Target] = []
+        self.generations: list[str] = []
+        self._next_ts = 1000
+        self.slo_p99_ms = 1000.0
+        # one entry per crash verb: {"replica", "recovery_seconds"}; the
+        # last measurement also lands on the recovery.seconds gauge
+        self.crash_events: list[dict] = []
+
+    def publish(self, metric: float = 0.9) -> str:
+        """One ScriptedMetricUpdate batch generation onto the shared file
+        bus (the replicas replay it on boot — publish before start)."""
+        from oryx_tpu.registry.testing import ScriptedMetricUpdate
+
+        ts = self._next_ts
+        self._next_ts += 1000
+        update = ScriptedMetricUpdate(
+            _process_replica_config(self.work_dir, f"{self.work_dir}/driver")
+        )
+        data = [KeyMessage(None, f"r{i}") for i in range(6)]
+        broker = bus.get_broker(f"file:{self.work_dir}/bus")
+        with broker.producer(UPDATE_TOPIC) as producer:
+            update.run_update(ts, data, [], self.model_dir, producer)
+        self.generations.append(str(ts))
+        return str(ts)
+
+    def start(self, ready_timeout: float = 60.0) -> None:
+        broker = bus.get_broker(f"file:{self.work_dir}/bus")
+        broker.create_topic(UPDATE_TOPIC, 1)
+        broker.create_topic(INPUT_TOPIC, 1)
+        if not self.generations:
+            self.publish()
+        try:
+            for r in self.replicas:
+                r.spawn()
+            for r in self.replicas:
+                r.wait_ready(timeout=ready_timeout)
+                self.targets.append(Target(f"replica-{r.index}", r.base_url))
+        except BaseException:
+            self.stop()
+            raise
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            r.terminate()
+        self.targets.clear()
+
+    def __enter__(self) -> "ProcessFleet":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def crash(self, replica: int = 1, recovery_timeout: float = 60.0) -> float:
+        """The crash verb: SIGKILL one replica mid-traffic (no drain — the
+        router discovers the death by connection refusal and fails over),
+        respawn it in the same slot, and measure SIGKILL -> /readyz 200.
+        The respawned replica re-repairs its restage cache and replays
+        the update topic; the measurement is the whole recovery, not just
+        process start."""
+        from oryx_tpu.common import metrics
+
+        r = self.replicas[replica]
+        t0 = time.monotonic()
+        r.kill()
+        self.targets[replica].ready = False
+        r.spawn()
+        r.wait_ready(timeout=recovery_timeout)
+        recovery_s = time.monotonic() - t0
+        self.targets[replica].base_url = r.base_url
+        # the readiness poller re-promotes the target from /readyz
+        metrics.registry.gauge("recovery.seconds").set(recovery_s)
+        self.crash_events.append(
+            {"replica": replica, "recovery_seconds": round(recovery_s, 3)}
+        )
+        return recovery_s
+
+    def handlers(self) -> dict:
+        return {"publish": self.publish, "crash": self.crash}
+
+
+def crash_scenario(rate: float, seconds: float, replica: int = 1, seed: int = 7) -> Scenario:
+    """The crash-campaign proof: hold an open-loop offered rate against 3
+    replicas and SIGKILL one mid-run. The SLO demands zero failed
+    requests — in-flight requests to the killed replica must fail over to
+    survivors — and p99 within budget on the fleet that remains."""
+    return Scenario.from_dict(
+        {
+            "duration_s": seconds,
+            "template": "/probe/recommend/u%d",
+            "arrivals": {"process": "poisson", "rate": rate, "seed": seed},
+            "skew": {
+                "users": 2_000_000,
+                "exponent": 1.1,
+                "hot_count": 16,
+                "hot_weight": 0.2,
+                "seed": seed,
+            },
+            "slo": {"p99_ms": 1000.0, "error_rate": 0.0, "window_s": 5.0},
+            "actions": [{"at": seconds * 0.35, "do": "crash", "replica": replica}],
+        }
+    )
+
+
+def run_crash_campaign(
+    replicas: int,
+    rate: float,
+    seconds: float,
+    work_dir: str,
+    seed: int = 7,
+    recovery_budget_s: float = 30.0,
+) -> dict:
+    """3-replica open-loop run, one SIGKILL, recovery measured. Returns
+    the campaign report (also the bench.py crash-recovery row's input)."""
+    with ProcessFleet(replicas, work_dir) as fleet:
+        scenario = crash_scenario(rate, seconds, seed=seed)
+        result, verdict, runner = run_scenario(fleet, scenario)
+    s = result.summary()
+    recovery = [e["recovery_seconds"] for e in fleet.crash_events]
+    return {
+        "replicas": replicas,
+        "crashes": len(fleet.crash_events),
+        "recovery_seconds": recovery,
+        "recovery_budget_s": recovery_budget_s,
+        "recovery_within_budget": all(r <= recovery_budget_s for r in recovery),
+        "scenario_actions": [a.do for a in runner.executed],
+        "slo": {
+            "passed": verdict.passed,
+            "p99_ms": round(verdict.p99_ms, 2),
+            "error_rate": verdict.error_rate,
+            "violations": verdict.violations,
+        },
+        **s,
+    }
+
+
 def run_scenario(
     harness: FleetHarness,
     scenario: Scenario,
@@ -567,12 +851,45 @@ def main() -> int:
         action="store_true",
         help="run the predictive/reactive autoscaler during the scenario",
     )
+    ap.add_argument(
+        "--crash",
+        action="store_true",
+        help="crash campaign: subprocess replicas, one SIGKILL mid-run, "
+        "per-replica recovery-time measurement",
+    )
+    ap.add_argument(
+        "--recovery-budget",
+        type=float,
+        default=30.0,
+        help="crash campaign: max allowed SIGKILL->/readyz seconds",
+    )
+    ap.add_argument(
+        "--serve-replica",
+        metavar="SLOT_DIR",
+        default=None,
+        help="internal: run one subprocess serving replica in this slot",
+    )
     args = ap.parse_args()
+
+    if args.serve_replica:
+        return serve_replica(args.work_dir, args.serve_replica)
 
     import tempfile
 
     with tempfile.TemporaryDirectory() as tmp:
         work_dir = args.work_dir or tmp
+        if args.crash:
+            report = run_crash_campaign(
+                args.replicas, args.rate, args.seconds, work_dir,
+                seed=args.seed, recovery_budget_s=args.recovery_budget,
+            )
+            print(json.dumps(report, indent=2))
+            ok = (
+                report["slo"]["passed"]
+                and report["failed"] == 0
+                and report["recovery_within_budget"]
+            )
+            return 0 if ok else 1
         scenario = (
             Scenario.from_file(args.scenario)
             if args.scenario

@@ -20,7 +20,7 @@ sys.path.insert(0, str(REPO_ROOT))
 from oryx_tpu.analysis import metricscatalog as _impl  # noqa: E402
 from oryx_tpu.analysis.metricscatalog import (  # noqa: E402,F401
     DOC,
-    SOURCE_ROOT,
+    SOURCE_ROOTS,
     code_names,
     doc_names,
     tracing_knob_keys,
