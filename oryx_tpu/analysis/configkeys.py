@@ -17,6 +17,7 @@ Linted prefixes:
   oryx.ml.gate.online     — evidence-gated online promotion
   oryx.speed.parse        — native columnar input parse stage
   oryx.speed.pipeline     — three-stage speed-layer pipeline
+  oryx.tenancy            — multi-tenant lambda (oryx_tpu/tenancy/)
   oryx.tracing            — distributed tracer (common/tracing.py)
 """
 
@@ -44,6 +45,7 @@ LINTED_PREFIXES = (
     "oryx.serving.overload",
     "oryx.speed.parse",
     "oryx.speed.pipeline",
+    "oryx.tenancy",
     "oryx.tracing",
 )
 DEFAULT_TARGETS = [

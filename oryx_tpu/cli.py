@@ -35,10 +35,12 @@ log = logging.getLogger(__name__)
 COMMANDS = (
     "batch", "speed", "serving", "bus-setup", "bus-serve", "bus-tail",
     "bus-input", "config", "health", "models", "trace", "experiments", "lint",
-    "repair",
+    "repair", "tenants",
 )
 
 MODELS_SUBCOMMANDS = ("list", "show", "rollback", "gc")
+
+TENANTS_SUBCOMMANDS = ("list", "show")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -52,13 +54,14 @@ def _build_parser() -> argparse.ArgumentParser:
         nargs="?",
         default=None,
         help="models: list | show <generation> | rollback <generation> | gc; "
-        "trace: optional trace id to filter by",
+        "tenants: list | show <tenant>; trace: optional trace id to filter by",
     )
     p.add_argument(
         "generation",
         nargs="?",
         default=None,
-        help="models show/rollback: the generation id (a <timestampMs> dir name)",
+        help="models show/rollback: the generation id (a <timestampMs> dir "
+        "name); tenants show: the tenant id",
     )
     p.add_argument(
         "--conf",
@@ -276,6 +279,7 @@ def run_health(cfg: Config, out=None) -> int:
     ctx_path = cfg.get_string("oryx.serving.api.context-path").rstrip("/")
     ok = True
     live_generation = None
+    tenant_generations: dict | None = None
     for endpoint in ("/healthz", "/readyz"):
         url = f"{scheme}://localhost:{port}{ctx_path}{endpoint}"
         try:
@@ -294,6 +298,9 @@ def run_health(cfg: Config, out=None) -> int:
             detail = None
         if endpoint == "/healthz" and isinstance(detail, dict):
             live_generation = detail.get("live_generation")
+            tenants = detail.get("tenants")
+            if isinstance(tenants, dict):
+                tenant_generations = tenants
             # unified operator verdict (ok/degraded/draining/down) plus the
             # overload ladder's current rung when it is shedding quality
             unified = detail.get("status")
@@ -319,7 +326,105 @@ def run_health(cfg: Config, out=None) -> int:
                 ok = False
         else:
             print(f"generations: live={live_generation} champion={champion}", file=out)
+    # per-tenant skew: each tenant's live generation (from /healthz's
+    # tenants map) against that tenant's OWN registry champion — one
+    # lagging tenant is skew even when every other tenant is in sync
+    if tenant_generations is not None:
+        from oryx_tpu.registry.store import RegistryStore
+        from oryx_tpu.tenancy import TenantRegistry, tenant_config
+
+        registry = TenantRegistry.from_config(cfg)
+        for tid in sorted(tenant_generations):
+            live = tenant_generations[tid]
+            spec = registry.get(tid) if registry is not None else None
+            champion = None
+            if spec is not None:
+                tenant_model_dir = tenant_config(cfg, spec).get_optional_string(
+                    "oryx.batch.storage.model-dir"
+                )
+                if tenant_model_dir and os.path.isdir(tenant_model_dir):
+                    champion = RegistryStore(tenant_model_dir).champion_id()
+            if champion is None:
+                print(f"tenant {tid}: live={live}", file=out)
+            elif live == champion:
+                print(f"tenant {tid}: live={live} champion={champion} (in sync)", file=out)
+            else:
+                print(f"tenant {tid}: live={live} champion={champion} SKEW", file=out)
+                ok = False
     return 0 if ok else 1
+
+
+def run_tenants(cfg: Config, subcommand: str | None, tenant_id: str | None, out=None) -> int:
+    """Tenancy operator surface (docs/multi-tenancy.md):
+
+        tenants list          one line per declared tenant: app, weight,
+                              quota, SLO p99 (the fair-share inputs)
+        tenants show <id>     the tenant's full derived identity as JSON —
+                              namespaced topics, registry root, wired
+                              classes — plus its registry's champion when
+                              the model dir exists
+    """
+    import json
+
+    from oryx_tpu.tenancy import TenantRegistry, tenant_config
+
+    out = out or sys.stdout
+    registry = TenantRegistry.from_config(cfg)
+    if registry is None:
+        print("tenancy disabled (oryx.tenancy.enabled = false or no tenants declared)", file=out)
+        return 1
+    if subcommand not in TENANTS_SUBCOMMANDS:
+        raise SystemExit(
+            f"tenants requires a subcommand: {' | '.join(TENANTS_SUBCOMMANDS)}"
+        )
+
+    if subcommand == "list":
+        for spec in registry:
+            marker = " *default*" if spec.tenant_id == registry.default_tenant else ""
+            quota = f"{spec.quota_qps:g}qps" if spec.quota_qps else "-"
+            print(
+                f"{spec.tenant_id}\tapp={spec.app}\tweight={spec.weight:g}\t"
+                f"quota={quota}\tslo_p99={spec.slo_p99_ms:g}ms{marker}",
+                file=out,
+            )
+        return 0
+
+    if tenant_id is None:
+        raise SystemExit("tenants show requires a tenant id")
+    spec = registry.get(tenant_id)
+    if spec is None:
+        print(f"no such tenant {tenant_id!r} (declared: {', '.join(registry.ids())})", file=out)
+        return 1
+    tcfg = tenant_config(cfg, spec)
+    model_dir = tcfg.get_optional_string("oryx.batch.storage.model-dir")
+    view = {
+        "tenant": spec.tenant_id,
+        "app": spec.app,
+        "weight": spec.weight,
+        "quota_qps": spec.quota_qps,
+        "slo": {
+            "p99_ms": spec.slo_p99_ms,
+            "error_rate": spec.slo_error_rate,
+            "min_full_quality": spec.slo_min_full_quality,
+        },
+        "input_topic": tcfg.get_optional_string("oryx.input-topic.message.topic"),
+        "update_topic": tcfg.get_optional_string("oryx.update-topic.message.topic"),
+        "model_dir": model_dir,
+        "wiring": {
+            "update_class": spec.wiring("update-class"),
+            "speed_manager": spec.wiring("speed-manager"),
+            "serving_manager": spec.wiring("serving-manager"),
+            "resources": spec.resource_modules(),
+        },
+    }
+    if model_dir and os.path.isdir(model_dir):
+        from oryx_tpu.registry.store import RegistryStore
+
+        store = RegistryStore(model_dir)
+        view["champion"] = store.champion_id()
+        view["generations"] = store.list_generations()
+    print(json.dumps(view, indent=2), file=out)
+    return 0
 
 
 def run_lint(cfg: Config, out=None) -> int:
@@ -595,6 +700,8 @@ def main(argv: list[str] | None = None) -> int:
         return run_health(cfg)
     elif args.command == "models":
         return run_models(cfg, args.subcommand, args.generation)
+    elif args.command == "tenants":
+        return run_tenants(cfg, args.subcommand, args.generation)
     elif args.command == "trace":
         return run_trace(cfg, args.subcommand)
     elif args.command == "experiments":

@@ -52,6 +52,10 @@ SHED_STAGES = ("full", "reduced-probe", "stale", "shed")
 # Mirrors oryx_tpu.experiments.routing.ARM_HEADER the same way;
 # tests/experiments/test_routing.py asserts the two stay in sync.
 ARM_HEADER = "X-Oryx-Experiment-Arm"
+# Mirrors oryx_tpu.tenancy.context.TENANT_HEADER / TENANT_PATH_PREFIX
+# the same way; tests/tenancy/test_spec.py asserts they stay in sync.
+TENANT_HEADER = "X-Oryx-Tenant"
+TENANT_PATH_PREFIX = "/t/"
 
 
 def classify_error(exc: Exception) -> str:
@@ -107,6 +111,9 @@ class RequestRecord:
     # the user the request was issued for (arm-stickiness assertions
     # group records by user)
     user: int | None = None
+    # the tenant the request was issued for (per-tenant SLO verdicts
+    # group records by tenant); None on a single-tenant run
+    tenant: str | None = None
 
 
 @dataclass
@@ -129,6 +136,14 @@ class LoadResult:
     # connection-refused attempts that failed over to a surviving replica
     # (crash failover); nonzero during a SIGKILL campaign, not an error
     retried: int = 0
+
+    def tenant_records(self) -> dict[str, list[RequestRecord]]:
+        """Records grouped by tenant (tenanted runs only)."""
+        grouped: dict[str, list[RequestRecord]] = {}
+        for r in self.records:
+            if r.tenant is not None:
+                grouped.setdefault(r.tenant, []).append(r)
+        return grouped
 
     @property
     def offered_rate(self) -> float:
@@ -207,11 +222,27 @@ class OpenLoopEngine:
         readiness_poll_s: float = 0.2,
         on_response=None,
         connect_retries: int = 1,
+        tenant_mix: dict[str, float] | None = None,
+        tenant_templates: dict[str, str] | None = None,
+        tenant_seed: int = 0,
     ) -> None:
         if not targets:
             raise ValueError("need at least one target")
         self.targets = targets
         self.template = template
+        # per-tenant traffic mix: tenant -> weight. Each arrival draws a
+        # tenant (seeded, reproducible), routes under /t/<tenant>/ with
+        # the tenant's own path template, and stamps the tenant on its
+        # record so per-tenant SLOs are judged from the same run.
+        self.tenant_mix = dict(tenant_mix) if tenant_mix else None
+        self.tenant_templates = dict(tenant_templates or {})
+        self._tenant_dist = None  # (sorted items, total weight)
+        if self.tenant_mix:
+            import random
+
+            self._tenant_rng = random.Random(tenant_seed)
+            items = sorted(self.tenant_mix.items())
+            self._tenant_dist = (items, sum(w for _, w in items))
         self.max_inflight = int(max_inflight)
         self.timeout_s = float(timeout_s)
         self.readiness_poll_s = float(readiness_poll_s)
@@ -260,9 +291,44 @@ class OpenLoopEngine:
 
     # -- request execution ---------------------------------------------------
 
-    def _attempt(self, target: Target, user: int, ctx) -> tuple[bool, str, str, str | None]:
+    def set_tenant_mix(self, tenant_mix: dict[str, float]) -> None:
+        """Retune the per-tenant mix mid-run — how a scenario scripts a
+        noisy-neighbor burst on a tenanted fleet. Only valid on an engine
+        constructed with a tenant mix (the RNG is seeded there). The new
+        distribution is swapped in as one tuple, so the arrival thread
+        always reads a consistent (items, total) pair."""
+        if self._tenant_dist is None:
+            raise RuntimeError("engine was not constructed with a tenant mix")
+        self.tenant_mix = dict(tenant_mix)
+        items = sorted(self.tenant_mix.items())
+        self._tenant_dist = (items, sum(w for _, w in items))
+
+    def _pick_tenant(self) -> str | None:
+        """Weighted seeded tenant draw for one arrival (None = untenanted)."""
+        dist = self._tenant_dist
+        if dist is None:
+            return None
+        items, total = dist
+        r = self._tenant_rng.random() * total
+        acc = 0.0
+        for tid, w in items:
+            acc += w
+            if r < acc:
+                return tid
+        return items[-1][0]
+
+    def _attempt(
+        self, target: Target, user: int, ctx, tenant: str | None = None
+    ) -> tuple[bool, str, str, str | None]:
         """One HTTP attempt against one target: (ok, kind, shed_stage, arm)."""
-        path = self.template % user if "%d" in self.template else self.template
+        template = (
+            self.tenant_templates.get(tenant, self.template)
+            if tenant is not None
+            else self.template
+        )
+        path = template % user if "%d" in template else template
+        if tenant is not None:
+            path = f"{TENANT_PATH_PREFIX}{tenant}{path}"
         try:
             req = urllib.request.Request(target.base_url + path)
             if ctx is not None:
@@ -291,7 +357,14 @@ class OpenLoopEngine:
         except Exception as e:  # noqa: BLE001 - classified, not swallowed
             return False, classify_error(e), "full", None
 
-    def _execute(self, t_run0: float, t_sched: float, user: int, sink: list) -> None:
+    def _execute(
+        self,
+        t_run0: float,
+        t_sched: float,
+        user: int,
+        sink: list,
+        tenant: str | None = None,
+    ) -> None:
         t_send = time.perf_counter()
         t_wall0 = time.time()
         target = self._pick_target()
@@ -308,7 +381,7 @@ class OpenLoopEngine:
         else:
             retries = 0
             while True:
-                ok, kind, shed_stage, arm = self._attempt(target, user, ctx)
+                ok, kind, shed_stage, arm = self._attempt(target, user, ctx, tenant)
                 if kind != "connection" or retries >= self.connect_retries:
                     break
                 # a replica refusing connections is GONE (SIGKILLed, not
@@ -346,6 +419,7 @@ class OpenLoopEngine:
             shed_stage=shed_stage,
             arm=arm,
             user=user,
+            tenant=tenant,
         )
         with self._lock:
             sink.append(rec)
@@ -394,13 +468,14 @@ class OpenLoopEngine:
                 if delay > 0:
                     time.sleep(delay)
                 user = users.one()
+                tenant = self._pick_tenant()
                 with self._lock:
                     self._inflight += 1
                     if self._inflight > self.max_inflight:
                         queued += 1
                     self._peak_inflight = max(self._peak_inflight, self._inflight)
                 offered += 1
-                pool.submit(self._execute, t_run0, t_sched, user, records)
+                pool.submit(self._execute, t_run0, t_sched, user, records, tenant)
             pool.shutdown(wait=True)
         finally:
             self._stop.set()

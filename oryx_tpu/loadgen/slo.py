@@ -27,9 +27,17 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from collections import Counter
+
 from oryx_tpu.loadgen.engine import LoadResult
 
-__all__ = ["SLOSpec", "SLOVerdict", "burn_from_metrics", "evaluate_slo"]
+__all__ = [
+    "SLOSpec",
+    "SLOVerdict",
+    "burn_from_metrics",
+    "evaluate_slo",
+    "evaluate_tenant_slos",
+]
 
 
 @dataclass
@@ -116,6 +124,57 @@ def evaluate_slo(result: LoadResult, spec: SLOSpec) -> SLOVerdict:
         violations=violations,
         quality=quality,
     )
+
+
+def evaluate_tenant_slos(
+    result: LoadResult, specs: dict[str, SLOSpec]
+) -> dict[str, "SLOVerdict"]:
+    """Per-tenant verdicts over one multi-tenant open-loop run.
+
+    Each tenant's records are carved out of the shared run and judged
+    against the tenant's own declared SLO — the fairness contract
+    (docs/multi-tenancy.md) is exactly that a noisy neighbour's burst
+    must not flip a victim tenant's verdict. Tenants with a declared
+    spec but no records get a failing verdict (a tenant that was starved
+    out of the run entirely is the worst possible violation, not a
+    vacuous pass). Per-replica burn windows are fleet-scoped, not
+    tenant-scoped, so they are judged once in :func:`evaluate_slo`, not
+    here."""
+    grouped = result.tenant_records()
+    verdicts: dict[str, SLOVerdict] = {}
+    for tid, spec in specs.items():
+        recs = grouped.get(tid, [])
+        if not recs:
+            verdicts[tid] = SLOVerdict(
+                passed=False,
+                p99_ms=0.0,
+                error_rate=1.0,
+                failed_requests=0,
+                violations=[f"tenant {tid}: no completed requests in the run"],
+            )
+            continue
+        n_ok = sum(1 for r in recs if r.ok)
+        n_shed = sum(1 for r in recs if r.kind == "shed")
+        sub = LoadResult(
+            duration_s=result.duration_s,
+            offered=len(recs),
+            completed=len(recs),
+            ok=n_ok,
+            failed=len(recs) - n_ok - n_shed,
+            error_kinds=Counter(
+                r.kind for r in recs if not r.ok and r.kind != "shed"
+            ),
+            records=recs,
+            queued_arrivals=0,
+            peak_inflight=result.peak_inflight,
+            per_target={},  # replica burn is fleet-scoped; judged once
+            shed=n_shed,
+        )
+        verdict = evaluate_slo(sub, spec)
+        verdict.violations = [f"tenant {tid}: {v}" for v in verdict.violations]
+        verdict.passed = not verdict.violations
+        verdicts[tid] = verdict
+    return verdicts
 
 
 def burn_from_metrics(

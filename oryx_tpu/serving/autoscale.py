@@ -138,6 +138,11 @@ class AutoscaleSignals:
     queue_wait_ms: float  # worst batcher queue-wait EWMA across replicas
     burn_short: float  # latency burn rate over the short window
     burn_long: float  # latency burn rate over the long window
+    # per-tenant arrival-rate split on a multi-tenant fleet: published as
+    # fleet.autoscale.rate.tenant.<tenant> gauges so capacity dashboards
+    # attribute demand to tenants (sizing itself uses the aggregate rate
+    # — replicas host every tenant, so capacity is fungible across them)
+    tenant_rates: dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -234,6 +239,10 @@ class FleetAutoscaler:
         metrics.registry.gauge("fleet.autoscale.predicted-rate").set(
             self.last_predicted_rate
         )
+        for tid, tenant_rate in sig.tenant_rates.items():
+            metrics.registry.gauge(f"fleet.autoscale.rate.tenant.{tid}").set(
+                tenant_rate
+            )
         return count
 
     def _record(self, t: float, direction: str, reason: str) -> None:

@@ -57,10 +57,13 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from collections import deque
+
 from oryx_tpu.common import tracing
 from oryx_tpu.common.metrics import registry as _metrics
 from oryx_tpu.ops import topn as topn_ops
 from oryx_tpu.serving.overload import active_probe_fraction
+from oryx_tpu.tenancy.context import current_tenant
 
 log = logging.getLogger(__name__)
 
@@ -123,6 +126,10 @@ class _Entry:
     t_q: float = 0.0
     probe_fraction: float | None = None
     nprobe_applied: int | None = None
+    # multi-tenancy: the tenant identity snapshotted from the request
+    # thread's contextvar at enqueue — the DRR queue services per-tenant
+    # sub-queues by fair-share weight (docs/multi-tenancy.md)
+    tenant: str | None = None
 
 
 def _k_bucket(k: int) -> int:
@@ -173,6 +180,143 @@ def _record_entry_spans(e: _Entry, t_done: float) -> None:
     )
 
 
+class _FairQueue:
+    """Deficit-round-robin queue over per-tenant sub-queues.
+
+    Drop-in for the subset of :class:`queue.Queue` the batcher uses
+    (``put`` / ``get`` / ``get_nowait`` / ``qsize``), plus per-tenant
+    depth accounting for the admission controller. Entries without a
+    tenant ride a default sub-queue at weight 1.0, so with tenancy off
+    every entry lands there and service order is plain FIFO — the wired
+    -but-single-tenant overhead bench measures exactly this path.
+
+    Fairness semantics (docs/multi-tenancy.md): each tenant with queued
+    entries holds a credit; the queue serves the head tenant while its
+    credit lasts (one request costs 1), then rotates it to the tail with
+    a fresh quantum of ``quantum * weight`` credits. A hot tenant's
+    backlog therefore waits behind at most one quantum from each other
+    active tenant per rotation, bounding victim queue-wait regardless of
+    attacker depth.
+
+    The close sentinel (``put(None)``) is a flag, not a queued item:
+    ``get`` keeps draining real entries first and only yields ``None``
+    once every sub-queue is empty — the drain-then-stop contract the
+    dispatcher shutdown relies on.
+    """
+
+    _DEFAULT = ""  # sub-queue key for untenanted entries
+
+    def __init__(
+        self, weights: dict[str, float] | None = None, quantum: float = 8.0
+    ) -> None:
+        self._cv = threading.Condition()
+        self._weights = dict(weights or {})
+        self._quantum = max(1.0, float(quantum))
+        self._queues: dict[str, "deque[_Entry]"] = {}
+        self._rr: "deque[str]" = deque()  # tenants with queued entries
+        self._credit: dict[str, float] = {}
+        self._size = 0
+        self._sentinel = False
+
+    def _refill(self, key: str) -> float:
+        return max(1.0, self._quantum * self._weights.get(key, 1.0))
+
+    def put(self, e) -> None:
+        with self._cv:
+            if e is None:
+                self._sentinel = True
+                self._cv.notify_all()
+                return
+            key = e.tenant or self._DEFAULT
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = deque()
+            if not q:
+                self._rr.append(key)
+                self._credit[key] = self._refill(key)
+            q.append(e)
+            self._size += 1
+            self._cv.notify()
+
+    def _pop_locked(self):
+        while True:
+            key = self._rr[0]
+            q = self._queues[key]
+            if self._credit[key] >= 1.0:
+                self._credit[key] -= 1.0
+                e = q.popleft()
+                self._size -= 1
+                if not q:
+                    self._rr.popleft()  # re-enters the rotation on next put
+                return e
+            # credit spent: rotate to the tail with a fresh quantum
+            self._rr.rotate(-1)
+            self._credit[key] = self._refill(key)
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        with self._cv:
+            if not block:
+                if self._size:
+                    return self._pop_locked()
+                if self._sentinel:
+                    return None
+                raise queue.Empty
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while not self._size and not self._sentinel:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise queue.Empty
+                self._cv.wait(remaining)
+            if self._size:
+                return self._pop_locked()
+            return None  # sentinel, queues drained
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        with self._cv:
+            return self._size
+
+    def depth(self, tenant: str) -> int:
+        with self._cv:
+            q = self._queues.get(tenant)
+            return len(q) if q else 0
+
+    def tenant_depths(self) -> dict[str, int]:
+        """Queued entries per tenant (default sub-queue excluded) — the
+        admission controller's per-tenant pressure signal."""
+        with self._cv:
+            return {
+                k: len(q) for k, q in self._queues.items() if k and len(q)
+            }
+
+    def share_limit(self, tenant: str, max_queue: int) -> int:
+        """`tenant`'s slice of a bounded queue, by fair-share weight."""
+        weights = self._weights
+        total = sum(weights.values()) or 1.0
+        share = weights.get(tenant, 1.0) / max(total, weights.get(tenant, 1.0))
+        return max(1, int(max_queue * share))
+
+    def over_share(self, tenant: str, max_queue: int) -> bool:
+        """True when `tenant` has exhausted its weighted slice of the
+        bounded queue WHILE other tenants are queueing too. A lone
+        burster may use the whole queue — the per-tenant bound only
+        bites under contention, which is exactly when isolation matters."""
+        with self._cv:
+            q = self._queues.get(tenant)
+            if q is None or not q:
+                return False
+            contended = any(
+                k != tenant and len(other) for k, other in self._queues.items()
+            )
+            if not contended:
+                return False
+        return len(q) >= self.share_limit(tenant, max_queue)
+
+
 class TopNBatcher:
     """Coalesces concurrent ``score`` calls into batched ``submit_top_k``
     device calls. Thread-safe; one instance serves any number of models
@@ -188,6 +332,8 @@ class TopNBatcher:
         max_batch: int | None = None,
         max_inflight: int | None = None,
         max_queue: int | None = None,
+        tenant_weights: dict[str, float] | None = None,
+        fair_quantum: float = 8.0,
     ) -> None:
         # None => adaptive: the completer resizes the knob from its EWMA
         # of dispatch latency; an explicit value pins it (legacy behavior,
@@ -205,7 +351,9 @@ class TopNBatcher:
         # signal); guarded by _flight_cv like the dispatch EWMA
         self._queue_wait_ewma_ms = 0.0
         self._last_wait_obs = time.monotonic()
-        self._queue: queue.Queue[_Entry | None] = queue.Queue()
+        # DRR service across per-tenant sub-queues; FIFO-equivalent when
+        # every entry is untenanted (docs/multi-tenancy.md)
+        self._queue = _FairQueue(tenant_weights, fair_quantum)
         self._pending: queue.Queue = queue.Queue()
         # inflight tracked under a Condition (not a Semaphore) so the
         # adaptive cap can move while dispatches are blocked on it
@@ -252,9 +400,11 @@ class TopNBatcher:
             if ctx is not None and ctx.sampled:
                 e.trace_ctx = ctx
                 e.t_enqueue = time.time()
-        # snapshot the admission controller's reduced-probe override here,
-        # on the request thread that carries the contextvar
+        # snapshot the admission controller's reduced-probe override and
+        # the tenant identity here, on the request thread that carries
+        # both contextvars
         e.probe_fraction = active_probe_fraction()
+        e.tenant = current_tenant()
         e.t_q = time.monotonic()
         with self._state_lock:  # an entry can never land after the sentinel
             if self._closed:
@@ -265,6 +415,21 @@ class TopNBatcher:
                 _metrics.counter("serving.batcher.queue.rejected").inc()
                 raise BatcherOverloadedError(
                     f"batcher queue full ({self._max_queue} entries)"
+                )
+            if (
+                e.tenant is not None
+                and self._max_queue is not None
+                and self._queue.over_share(e.tenant, self._max_queue)
+            ):
+                # noisy-neighbor bound: under contention a tenant only
+                # gets its weighted slice of the bounded queue; alone it
+                # may still fill the whole thing
+                _metrics.counter("serving.batcher.queue.rejected").inc()
+                _metrics.counter(
+                    f"serving.batcher.queue.rejected.tenant.{e.tenant}"
+                ).inc()
+                raise BatcherOverloadedError(
+                    f"tenant {e.tenant} over fair queue share"
                 )
             self._queue.put(e)
             _metrics.gauge("serving.batcher.queue.depth").set(self._queue.qsize())
@@ -563,6 +728,18 @@ def configure_scheduler(
             LATENCY_BUDGET_MS = float(latency_budget_ms)
 
 
+def configure_fairness(
+    tenant_weights: dict[str, float] | None, quantum: float = 8.0
+) -> None:
+    """Pin the DRR fair-share weights for the process-wide batcher (the
+    serving layer maps ``oryx.tenancy.tenants.<id>.weight`` and
+    ``oryx.tenancy.fair-share.quantum`` here at startup). ``None``
+    weights keep tenancy-agnostic FIFO behavior."""
+    with _default_lock:
+        _default_init["tenant_weights"] = tenant_weights
+        _default_init["fair_quantum"] = quantum
+
+
 def default_batcher_signals() -> tuple[float, int]:
     """(queue_wait_ewma_ms, queue_depth) of the live default batcher, or
     zeros when none is running — the admission controller polls this on
@@ -573,6 +750,17 @@ def default_batcher_signals() -> tuple[float, int]:
     if b is None or b._closed:
         return 0.0, 0
     return b.queue_wait_ewma_ms(), b._queue.qsize()
+
+
+def default_tenant_depths() -> dict[str, int]:
+    """Per-tenant queued-entry counts of the live default batcher ({} when
+    none is running) — the per-tenant admission ladders poll this the same
+    way the global ladder polls :func:`default_batcher_signals`."""
+    with _default_lock:
+        b = _default
+    if b is None or b._closed:
+        return {}
+    return b._queue.tenant_depths()
 
 
 def get_default_batcher() -> TopNBatcher:

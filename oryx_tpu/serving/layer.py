@@ -39,6 +39,7 @@ from oryx_tpu.common.lang import load_instance_of
 from oryx_tpu.common.resilience import RetryPolicy, SupervisedThread
 from oryx_tpu.experiments import routing as _exp_routing
 from oryx_tpu.serving import overload as _overload
+from oryx_tpu.tenancy import context as _tenancy
 from oryx_tpu.serving.web import (
     OryxServingException,
     Request,
@@ -296,6 +297,12 @@ def _healthz(ctx: ServingContext, req: Request) -> Response:
         "live_generation": health.live_generation,
         "challenger_generation": health.challenger_generation,
     }
+    # multi-tenant serving: the model manager is a TenantServingMux and
+    # each tenant has its own live generation (cli health renders the
+    # per-tenant skew line from exactly this)
+    live_generations = getattr(ctx.model_manager, "live_generations", None)
+    if callable(live_generations):
+        body["tenants"] = live_generations()
     return Response(200 if health.alive else 503, body, content_type="application/json")
 
 
@@ -482,7 +489,9 @@ def _experiments_report(ctx: ServingContext, req: Request) -> Response:
     return Response(200, ctx.experiments.report(), content_type="application/json")
 
 
-def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
+def _observe_request(
+    method: str, status: int, t0: float, layer=None, tenant: str | None = None
+) -> None:
     dt = time.perf_counter() - t0
     metrics.registry.counter(f"serving.requests.{method}").inc()
     metrics.registry.counter(f"serving.responses.{status // 100}xx").inc()
@@ -504,6 +513,11 @@ def _observe_request(method: str, status: int, t0: float, layer=None) -> None:
     # per-arm comparison while an experiment runs) need the latency
     # distribution split the same way the request counter is
     im.histogram(f"serving.request.seconds.generation.{generation}").observe(dt)
+    if tenant is not None:
+        # tenant-labeled twins: per-tenant SLO burn and rate are computed
+        # from these on a shared multi-tenant fleet (docs/multi-tenancy.md)
+        im.counter(f"serving.requests.tenant.{tenant}").inc()
+        im.histogram(f"serving.request.seconds.tenant.{tenant}").observe(dt)
 
 
 def observe_block_freshness(raw_trace, instance_metrics=None):
@@ -537,10 +551,24 @@ def _model_ready(ctx: ServingContext) -> bool:
     manager = ctx.model_manager
     if manager is None:
         return False
+    min_fraction = ctx.config.get_float("oryx.serving.min-model-load-fraction")
+    tenant_models = getattr(manager, "tenant_models", None)
+    if tenant_models is not None:
+        # multi-tenant mux: the replica is ready when EVERY tenant's
+        # model is loaded past the threshold — readiness gates fleet
+        # rotation, and rotating onto a replica missing one tenant's
+        # model would 503 that tenant's traffic
+        models = tenant_models()
+        if not models:
+            return False
+        return all(
+            m is not None
+            and getattr(m, "get_fraction_loaded", lambda: 1.0)() >= min_fraction
+            for m in models.values()
+        )
     model = manager.get_model()
     if model is None:
         return False
-    min_fraction = ctx.config.get_float("oryx.serving.min-model-load-fraction")
     fraction = getattr(model, "get_fraction_loaded", lambda: 1.0)()
     return fraction >= min_fraction
 
@@ -587,13 +615,32 @@ class ServingLayer:
         self.model_manager_class = config.get_optional_string("oryx.serving.model-manager-class")
         self.app_resources = config.get_optional_strings("oryx.serving.application-resources")
 
+        # multi-tenant mode (docs/multi-tenancy.md): the oryx.tenancy
+        # block declares N tenants this one replica serves — None keeps
+        # the classic single-tenant wiring byte-for-byte
+        from oryx_tpu.tenancy.spec import TenantRegistry
+
+        self.tenants = TenantRegistry.from_config(config)
+        self.tenant_mux = None
+        if self.tenants is not None:
+            # one router hosts every tenant's app endpoints
+            merged = list(self.app_resources or [])
+            for mod in self.tenants.resource_modules():
+                if mod not in merged:
+                    merged.append(mod)
+            self.app_resources = merged
+
         # quantized pipelined scan engine: push oryx.serving.scan.* into
         # the micro-batcher scheduler and the scan kernels before either
         # compiles/spins up (jitted programs bake the knobs in at trace
         # time; the default batcher is created on first use)
         from oryx_tpu.ops.pallas_topn import configure_scan
-        from oryx_tpu.serving.batcher import configure_scheduler
+        from oryx_tpu.serving.batcher import configure_fairness, configure_scheduler
 
+        if self.tenants is not None and self.tenants.fair_share:
+            # DRR fair scheduling in the adaptive batcher: each tenant's
+            # entries drain from a private queue at its weighted share
+            configure_fairness(self.tenants.weights(), self.tenants.quantum)
         configure_scheduler(
             max_batch=config.get_optional_int("oryx.serving.scan.max-batch"),
             max_inflight=config.get_optional_int("oryx.serving.scan.max-inflight"),
@@ -712,6 +759,15 @@ class ServingLayer:
             if self.overload_config.enabled
             else None
         )
+        if self.admission is not None and self.tenants is not None:
+            from oryx_tpu.serving.batcher import default_tenant_depths
+
+            # per-tenant shed ladders: a noisy neighbor's own queue depth
+            # (vs its weighted share) walks its private ladder while the
+            # global one — every other tenant's floor — stays low
+            self.admission.configure_tenants(
+                self.tenants.weights(), default_tenant_depths
+            )
 
         self.router = Router()
         if self.app_resources:
@@ -744,7 +800,7 @@ class ServingLayer:
         update_broker_loc = cfg.get_optional_string("oryx.update-topic.broker")
         update_topic = cfg.get_optional_string("oryx.update-topic.message.topic")
 
-        if input_broker_loc and input_topic and not self.read_only:
+        if self.tenants is None and input_broker_loc and input_topic and not self.read_only:
             broker = get_broker(input_broker_loc)
             if not self.no_init_topics:
                 broker.create_topic(
@@ -762,7 +818,11 @@ class ServingLayer:
                 )
             self.experiments.start(broker.consumer(input_topic))
 
-        if self.model_manager_class:
+        if self.tenants is not None:
+            # multi-tenant wiring replaces the single manager/consumer
+            # pair with one runtime per tenant behind the mux facades
+            self._start_tenants(cfg, input_broker_loc, update_broker_loc)
+        elif self.model_manager_class:
             self.model_manager = load_instance_of(self.model_manager_class, cfg)
             if update_broker_loc and update_topic:
                 broker = get_broker(update_broker_loc)
@@ -932,6 +992,125 @@ class ServingLayer:
                                 )
                 self.health.mark_update()
 
+    # -- multi-tenant wiring (docs/multi-tenancy.md) ------------------------
+
+    def _start_tenants(self, cfg, input_broker_loc, update_broker_loc) -> None:
+        """One serving runtime per tenant — private model manager,
+        health, generation tracker, registry store, and a namespaced
+        update-topic consumer replaying from offset 0 — multiplexed
+        behind the single ``ServingContext`` surface the resource
+        handlers already use."""
+        from functools import partial
+
+        from oryx_tpu.registry.store import RegistryStore
+        from oryx_tpu.registry.tracking import GenerationTracker
+        from oryx_tpu.tenancy.mux import (
+            TenantInputMux,
+            TenantRuntime,
+            TenantServingMux,
+        )
+        from oryx_tpu.tenancy.spec import tenant_config
+
+        runtimes: dict[str, TenantRuntime] = {}
+        producers: dict = {}
+        for spec in self.tenants:
+            tid = spec.tenant_id
+            tcfg = tenant_config(cfg, spec)
+            manager = load_instance_of(spec.wiring("serving-manager"), tcfg)
+            health = ServingHealth()
+            tracker = GenerationTracker(health)
+            model_dir = tcfg.get_optional_string("oryx.batch.storage.model-dir")
+            rt = TenantRuntime(
+                spec,
+                tcfg,
+                manager,
+                health,
+                tracker,
+                store=RegistryStore(model_dir) if model_dir else None,
+            )
+            tenant_input = tcfg.get_optional_string("oryx.input-topic.message.topic")
+            if input_broker_loc and tenant_input and not self.read_only:
+                broker = get_broker(input_broker_loc)
+                if not self.no_init_topics:
+                    broker.create_topic(
+                        tenant_input,
+                        tcfg.get_optional_int("oryx.input-topic.message.partitions")
+                        or 1,
+                    )
+                rt.producer = broker.producer(tenant_input)
+                producers[tid] = rt.producer
+            tenant_update = tcfg.get_optional_string(
+                "oryx.update-topic.message.topic"
+            )
+            if update_broker_loc and tenant_update:
+                broker = get_broker(update_broker_loc)
+                if not self.no_init_topics:
+                    broker.create_topic(
+                        tenant_update,
+                        tcfg.get_optional_int("oryx.update-topic.message.partitions")
+                        or 1,
+                    )
+                rt.consumer = broker.consumer(tenant_update, from_beginning=True)
+                rt.thread = SupervisedThread(
+                    f"ServingUpdateConsumer-{tid}",
+                    partial(self._consume_tenant_updates, rt),
+                    self.retry_policy,
+                    self._stop_event,
+                    metrics_prefix="serving.consume",
+                )
+                health.consume_thread = rt.thread
+                rt.thread.start()
+            runtimes[tid] = rt
+        self.tenant_mux = TenantServingMux(runtimes, self.tenants.default_tenant)
+        self.model_manager = self.tenant_mux
+        if producers:
+            self.input_producer = TenantInputMux(
+                producers, self.tenants.default_tenant
+            )
+
+    def _consume_tenant_updates(self, rt) -> None:
+        rt.manager.consume_blocks(self._tenant_blocks(rt))
+
+    def _tenant_blocks(self, rt):
+        """The per-tenant twin of :meth:`_health_blocks`: same stream
+        health marks, duplicate-MODEL suppression, freshness accounting
+        and publish->apply span propagation, against the tenant's own
+        consumer/tracker/health — and every apply span carries the
+        tenant id."""
+        consumer = rt.consumer
+        while not self._stop_event.is_set() and not consumer.closed():
+            try:
+                block = consumer.poll_block(max_records=10_000, timeout=0.2)
+            except Exception:
+                rt.health.mark_stream_down()
+                raise
+            rt.health.mark_stream_ok()
+            raw_trace = getattr(block, "trace", None)
+            block = rt.tracker.filter_block(block)
+            if block is not None and len(block) > 0:
+                info = observe_block_freshness(raw_trace, self.instance_metrics)
+                if info is not None and info.ctx is not None:
+                    name = (
+                        "serving.model.apply"
+                        if _block_has_model(block)
+                        else "serving.apply"
+                    )
+                    with tracing.use(info.ctx):
+                        with tracing.span(
+                            name,
+                            attrs={
+                                "instance": self.port,
+                                "records": len(block),
+                                "tenant": rt.spec.tenant_id,
+                            },
+                        ) as sp:
+                            yield block
+                            if rt.health.live_generation is not None:
+                                sp.set("generation", rt.health.live_generation)
+                else:
+                    yield block
+                rt.health.mark_update()
+
     def await_termination(self, timeout: float | None = None) -> None:
         if self._server_thread is not None:
             self._server_thread.join(timeout)
@@ -1015,6 +1194,23 @@ class ServingLayer:
                     self._consume_thread.name,
                 )
                 metrics.registry.counter("layer.threads.leaked").inc()
+        if self.tenant_mux is not None:
+            # close every tenant consumer first (unblocks the polls),
+            # then join the consume threads
+            runtimes = self.tenant_mux.runtimes()
+            for rt in runtimes.values():
+                if rt.consumer is not None:
+                    rt.consumer.close()
+            for rt in runtimes.values():
+                if rt.thread is not None:
+                    rt.thread.join(timeout=5)
+                    if rt.thread.is_alive():
+                        log.warning(
+                            "serving thread %r still alive after 5s join; "
+                            "leaking it",
+                            rt.thread.name,
+                        )
+                        metrics.registry.counter("layer.threads.leaked").inc()
         if self.model_manager is not None:
             self.model_manager.close()
         if self.experiments is not None:
@@ -1099,10 +1295,22 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
                 return layer.router.dispatch(ctx, req)
         return layer.router.dispatch(ctx, req)
 
+    tenant = _tenancy.current_tenant()
     admission = layer.admission
     decision = (
-        admission.decide(req.method, req.path) if admission is not None else None
+        admission.decide(req.method, req.path, tenant=tenant)
+        if admission is not None
+        else None
     )
+
+    def _champion_generation():
+        # the generation stale-cache entries are stamped with / validated
+        # against: the tenant's own champion on a multi-tenant fleet
+        # (each tenant has a private lineage), the tracker's otherwise
+        if tenant is not None and layer.tenant_mux is not None:
+            rt = layer.tenant_mux.runtime(tenant)
+            return rt.health.live_generation if rt is not None else None
+        return admission.generation() if admission is not None else None
     served = None  # stage name actually used; None = full quality
     response = None
     if decision is not None and decision.stage >= _overload.STAGE_SHED:
@@ -1113,7 +1321,7 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
         and decision.stage >= _overload.STAGE_STALE
         and req.method == "GET"
     ):
-        cached = admission.cache.get(cache_key, admission.generation())
+        cached = admission.cache.get(cache_key, _champion_generation())
         if cached is not None:
             served = "stale"
             response = Response(cached.status, cached.payload, cached.content_type)
@@ -1143,7 +1351,7 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
                 and decision.stage == _overload.STAGE_FULL
                 and req.method == "GET"
                 and getattr(response, "status", 200) == 200
-                and admission.generation() is not None
+                and _champion_generation() is not None
                 # challenger answers must never enter the stale cache:
                 # it is stamped with the champion generation
                 and (assignment is None or assignment[0] != _exp_routing.ARM_CHALLENGER)
@@ -1153,7 +1361,7 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
                 admission.cache.put(
                     cache_key,
                     _overload.CachedAnswer(
-                        admission.generation(),
+                        _champion_generation(),
                         response.status,
                         response.body,
                         response.content_type,
@@ -1164,8 +1372,11 @@ def _admit_and_route(layer: ServingLayer, ctx: ServingContext, req, cache_key, s
             served,
             layer.instance_metrics,
             generation=(
-                assignment[1] if assignment is not None else layer.health.live_generation
+                assignment[1]
+                if assignment is not None
+                else (_champion_generation() or layer.health.live_generation)
             ),
+            tenant=tenant,
         )
         headers = getattr(response, "headers", None)
         if headers is not None:
@@ -1238,15 +1449,21 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
             try:
                 status, payload, ct, extra = self._dispatch(method)
             except OryxServingException as e:
-                _observe_request(method, e.status, t0, layer)
+                _observe_request(
+                    method, e.status, t0, layer, getattr(self, "_tenant", None)
+                )
                 self._send_error(e.status, e.message)
                 return
             except Exception:
                 log.exception("internal error handling %s %s", method, self.path)
-                _observe_request(method, 500, t0, layer)
+                _observe_request(
+                    method, 500, t0, layer, getattr(self, "_tenant", None)
+                )
                 self._send_error(500, "internal error")
                 return
-            _observe_request(method, status, t0, layer)
+            _observe_request(
+                method, status, t0, layer, getattr(self, "_tenant", None)
+            )
             body = payload
             headers = dict(extra)
             if len(body) > 1024 and "gzip" in self.headers.get("Accept-Encoding", ""):
@@ -1262,6 +1479,7 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 self.wfile.write(body)
 
         def _dispatch(self, method: str):
+            self._tenant = None
             if not self._authorized():
                 raise OryxServingException(401, "unauthorized")
             split = urlsplit(self.path)
@@ -1270,6 +1488,22 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 if not path.startswith(layer.context_path):
                     raise OryxServingException(404, "outside context path")
                 path = path[len(layer.context_path) :] or "/"
+            # tenant resolution (docs/multi-tenancy.md): the /t/<tenant>/
+            # prefix wins over the X-Oryx-Tenant header; untenanted
+            # data-plane requests fall to the default tenant. Resolved
+            # before routing so the stripped path matches the resources,
+            # and scoped over the dispatch so the batcher / admission /
+            # mux all see it.
+            tenant = None
+            if layer.tenants is not None:
+                tenant, path = _tenancy.split_tenant_path(path)
+                if tenant is None:
+                    tenant = self.headers.get(_tenancy.TENANT_HEADER)
+                if tenant is None and not _overload.exempt(path):
+                    tenant = layer.tenants.default_tenant
+                if tenant is not None and tenant not in layer.tenants:
+                    raise OryxServingException(404, f"unknown tenant {tenant!r}")
+                self._tenant = tenant
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
             if self.headers.get("Content-Encoding") == "gzip":
@@ -1284,29 +1518,34 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
                 body=body,
             )
             # answer-cache key: path + raw query, i.e. the full request
-            # identity for the GET data plane the stale rung serves
+            # identity for the GET data plane the stale rung serves — the
+            # tenant rides in front so two tenants' answers for the same
+            # path can never alias in the cache
             cache_key = path + ("?" + split.query if split.query else "")
+            if tenant is not None:
+                cache_key = f"/t/{tenant}{cache_key}"
+            attrs = {"path": path, "method": req.method}
+            if tenant is not None:
+                attrs["tenant"] = tenant
             # request-lifecycle span: a sampled incoming traceparent is
             # honored (the loadgen client's span becomes this span's
             # parent, joined by trace id); header-less requests roll the
             # root sampling dice. Untraced requests skip all of it.
             incoming = tracing.parse_traceparent(self.headers.get("traceparent"))
-            if incoming is not None and incoming.sampled:
-                with tracing.use(incoming):
+            with _tenancy.tenant_scope(tenant):
+                if incoming is not None and incoming.sampled:
+                    with tracing.use(incoming):
+                        with tracing.span("serving.request", attrs=attrs) as sp:
+                            response = _admit_and_route(
+                                layer, ctx, req, cache_key, sp
+                            )
+                            sp.set("status", getattr(response, "status", 200))
+                else:
                     with tracing.span(
-                        "serving.request",
-                        attrs={"path": path, "method": req.method},
+                        "serving.request", attrs=attrs, root=True
                     ) as sp:
                         response = _admit_and_route(layer, ctx, req, cache_key, sp)
                         sp.set("status", getattr(response, "status", 200))
-            else:
-                with tracing.span(
-                    "serving.request",
-                    attrs={"path": path, "method": req.method},
-                    root=True,
-                ) as sp:
-                    response = _admit_and_route(layer, ctx, req, cache_key, sp)
-                    sp.set("status", getattr(response, "status", 200))
             return render(response, self.headers.get("Accept", "application/json"))
 
         def _authorized(self) -> bool:

@@ -150,19 +150,25 @@ class OverloadConfig:
 _SHED_COUNTER_PREFIX = "serving.overload.shed."
 
 
-def count_shed(stage_name: str, instance_metrics=None, generation=None) -> None:
+def count_shed(
+    stage_name: str, instance_metrics=None, generation=None, tenant=None
+) -> None:
     """Count one answer served below full quality at `stage_name`.
 
     When the generation that would have served the request is known, a
     generation-labeled twin is counted alongside, so per-generation (and
     per-experiment-arm) dashboards see *which* model's traffic was
-    degraded."""
+    degraded; likewise a tenant-labeled twin
+    (``serving.overload.shed.<stage>.tenant.<tenant>``) attributes the
+    degradation to the tenant that absorbed it."""
     name = _SHED_COUNTER_PREFIX + stage_name
     metrics.registry.counter(name).inc()
     if instance_metrics is not None:
         instance_metrics.counter(name).inc()
         if generation is not None:
             instance_metrics.counter(f"{name}.generation.{generation}").inc()
+        if tenant is not None:
+            instance_metrics.counter(f"{name}.tenant.{tenant}").inc()
 
 
 # -- stale-answer cache ------------------------------------------------------
@@ -223,6 +229,21 @@ class AnswerCache:
 # -- admission controller ----------------------------------------------------
 
 
+@dataclass
+class _TenantLadder:
+    """One tenant's private shed ladder (same control law, scoped signal).
+
+    A tenant's pressure is its *own* queue depth against its *weighted
+    share* of the bounded queue, so a noisy neighbor climbs its ladder —
+    and gets shed — while the global ladder (which all tenants inherit as
+    a floor) stays low and victims keep full quality."""
+
+    weight: float = 1.0
+    stage: int = STAGE_FULL
+    pressure: float = 0.0
+    last_move: float = -float("inf")
+
+
 @dataclass(frozen=True)
 class Decision:
     """One admission decision: the stage to serve the request at."""
@@ -269,6 +290,26 @@ class AdmissionController:
         self._last_eval = -float("inf")
         self._last_move = -float("inf")
         self.transitions: list[tuple[float, int, int, float]] = []
+        # per-tenant ladders (configure_tenants); empty = tenancy off
+        self._tenants: dict[str, _TenantLadder] = {}
+        self._tenant_depths: Callable[[], dict[str, int]] | None = None
+
+    def configure_tenants(
+        self,
+        weights: dict[str, float],
+        depths_fn: Callable[[], dict[str, int]],
+    ) -> None:
+        """Attach per-tenant shed ladders (serving layer, at startup).
+
+        `depths_fn` returns the batcher's per-tenant queued-entry counts;
+        each tenant's ladder normalises its own depth against its weighted
+        share of ``max-queue`` and walks the same hysteresis rungs as the
+        global ladder."""
+        with self._lock:
+            self._tenants = {
+                tid: _TenantLadder(weight=w) for tid, w in weights.items()
+            }
+            self._tenant_depths = depths_fn
 
     # -- signal plumbing --
 
@@ -317,6 +358,8 @@ class AdmissionController:
                     <= self.cfg.engage_threshold(stage) * self.cfg.release_fraction
                 ):
                     self._move(stage - 1, t)
+            if self._tenants:
+                self._evaluate_tenants(t)
             metrics.registry.gauge("serving.overload.stage").set(self._stage)
             metrics.registry.gauge("serving.overload.pressure").set(self._pressure)
             if self._instance_metrics is not None:
@@ -326,6 +369,49 @@ class AdmissionController:
                 )
             return self._stage
 
+    def _evaluate_tenants(self, t: float) -> None:
+        """Walk each tenant ladder one step (caller holds the lock)."""
+        depths = self._tenant_depths() if self._tenant_depths else {}
+        total_weight = sum(l.weight for l in self._tenants.values())
+        max_queue = self.cfg.max_queue
+        for tid, ladder in self._tenants.items():
+            if not max_queue:
+                break  # unbounded queue: per-tenant shares are undefined
+            share = max(1.0, max_queue * ladder.weight / max(total_weight, 1e-9))
+            raw = depths.get(tid, 0) / share
+            a = self.cfg.alpha
+            ladder.pressure = a * raw + (1.0 - a) * ladder.pressure
+            if t - ladder.last_move >= self.cfg.hold_s:
+                if (
+                    ladder.stage < STAGE_SHED
+                    and ladder.pressure
+                    >= self.cfg.engage_threshold(ladder.stage + 1)
+                ):
+                    ladder.stage += 1
+                    ladder.last_move = t
+                elif (
+                    ladder.stage > STAGE_FULL
+                    and ladder.pressure
+                    <= self.cfg.engage_threshold(ladder.stage)
+                    * self.cfg.release_fraction
+                ):
+                    ladder.stage -= 1
+                    ladder.last_move = t
+            if self._instance_metrics is not None:
+                self._instance_metrics.gauge(
+                    f"serving.overload.stage.tenant.{tid}"
+                ).set(ladder.stage)
+                self._instance_metrics.gauge(
+                    f"serving.overload.pressure.tenant.{tid}"
+                ).set(ladder.pressure)
+
+    def tenant_stage(self, tenant: str | None) -> int:
+        """The tenant's own ladder stage (STAGE_FULL when untracked)."""
+        if tenant is None:
+            return STAGE_FULL
+        ladder = self._tenants.get(tenant)
+        return ladder.stage if ladder is not None else STAGE_FULL
+
     def _move(self, to_stage: int, t: float) -> None:
         self.transitions.append((t, self._stage, to_stage, self._pressure))
         self._stage = to_stage
@@ -334,14 +420,20 @@ class AdmissionController:
         if self._instance_metrics is not None:
             self._instance_metrics.counter("serving.overload.transitions").inc()
 
-    def decide(self, method: str, path: str) -> Decision | None:
-        """Admission decision for one request; None = exempt, serve normally."""
+    def decide(
+        self, method: str, path: str, tenant: str | None = None
+    ) -> Decision | None:
+        """Admission decision for one request; None = exempt, serve normally.
+
+        With tenancy on, the effective stage is the *max* of the global
+        ladder and the tenant's own — global pressure degrades everyone,
+        a noisy neighbor additionally degrades only itself."""
         if exempt(path):
             return None
         t = self._clock()
         if t - self._last_eval >= self.cfg.control_interval_ms / 1000.0:
             self.evaluate(t)
-        stage = self._stage
+        stage = max(self._stage, self.tenant_stage(tenant))
         if stage == STAGE_FULL:
             return Decision(STAGE_FULL)
         if stage == STAGE_REDUCED_PROBE:

@@ -171,3 +171,9 @@ def pytest_configure(config):
         "arm routing, interleaved evaluation joins, evidence-gated "
         "promotion); fast and tier-1-safe, select with -m experiments",
     )
+    config.addinivalue_line(
+        "markers",
+        "tenancy: multi-tenant lambda tests (tenant spec parsing, DRR "
+        "fairness, three packaged apps sharing one fleet); tier-1-safe, "
+        "select with -m tenancy",
+    )

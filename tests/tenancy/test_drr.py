@@ -1,0 +1,100 @@
+"""Deficit-round-robin fairness in the batcher's _FairQueue."""
+
+import queue
+
+import pytest
+
+from oryx_tpu.serving.batcher import _Entry, _FairQueue
+
+
+def entry(tenant=None):
+    e = _Entry(uploaded=None, query=None, k=1, cosine=False)
+    e.tenant = tenant
+    return e
+
+
+def drain_order(q, n):
+    order = []
+    for _ in range(n):
+        order.append(q.get_nowait().tenant)
+    return order
+
+
+class TestFifoCompat:
+    def test_untenanted_entries_are_fifo(self):
+        q = _FairQueue()
+        entries = [entry() for _ in range(5)]
+        for e in entries:
+            q.put(e)
+        assert [q.get_nowait() for _ in range(5)] == entries
+        with pytest.raises(queue.Empty):
+            q.get_nowait()
+
+    def test_sentinel_drains_then_stops(self):
+        q = _FairQueue()
+        q.put(entry("a"))
+        q.put(None)  # close flag, not a queued item
+        q.put(entry("b"))
+        got = [q.get_nowait(), q.get_nowait()]
+        assert {e.tenant for e in got} == {"a", "b"}
+        assert q.get_nowait() is None  # only after the real entries
+        assert q.get(timeout=0.1) is None  # sentinel is sticky
+
+    def test_qsize_and_depths(self):
+        q = _FairQueue()
+        for t in ("a", "a", "b", None):
+            q.put(entry(t))
+        assert q.qsize() == 4
+        assert q.depth("a") == 2 and q.depth("b") == 1
+        # default sub-queue excluded from the admission pressure signal
+        assert q.tenant_depths() == {"a": 2, "b": 1}
+
+
+class TestFairness:
+    def test_equal_weights_interleave_under_skew(self):
+        """1000 queued entries from the attacker vs 10 from the victim:
+        the victim's entries are all served within the first few DRR
+        rotations, never behind the attacker's whole backlog."""
+        q = _FairQueue(weights={"noisy": 1.0, "victim": 1.0}, quantum=8)
+        for _ in range(1000):
+            q.put(entry("noisy"))
+        for _ in range(10):
+            q.put(entry("victim"))
+        order = drain_order(q, 200)
+        last_victim = max(i for i, t in enumerate(order) if t == "victim")
+        assert order.count("victim") == 10
+        # 10 victim entries need ceil(10/8)=2 victim quanta; with one
+        # 8-credit attacker quantum between them the worst case is ~26
+        assert last_victim < 40
+
+    def test_weights_skew_service_ratio(self):
+        q = _FairQueue(weights={"gold": 3.0, "bronze": 1.0}, quantum=8)
+        for _ in range(600):
+            q.put(entry("gold"))
+            q.put(entry("bronze"))
+        order = drain_order(q, 400)
+        gold = order.count("gold")
+        bronze = order.count("bronze")
+        # 3:1 credit refill -> ~3:1 service while both stay backlogged
+        assert gold / bronze == pytest.approx(3.0, rel=0.15)
+
+    def test_idle_tenant_costs_nothing(self):
+        """A tenant with no backlog is out of the rotation entirely — DRR
+        only arbitrates between tenants that actually have entries."""
+        q = _FairQueue(weights={"a": 1.0, "idle": 100.0}, quantum=8)
+        for _ in range(20):
+            q.put(entry("a"))
+        assert drain_order(q, 20) == ["a"] * 20
+
+    def test_share_limit_and_over_share(self):
+        q = _FairQueue(weights={"a": 1.0, "b": 3.0}, quantum=8)
+        assert q.share_limit("a", 100) == 25
+        assert q.share_limit("b", 100) == 75
+        # a lone burster may use the whole queue
+        for _ in range(30):
+            q.put(entry("a"))
+        assert not q.over_share("a", 100)
+        # contention bites: one queued entry from b arms the bound
+        q.put(entry("b"))
+        assert q.over_share("a", 100)
+        assert not q.over_share("b", 100)

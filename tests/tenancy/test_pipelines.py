@@ -1,0 +1,210 @@
+"""All three packaged apps live on one shared fleet — the tentpole
+acceptance: ALS, k-means and RDF as tenants of ONE process group, each
+training in its own batch pipeline, publishing on its own namespaced
+update topic, and serving from ONE ServingLayer that multiplexes the
+three models behind /t/<tenant>/ prefixes."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.common import metrics
+from oryx_tpu.serving.layer import ServingLayer
+from oryx_tpu.tenancy import TenantRegistry
+from oryx_tpu.tenancy.pipelines import TenantPipelines
+
+pytestmark = pytest.mark.tenancy
+
+
+def make_config(tmp_path, broker_loc):
+    """One base config, three tenants: the app-specific schema and
+    hyperparameters ride each tenant's config block."""
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          id = "MT"
+          input-topic.broker = "{broker_loc}"
+          update-topic.broker = "{broker_loc}"
+          batch {{
+            streaming.generation-interval-sec = 3600
+            storage {{ data-dir = "{tmp_path}/data/"
+                      model-dir = "{tmp_path}/model/" }}
+          }}
+          serving.api.port = 0
+          ml.eval {{ candidates = 1, test-fraction = 0 }}
+          tenancy {{
+            enabled = true
+            tenants {{
+              movies = {{
+                app = als
+                weight = 2
+                config {{
+                  oryx.als {{
+                    implicit = true
+                    iterations = 4
+                    hyperparams {{ features = 4, lambda = 0.01, alpha = 2.0 }}
+                  }}
+                }}
+              }}
+              sensors = {{
+                app = kmeans
+                config {{
+                  oryx {{
+                    input-schema {{ num-features = 2
+                                    numeric-features = ["0", "1"] }}
+                    kmeans.hyperparams.k = 3
+                  }}
+                }}
+              }}
+              churn = {{
+                app = rdf
+                config {{
+                  oryx {{
+                    input-schema {{ num-features = 3
+                                    numeric-features = ["0", "1"]
+                                    target-feature = "2" }}
+                    rdf {{ num-trees = 5
+                           hyperparams {{ max-depth = 4, impurity = "entropy" }} }}
+                  }}
+                }}
+              }}
+            }}
+          }}
+        }}
+        """
+    )
+
+
+def http(method, url, body=None):
+    req = urllib.request.Request(url, data=body, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def wait_for(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def als_lines():
+    gen = np.random.default_rng(0)
+    lines, ts = [], 0
+    for u in range(12):
+        for i in range(8):
+            if ((u < 6) == (i < 4)) or gen.random() < 0.2:
+                ts += 1
+                lines.append(f"u{u},i{i},{1.0 + 2.0 * gen.random():.2f},{ts}")
+    return "\n".join(lines)
+
+
+def kmeans_lines():
+    gen = np.random.default_rng(4)
+    centers = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 10.0]])
+    lines = []
+    for c in centers:
+        for _ in range(40):
+            p = c + 0.5 * gen.standard_normal(2)
+            lines.append(f"{p[0]:.3f},{p[1]:.3f}")
+    return "\n".join(lines)
+
+
+def rdf_lines():
+    gen = np.random.default_rng(8)
+    lines = []
+    for _ in range(150):
+        x = float(gen.uniform(-5, 5))
+        y = float(gen.uniform(-5, 5))
+        lines.append(f"{x:.3f},{y:.3f},{'pos' if x > 0 else 'neg'}")
+    return "\n".join(lines)
+
+
+def test_three_apps_one_fleet(tmp_path):
+    broker_loc = "inproc://mt-pipelines"
+    cfg = make_config(tmp_path, broker_loc)
+    tenants = TenantRegistry.from_config(cfg)
+    assert tenants is not None and tenants.ids() == ["churn", "movies", "sensors"]
+
+    serving = ServingLayer(cfg)
+    serving.start()
+    base = f"http://127.0.0.1:{serving.port}"
+    batch = TenantPipelines(cfg, tenants, "batch")
+    try:
+        # one serving replica hosts all three tenants' runtimes
+        assert serving.tenant_mux is not None
+        assert sorted(serving.tenant_mux.ids()) == ["churn", "movies", "sensors"]
+
+        # 1. the batch pipelines subscribe first (the input consumer
+        # tails from its subscription point), then ingest flows through
+        # the shared serving edge, tenant-prefixed: each app's ingest
+        # endpoint routes to THAT tenant's input topic
+        batch.start()
+        status, _ = http("POST", f"{base}/t/movies/ingest", als_lines().encode())
+        assert status == 204
+        status, _ = http("POST", f"{base}/t/sensors/add", kmeans_lines().encode())
+        assert status == 204
+        status, _ = http("POST", f"{base}/t/churn/train", rdf_lines().encode())
+        assert status == 204
+
+        # unknown tenants are rejected at the edge, not mis-served
+        status, _ = http("GET", f"{base}/t/nope/recommend/u0")
+        assert status == 404
+
+        # 2. all three tenants train in one process: one round = one
+        # generation each, private lineage per tenant
+        done = batch.run_round()
+        assert done == {"churn": 1, "movies": 1, "sensors": 1}
+        counts = batch.generation_counts()
+        assert all(c == 1 for c in counts.values()), counts
+        for tid in ("movies", "sensors", "churn"):
+            gens = list((tmp_path / "model" / tid).iterdir())
+            models = [g for g in gens if (g / "model.pmml").exists()]
+            assert models, f"tenant {tid} published no generation"
+            assert metrics.registry.counter(
+                f"batch.generations.tenant.{tid}"
+            ).value == 1
+
+        # 3. the one serving fleet loads every tenant's model; readiness
+        # requires ALL tenants (a replica missing one tenant's model
+        # would 503 that tenant after rotation)
+        assert wait_for(lambda: http("GET", f"{base}/ready")[0] == 200)
+
+        # 4. each tenant answers from its OWN model on the shared port
+        status, body = http("GET", f"{base}/t/movies/recommend/u0")
+        assert status == 200 and json.loads(body)
+        a0 = json.loads(http("GET", f"{base}/t/sensors/assign/0.1,0.2")[1])
+        a1 = json.loads(http("GET", f"{base}/t/sensors/assign/9.8,10.1")[1])
+        assert json.dumps(a0) != json.dumps(a1)
+        assert json.loads(http("GET", f"{base}/t/churn/predict/3.5,0.0,")[1]) == "pos"
+        assert json.loads(http("GET", f"{base}/t/churn/predict/-3.5,0.0,")[1]) == "neg"
+
+        # the header form routes identically to the path prefix
+        req = urllib.request.Request(
+            f"{base}/predict/3.5,0.0,", headers={"X-Oryx-Tenant": "churn"}
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read()) == "pos"
+
+        # 5. per-tenant observability: /healthz names every tenant's live
+        # generation; request counters carry the tenant label
+        _, hz = http("GET", f"{base}/healthz")
+        tenant_gens = json.loads(hz)["tenants"]
+        assert sorted(tenant_gens) == ["churn", "movies", "sensors"]
+        assert all(gen is not None for gen in tenant_gens.values()), tenant_gens
+        snap = serving.instance_metrics.snapshot()
+        for tid in ("movies", "sensors", "churn"):
+            assert snap.get(f"serving.requests.tenant.{tid}", {}).get("value", 0) > 0
+    finally:
+        serving.close()
+        batch.close()

@@ -1,0 +1,165 @@
+"""TenantSpec / TenantRegistry parsing, validation and namespacing."""
+
+import pytest
+
+from oryx_tpu.common import config as C
+from oryx_tpu.tenancy import (
+    APP_WIRING,
+    TENANT_HEADER,
+    TENANT_PATH_PREFIX,
+    TenantRegistry,
+    TenantSpec,
+    namespaced,
+    split_tenant_path,
+    tenant_config,
+)
+
+
+def make_config(extra: str = ""):
+    return C.get_default().with_overlay(
+        f"""
+        oryx.tenancy = {{
+          enabled = true
+          tenants = {{
+            movies  = {{ app = als, weight = 2 }}
+            sensors = {{ app = kmeans, slo = {{ p99-ms = 250 }} }}
+            churn   = {{ app = rdf, quota-qps = 50 }}
+          }}
+          {extra}
+        }}
+        """
+    )
+
+
+class TestParsing:
+    def test_registry_from_config(self):
+        reg = TenantRegistry.from_config(make_config())
+        assert reg is not None and len(reg) == 3
+        assert reg.ids() == ["churn", "movies", "sensors"]  # sorted
+        assert reg.require("movies").weight == 2.0
+        assert reg.require("sensors").slo_p99_ms == 250.0
+        assert reg.require("churn").quota_qps == 50.0
+        # undeclared knobs default
+        assert reg.require("movies").slo_p99_ms == 500.0
+        assert reg.fair_share and reg.quantum == 8.0
+
+    def test_disabled_or_empty_is_none(self):
+        assert TenantRegistry.from_config(C.get_default()) is None
+        cfg = C.get_default().with_overlay(
+            "oryx.tenancy { enabled = true, tenants = {} }"
+        )
+        assert TenantRegistry.from_config(cfg) is None
+        cfg = make_config().with_overlay("oryx.tenancy.enabled = false")
+        assert TenantRegistry.from_config(cfg) is None
+
+    def test_default_tenant_must_be_declared(self):
+        reg = TenantRegistry.from_config(
+            make_config("default-tenant = movies")
+        )
+        assert reg.default_tenant == "movies"
+        with pytest.raises(ValueError, match="default-tenant"):
+            TenantRegistry.from_config(make_config("default-tenant = nope"))
+
+    def test_invalid_ids_and_apps_rejected(self):
+        with pytest.raises(ValueError, match="invalid tenant id"):
+            TenantSpec(tenant_id="a.b", app="als")
+        with pytest.raises(ValueError, match="invalid tenant id"):
+            TenantSpec(tenant_id="a/b", app="als")
+        with pytest.raises(ValueError, match="unknown app"):
+            TenantSpec(tenant_id="ok", app="resnet")
+        with pytest.raises(ValueError, match="weight"):
+            TenantSpec(tenant_id="ok", app="als", weight=0)
+
+    def test_slo_spec_contract(self):
+        spec = TenantSpec(tenant_id="t", app="als", slo_p99_ms=123.0)
+        slo = spec.slo_spec()
+        assert slo.p99_ms == 123.0 and slo.error_rate == 0.0
+
+    def test_weights_and_slo_specs_maps(self):
+        reg = TenantRegistry.from_config(make_config())
+        assert reg.weights() == {"movies": 2.0, "sensors": 1.0, "churn": 1.0}
+        assert reg.slo_specs()["sensors"].p99_ms == 250.0
+
+
+class TestNamespacing:
+    def test_topics_dirs_and_identity(self):
+        cfg = make_config()
+        reg = TenantRegistry.from_config(cfg)
+        tcfg = tenant_config(cfg, reg.require("movies"))
+        assert tcfg.get_string("oryx.input-topic.message.topic") == (
+            namespaced(cfg.get_string("oryx.input-topic.message.topic"), "movies")
+        )
+        assert tcfg.get_string("oryx.update-topic.message.topic").endswith(".movies")
+        assert tcfg.get_string("oryx.batch.storage.model-dir").rstrip("/").endswith(
+            "/movies"
+        )
+        assert tcfg.get_string("oryx.batch.storage.data-dir").rstrip("/").endswith(
+            "/movies"
+        )
+        # consumer-group / ledger identity is namespaced too ("<base>-<id>"
+        # when the base declared an id, the bare tenant id otherwise)
+        oryx_id = tcfg.get_string("oryx.id")
+        assert oryx_id == "movies" or oryx_id.endswith("-movies")
+        named = tenant_config(
+            cfg.with_overlay('oryx.id = "Prod"'), reg.require("movies")
+        )
+        assert named.get_string("oryx.id") == "Prod-movies"
+
+    def test_app_wiring_applied(self):
+        cfg = make_config()
+        reg = TenantRegistry.from_config(cfg)
+        tcfg = tenant_config(cfg, reg.require("churn"))
+        assert "rdf" in tcfg.get_string("oryx.batch.update-class")
+        assert "rdf" in tcfg.get_string("oryx.serving.model-manager-class")
+
+    def test_explicit_topic_overrides_win(self):
+        cfg = make_config()
+        spec = TenantSpec(
+            tenant_id="ext", app="als", update_topic="SharedBusUpdates"
+        )
+        tcfg = tenant_config(cfg, spec)
+        assert tcfg.get_string("oryx.update-topic.message.topic") == "SharedBusUpdates"
+
+    def test_config_overlay_wins_last(self):
+        cfg = make_config()
+        spec = TenantSpec(
+            tenant_id="t",
+            app="kmeans",
+            config_overlay={
+                "oryx": {
+                    "input-schema": {"num-features": 2},
+                    "kmeans": {"hyperparams": {"k": 7}},
+                }
+            },
+        )
+        tcfg = tenant_config(cfg, spec)
+        assert tcfg.get("oryx.input-schema.num-features", None) == 2
+        assert tcfg.get("oryx.kmeans.hyperparams.k", None) == 7
+        # namespacing still applied underneath the overlay
+        assert tcfg.get_string("oryx.input-topic.message.topic").endswith(".t")
+
+    def test_resource_modules_union_is_ordered_and_deduped(self):
+        reg = TenantRegistry.from_config(make_config())
+        mods = reg.resource_modules()
+        assert mods == sorted(set(mods), key=mods.index)
+        for spec in reg:
+            for mod in spec.resource_modules():
+                assert mod in mods
+
+
+class TestRequestRouting:
+    def test_split_tenant_path(self):
+        assert split_tenant_path("/t/movies/recommend/u1") == (
+            "movies",
+            "/recommend/u1",
+        )
+        assert split_tenant_path("/t/movies") == ("movies", "/")
+        assert split_tenant_path("/recommend/u1") == (None, "/recommend/u1")
+
+    def test_loadgen_mirrors_routing_constants(self):
+        # the loadgen deliberately avoids importing serving; the constants
+        # must stay in sync by value
+        from oryx_tpu.loadgen import engine
+
+        assert engine.TENANT_HEADER == TENANT_HEADER
+        assert engine.TENANT_PATH_PREFIX == TENANT_PATH_PREFIX

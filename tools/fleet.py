@@ -26,6 +26,11 @@ Scenario actions (oryx_tpu/loadgen/scenario.py format):
   scale     {direction, drain_s}    — scale the fleet out (fresh replica,
                                       routed once ready) or in (drain-first
                                       retirement; the slot is tombstoned)
+  publish-tenant {tenant, metric}   — one generation for ONE tenant, on the
+                                      tenant's namespaced topic + lineage
+  tenant-mix {tenant: weight, ...}  — rebalance the engine's tenant traffic
+                                      split mid-run (the noisy-neighbour
+                                      burst; --tenants runs only)
 
 The harness is also an autoscaler actuator: ``start_autoscaler()`` runs
 the predictive/reactive policy (oryx_tpu/serving/autoscale.py) on a
@@ -37,6 +42,7 @@ Usage:
     python tools/fleet.py --replicas 3 --rate 150 --seconds 10
     python tools/fleet.py --replicas 3 --scenario scenario.json
     python tools/fleet.py --replicas 2 --autoscale --rate 150 --seconds 20
+    python tools/fleet.py --replicas 3 --tenants "als:2,kmeans:1,rdf:1"
 """
 
 from __future__ import annotations
@@ -67,6 +73,7 @@ from oryx_tpu.loadgen import (
     Target,
     evaluate_slo,
 )
+from oryx_tpu.loadgen.slo import SLOSpec, evaluate_tenant_slos
 from oryx_tpu.registry.tracking import record_fleet_skew
 from oryx_tpu.serving.autoscale import (
     AutoscaleConfig,
@@ -102,6 +109,7 @@ class FleetHarness:
         chaos_seed: int = 7,
         skew_poll_s: float = 0.25,
         overlay: str | None = None,
+        tenants: dict[str, dict] | None = None,
     ) -> None:
         self.n_replicas = int(n_replicas)
         self.work_dir = str(work_dir)
@@ -138,6 +146,13 @@ class FleetHarness:
         self.slo_p99_ms = 1000.0
         # scripted-feedback producer on the input topic (attach_feedback)
         self._feedback_producer = None
+        # multi-tenant fleet (docs/multi-tenancy.md): tenant id ->
+        # {"weight": w, "slo_p99_ms": p99} declared on every replica as
+        # probe-app tenants; each gets its own namespaced update topic
+        # (OryxUpdate.<tenant>) and model lineage (model/<tenant>)
+        self.tenants = dict(tenants) if tenants else None
+        self.tenant_generations: dict[str, list[str]] = {}
+        self._tenant_rate_prev: tuple[float, dict | None] = (time.monotonic(), None)
 
     # -- replica lifecycle ---------------------------------------------------
 
@@ -163,9 +178,31 @@ class FleetHarness:
             }}
             """
         )
+        if self.tenants:
+            cfg = cfg.with_overlay(self._tenancy_overlay())
         if self.overlay:
             cfg = cfg.with_overlay(self.overlay)
         return cfg
+
+    def _tenancy_overlay(self) -> str:
+        blocks = []
+        for tid, spec in sorted(self.tenants.items()):
+            weight = float(spec.get("weight", 1.0))
+            p99 = float(spec.get("slo_p99_ms", 500.0))
+            blocks.append(
+                f'{tid} {{ app = "probe", weight = {weight}, '
+                f"slo {{ p99-ms = {p99} }} }}"
+            )
+        joined = "\n            ".join(blocks)
+        return f"""
+        oryx.tenancy {{
+          enabled = true
+          fair-share {{ enabled = true, quantum = 8 }}
+          tenants {{
+            {joined}
+          }}
+        }}
+        """
 
     def _start_replica(self) -> ServingLayer:
         layer = ServingLayer(self._replica_config())
@@ -175,7 +212,11 @@ class FleetHarness:
     def start(self) -> None:
         if self._skew_thread is not None or self.replicas:
             raise RuntimeError("FleetHarness.start() called twice")
-        bus.get_broker(self.inner_locator).create_topic(UPDATE_TOPIC, 1)
+        broker = bus.get_broker(self.inner_locator)
+        broker.create_topic(UPDATE_TOPIC, 1)
+        if self.tenants:
+            for tid in self.tenants:
+                broker.create_topic(f"{UPDATE_TOPIC}.{tid}", 1)
         try:
             for i in range(self.n_replicas):
                 layer = self._start_replica()
@@ -250,9 +291,45 @@ class FleetHarness:
         skipped — a closed replica's last generation is not fleet skew."""
         return [layer.health.live_generation for layer in self._live_replicas()]
 
+    def tenant_generations_by_replica(self) -> list[dict[str, str | None]]:
+        """Per live replica: tenant id -> live generation (tenanted fleet)."""
+        return [
+            layer.tenant_mux.live_generations()
+            if getattr(layer, "tenant_mux", None) is not None
+            else {}
+            for layer in self._live_replicas()
+        ]
+
+    def wait_tenants_converged(
+        self, want: dict[str, str], timeout: float = 15.0
+    ) -> bool:
+        """True once every replica serves `want[tenant]` for every tenant."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            per = self.tenant_generations_by_replica()
+            if per and all(
+                d.get(tid) == gen for d in per for tid, gen in want.items()
+            ):
+                return True
+            time.sleep(0.05)
+        return False
+
     def _watch_skew(self) -> None:
         t0 = time.monotonic()
         while not self._skew_stop.wait(self._skew_poll_s):
+            if self.tenants:
+                # per-tenant skew on a tenanted fleet: the worst tenant's
+                # skew is the fleet's (one lagging tenant on one replica
+                # IS divergence users can see)
+                per = self.tenant_generations_by_replica()
+                skew = 0
+                gens: list = []
+                for tid in sorted(self.tenants):
+                    tenant_gens = [d.get(tid) for d in per]
+                    skew = max(skew, record_fleet_skew(tenant_gens))
+                    gens.append(tenant_gens)
+                self.skew_samples.append((time.monotonic() - t0, gens, skew))
+                continue
             gens = self.replica_generations()
             skew = record_fleet_skew(gens)
             self.skew_samples.append((time.monotonic() - t0, gens, skew))
@@ -335,6 +412,27 @@ class FleetHarness:
         with broker.producer(UPDATE_TOPIC) as producer:
             update.run_update(ts, data, [], self.model_dir, producer)
         self.generations.append(str(ts))
+        return str(ts)
+
+    def publish_tenant(self, tenant: str, metric: float = 1.0) -> str:
+        """One batch generation for ONE tenant: the model lands in that
+        tenant's model lineage (model/<tenant>) and the MLUpdate goes out
+        on the tenant's namespaced update topic (OryxUpdate.<tenant>), so
+        only that tenant's serving consumers see it."""
+        from oryx_tpu.registry.testing import ScriptedMetricUpdate
+
+        if not self.tenants or tenant not in self.tenants:
+            raise ValueError(f"unknown tenant {tenant!r}")
+        ts = self._next_ts
+        self._next_ts += 1000
+        update = ScriptedMetricUpdate(self._replica_config(metric))
+        data = [KeyMessage(None, f"r{i}") for i in range(6)]
+        broker = bus.get_broker(self.inner_locator)
+        with broker.producer(f"{UPDATE_TOPIC}.{tenant}") as producer:
+            update.run_update(
+                ts, data, [], f"{self.model_dir}/{tenant}", producer
+            )
+        self.tenant_generations.setdefault(tenant, []).append(str(ts))
         return str(ts)
 
     def _resolve_generation(self, generation: str) -> str:
@@ -454,7 +552,36 @@ class FleetHarness:
             queue_wait_ms=queue_wait_ms,
             burn_short=burn_short,
             burn_long=burn_long,
+            tenant_rates=self._tenant_rates(),
         )
+
+    def _tenant_rates(self) -> dict[str, float]:
+        """Per-tenant arrival rates by differencing the replicas'
+        serving.requests.tenant.<id> counters between signal snapshots
+        (server-side attribution — the load targets don't know tenants)."""
+        if not self.tenants:
+            return {}
+        now = time.monotonic()
+        totals = {
+            tid: float(
+                sum(
+                    layer.instance_metrics.counter(
+                        f"serving.requests.tenant.{tid}"
+                    ).value
+                    for layer in self._live_replicas()
+                )
+            )
+            for tid in self.tenants
+        }
+        prev_t, prev = self._tenant_rate_prev
+        self._tenant_rate_prev = (now, totals)
+        dt = now - prev_t
+        if prev is None or dt <= 0:
+            return {tid: 0.0 for tid in totals}
+        return {
+            tid: max(0.0, totals[tid] - prev.get(tid, 0.0)) / dt
+            for tid in totals
+        }
 
     def start_autoscaler(self, cfg: AutoscaleConfig | None = None) -> FleetAutoscaler:
         """Run the predictive/reactive sizing policy against this harness
@@ -484,6 +611,7 @@ class FleetHarness:
     def handlers(self) -> dict:
         return {
             "publish": self.publish,
+            "publish-tenant": self.publish_tenant,
             "rollback": self.rollback,
             "chaos": self.chaos,
             "restart": self.restart,
@@ -777,9 +905,15 @@ def run_scenario(
     max_inflight: int = 128,
     timeout_s: float = 10.0,
     on_response=None,
+    tenant_mix: dict[str, float] | None = None,
 ):
     """Drive one scripted scenario: traffic + action timeline + verdict.
-    Returns (LoadResult, SLOVerdict, ScenarioRunner)."""
+    Returns (LoadResult, SLOVerdict, ScenarioRunner).
+
+    `tenant_mix` (tenant id -> weight) makes the engine stamp each request
+    with a tenant drawn from the mix and route it via the /t/<tenant>
+    path prefix; a scenario "tenant-mix" action rebalances the mix
+    mid-run (the noisy-neighbour burst)."""
     # the autoscaler's burn signals judge against the scenario's own SLO
     harness.slo_p99_ms = scenario.slo.p99_ms
     engine = OpenLoopEngine(
@@ -788,8 +922,12 @@ def run_scenario(
         max_inflight=max_inflight,
         timeout_s=timeout_s,
         on_response=on_response,
+        tenant_mix=tenant_mix,
     )
-    runner = ScenarioRunner(scenario.actions, harness.handlers())
+    handlers = harness.handlers()
+    if tenant_mix is not None:
+        handlers["tenant-mix"] = lambda **mix: engine.set_tenant_mix(mix)
+    runner = ScenarioRunner(scenario.actions, handlers)
     runner.start()
     try:
         result = engine.run(
@@ -837,6 +975,110 @@ def default_scenario(rate: float, seconds: float, seed: int = 7) -> Scenario:
     )
 
 
+def parse_tenant_arg(arg: str) -> dict[str, dict]:
+    """``"als:2,kmeans:1,rdf:1"`` -> {"als": {"weight": 2.0}, ...}."""
+    tenants: dict[str, dict] = {}
+    for part in arg.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tid, _, w = part.partition(":")
+        tenants[tid.strip()] = {"weight": float(w) if w else 1.0}
+    if not tenants:
+        raise ValueError(f"no tenants in {arg!r}")
+    return tenants
+
+
+def run_tenant_fleet(args, work_dir: str) -> int:
+    """--tenants mode: one shared fleet, N probe-app tenants, traffic
+    split by weight, per-tenant generations and per-tenant SLO verdicts
+    in the report. Exit 0 only when EVERY tenant passes its SLO."""
+    tenants = parse_tenant_arg(args.tenants)
+    scenario = (
+        Scenario.from_file(args.scenario)
+        if args.scenario
+        else default_tenant_scenario(args.rate, args.seconds, args.seed)
+    )
+    with FleetHarness(
+        args.replicas, work_dir, chaos_seed=args.seed, tenants=tenants
+    ) as fleet:
+        want = {
+            tid: fleet.publish_tenant(tid, metric=0.90) for tid in tenants
+        }
+        if not fleet.wait_tenants_converged(want, timeout=20.0):
+            print("fleet: replicas never converged on every tenant's generation")
+            return 2
+        if args.autoscale:
+            fleet.start_autoscaler()
+        mix = {tid: spec["weight"] for tid, spec in tenants.items()}
+        result, verdict, runner = run_scenario(
+            fleet, scenario, max_inflight=args.max_inflight, tenant_mix=mix
+        )
+        fleet.stop_autoscaler()
+        specs = {
+            tid: SLOSpec(
+                p99_ms=float(spec.get("slo_p99_ms", scenario.slo.p99_ms)),
+                error_rate=scenario.slo.error_rate,
+            )
+            for tid, spec in tenants.items()
+        }
+        tenant_verdicts = evaluate_tenant_slos(result, specs)
+        report = {
+            "replicas": args.replicas,
+            "tenants": sorted(tenants),
+            "scenario_actions": [a.do for a in runner.executed],
+            "tenant_generations": fleet.tenant_generations,
+            "max_skew_observed": max(
+                (s for _, _, s in fleet.skew_samples), default=0
+            ),
+            "slo": {
+                "passed": verdict.passed,
+                "p99_ms": round(verdict.p99_ms, 2),
+                "error_rate": verdict.error_rate,
+                "violations": verdict.violations,
+            },
+            "tenant_slo": {
+                tid: {
+                    "passed": v.passed,
+                    "p99_ms": round(v.p99_ms, 2),
+                    "error_rate": v.error_rate,
+                    "violations": v.violations,
+                }
+                for tid, v in sorted(tenant_verdicts.items())
+            },
+            **result.summary(),
+        }
+        print(json.dumps(report, indent=2))
+        ok = verdict.passed and all(v.passed for v in tenant_verdicts.values())
+        return 0 if ok else 1
+
+
+def default_tenant_scenario(rate: float, seconds: float, seed: int = 7) -> Scenario:
+    """The multi-tenant fairness proof: steady weighted traffic across
+    the tenants, then a mid-run noisy-neighbour burst (one tenant's mix
+    weight multiplied 10x) that the DRR batcher and per-tenant admission
+    ladders must contain — victims keep their p99, zero failures."""
+    return Scenario.from_dict(
+        {
+            "duration_s": seconds,
+            "template": "/probe/recommend/u%d",
+            "arrivals": {"process": "poisson", "rate": rate, "seed": seed},
+            "skew": {
+                "users": 2_000_000,
+                "exponent": 1.1,
+                "hot_count": 16,
+                "hot_weight": 0.2,
+                "seed": seed,
+            },
+            "slo": {"p99_ms": 1000.0, "error_rate": 0.0, "window_s": 5.0},
+            # the burst rebalances the mix, not the offered rate: the
+            # noisy tenant crowds the queue, it does not add capacity
+            # pressure the fleet was never sized for
+            "actions": [],
+        }
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--replicas", type=int, default=3)
@@ -869,6 +1111,14 @@ def main() -> int:
         default=None,
         help="internal: run one subprocess serving replica in this slot",
     )
+    ap.add_argument(
+        "--tenants",
+        default=None,
+        metavar="ID:WEIGHT,...",
+        help="multi-tenant fleet: comma-separated tenant:weight pairs "
+        '(e.g. "als:2,kmeans:1,rdf:1"); traffic is split by weight and '
+        "each tenant gets its own model lineage and SLO verdict",
+    )
     args = ap.parse_args()
 
     if args.serve_replica:
@@ -890,6 +1140,8 @@ def main() -> int:
                 and report["recovery_within_budget"]
             )
             return 0 if ok else 1
+        if args.tenants:
+            return run_tenant_fleet(args, work_dir)
         scenario = (
             Scenario.from_file(args.scenario)
             if args.scenario
