@@ -42,6 +42,7 @@ LINTED_PREFIXES = (
     "oryx.fleet.autoscale",
     "oryx.ml.gate.online",
     "oryx.serving.ab",
+    "oryx.serving.native",
     "oryx.serving.overload",
     "oryx.speed.parse",
     "oryx.speed.pipeline",

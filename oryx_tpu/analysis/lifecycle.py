@@ -421,8 +421,14 @@ def _check_overwrites(own: ClassOwnership) -> list[Finding]:
             if isinstance(sub, (ast.If, ast.IfExp, ast.While)) and _mentions(
                 sub.test, attr, aliases
             ):
-                if any(s is assign for body in (sub.body,) for st in body for s in ast.walk(st)) or any(
-                    s is assign for st in getattr(sub, "orelse", []) for s in ast.walk(st)
+                # ast.IfExp carries single expression nodes where If/While
+                # carry statement lists — normalize both arms to lists
+                arm = sub.body if isinstance(sub.body, list) else [sub.body]
+                orelse = getattr(sub, "orelse", [])
+                if not isinstance(orelse, list):
+                    orelse = [orelse]
+                if any(s is assign for st in arm for s in ast.walk(st)) or any(
+                    s is assign for st in orelse for s in ast.walk(st)
                 ):
                     safe = True
         # or it's a conditional-expression guard on the same line

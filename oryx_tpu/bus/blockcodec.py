@@ -28,6 +28,7 @@ Frame header layout (little-endian, 32 bytes):
     0       4     magic   0x4B4C4252 (b"RBLK")
     4       2     kind    0=pad/wrap  1=text lines  2=interaction columns
                           3=trace context (count=0: occupies no offsets)
+                          4=pre-parsed HTTP request batch (native front)
     6       2     flags   bit 0: columns carry timestamps
     8       8     seqno   absolute topic offset of the first record
     16      4     count   records in the frame
@@ -56,6 +57,10 @@ KIND_COLS = 2
 # offset arithmetic is undisturbed); the text formats carry the same
 # context as a reserved "@trc" record line instead
 KIND_TRACE = 3
+# pre-parsed HTTP request batch from the native serving front
+# (native/httpfront.cpp hands these to serving/native_front.py); seqno
+# counts requests since front start, count = records in the frame
+KIND_HTTP = 4
 FLAG_TIMESTAMPS = 1
 
 # a trace control record's encoded line starts with this (the "@trc" key
@@ -443,6 +448,78 @@ def columns_from_payload(payload, count: int, flags: int):
     if flags & FLAG_TIMESTAMPS:
         timestamps = np.frombuffer(buf, dtype=np.int64, count=count, offset=off)
     return users, items, values, timestamps, user_prefix, item_prefix
+
+
+# ---------------------------------------------------------------------------
+# HTTP request records (KIND_HTTP): the native front's micro-batch unit
+# ---------------------------------------------------------------------------
+
+# per-record fixed header inside a KIND_HTTP payload:
+#   u32 conn_id, u32 req_id, u8 method, u8 flags, u16 n_headers,
+#   u32 target_len, u32 body_len, u32 rec_len (8-aligned total)
+_HTTP_REC = struct.Struct("<IIBBHIII")
+_HTTP_METHODS = ("GET", "POST", "DELETE", "HEAD", "OTHER")
+HTTP_FLAG_HTTP10 = 1
+HTTP_FLAG_CLOSE = 2
+
+
+class HttpRecord:
+    """One pre-parsed request from the native front. ``headers`` keeps
+    the client's original name casing and order; consumers that need
+    case-insensitive lookup wrap it (serving.native_front._Headers)."""
+
+    __slots__ = ("conn_id", "req_id", "method", "flags", "target",
+                 "headers", "body")
+
+    def __init__(self, conn_id, req_id, method, flags, target, headers,
+                 body) -> None:
+        self.conn_id = conn_id
+        self.req_id = req_id
+        self.method = method
+        self.flags = flags
+        self.target = target
+        self.headers = headers
+        self.body = body
+
+
+def decode_http_records(payload, count: int) -> list[HttpRecord]:
+    """Decode a KIND_HTTP payload into its request records."""
+    buf = memoryview(payload)
+    out: list[HttpRecord] = []
+    pos = 0
+    for _ in range(count):
+        if pos + _HTTP_REC.size > len(buf):
+            raise FrameError("truncated http record header")
+        (conn_id, req_id, method, flags, n_headers, target_len, body_len,
+         rec_len) = _HTTP_REC.unpack_from(buf, pos)
+        if pos + rec_len > len(buf) or rec_len < _HTTP_REC.size:
+            raise FrameError(f"http record length {rec_len} overruns payload")
+        off = pos + _HTTP_REC.size
+        target = bytes(buf[off : off + target_len]).decode("latin-1")
+        off += target_len
+        headers: list[tuple[str, str]] = []
+        for _h in range(n_headers):
+            klen, vlen = struct.unpack_from("<HH", buf, off)
+            off += 4
+            k = bytes(buf[off : off + klen]).decode("latin-1")
+            off += klen
+            v = bytes(buf[off : off + vlen]).decode("latin-1")
+            off += vlen
+            headers.append((k, v))
+        body = bytes(buf[off : off + body_len])
+        out.append(
+            HttpRecord(
+                conn_id,
+                req_id,
+                _HTTP_METHODS[method] if method < 5 else "OTHER",
+                flags,
+                target,
+                headers,
+                body,
+            )
+        )
+        pos += rec_len
+    return out
 
 
 class Frame:

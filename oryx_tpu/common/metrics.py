@@ -96,6 +96,30 @@ class Histogram:
             self._min = min(self._min, value)
             self._max = max(self._max, value)
 
+    def merge_buckets(self, buckets, total_sum: float) -> None:
+        """Fold pre-bucketed observations in (native-front stats drain).
+
+        ``buckets`` must use THIS histogram's bucketing (the C++ side
+        mirrors the 1e-6·2^i bounds and the same upper-bound-inclusive
+        index rule); ``total_sum`` is the sum of the raw values in
+        seconds. min/max are approximated by the populated bucket
+        bounds — exact raw values never crossed the drain."""
+        n = sum(buckets)
+        if n == 0:
+            return
+        with self._lock:
+            for i, c in enumerate(buckets):
+                if i < len(self._buckets):
+                    self._buckets[i] += c
+                else:
+                    self._buckets[-1] += c
+            self._sum += total_sum
+            self._count += n
+            lo = next(i for i, c in enumerate(buckets) if c)
+            hi = max(i for i, c in enumerate(buckets) if c)
+            self._min = min(self._min, self._bounds[lo] if lo < len(self._bounds) else self._bounds[-1])
+            self._max = max(self._max, self._bounds[hi] if hi < len(self._bounds) else self._bounds[-1])
+
     @property
     def count(self) -> int:
         return self._count

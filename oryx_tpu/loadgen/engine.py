@@ -29,19 +29,27 @@ connection / no-ready-replica), never folded into latency stats.
 
 from __future__ import annotations
 
+import http.client
 import socket
 import threading
 import time
 import urllib.error
-import urllib.request
 from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
+from urllib.parse import urlsplit
 
 from oryx_tpu.common import tracing
 from oryx_tpu.common.metrics import SLOWindow
 
-__all__ = ["LoadResult", "OpenLoopEngine", "RequestRecord", "Target", "classify_error"]
+__all__ = [
+    "KeepAliveClient",
+    "LoadResult",
+    "OpenLoopEngine",
+    "RequestRecord",
+    "Target",
+    "classify_error",
+]
 
 # Mirrors oryx_tpu.serving.overload.SHED_HEADER / STAGE_NAMES — declared
 # locally because importing the serving package would drag the whole
@@ -58,6 +66,13 @@ TENANT_HEADER = "X-Oryx-Tenant"
 TENANT_PATH_PREFIX = "/t/"
 
 
+def _quantile(values: list[float], q: float) -> float:
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
 def classify_error(exc: Exception) -> str:
     """Map a request exception to an error KIND — timeouts must never be
     indistinguishable from 5xx (they exhaust client patience and server
@@ -72,6 +87,115 @@ def classify_error(exc: Exception) -> str:
             return "timeout"
         return "connection"
     return "connection"
+
+
+class KeepAliveClient:
+    """Persistent-connection HTTP client: one ``http.client``
+    connection per (worker thread, scheme+host), reused across requests.
+
+    urllib.request stamps ``Connection: close`` on every request, so
+    each request pays a fresh TCP connect — which dominates the
+    single-digit-ms latencies the native serving front produces and is
+    the cost its keep-alive epoll path exists to amortize. Connect time
+    is returned separately per request (0.0 on a reused socket) so
+    reports can split connect from service.
+
+    Failure semantics preserve crash-failover detection: a connection
+    that dies after serving at least one request is retried ONCE on a
+    fresh socket (the server may simply have reaped it idle between
+    requests); a first-use failure, a timeout, or a repeat failure
+    propagates, so a SIGKILLed replica still surfaces as an immediate
+    connection error to the failover logic upstream.
+    """
+
+    def __init__(self, timeout_s: float = 10.0) -> None:
+        self.timeout_s = float(timeout_s)
+        self._local = threading.local()
+
+    def _cache(self) -> dict:
+        cache = getattr(self._local, "conns", None)
+        if cache is None:
+            cache = self._local.conns = {}
+        return cache
+
+    def _connect(self, key, timeout: float):
+        scheme, netloc = key
+        t0 = time.perf_counter()
+        if scheme == "https":
+            import ssl
+
+            conn = http.client.HTTPSConnection(
+                netloc, timeout=timeout,
+                context=ssl._create_unverified_context(),
+            )
+        else:
+            conn = http.client.HTTPConnection(netloc, timeout=timeout)
+        conn.connect()
+        return conn, time.perf_counter() - t0
+
+    def close(self) -> None:
+        """Close this THREAD's cached connections."""
+        cache = self._cache()
+        for entry in cache.values():
+            try:
+                entry[0].close()
+            except Exception:  # noqa: BLE001
+                pass
+        cache.clear()
+
+    def request(
+        self, url: str, method: str = "GET", headers=None, body=None,
+        timeout: float | None = None,
+    ):
+        """One request over a (possibly reused) persistent connection.
+
+        Returns ``(status, headers, body_bytes, connect_s)`` — never
+        raises for HTTP error statuses, only for transport failures.
+        """
+        parts = urlsplit(url)
+        key = (parts.scheme or "http", parts.netloc)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        t = timeout if timeout is not None else self.timeout_s
+        cache = self._cache()
+        for attempt in (0, 1):
+            entry = cache.get(key)
+            connect_s = 0.0
+            if entry is None:
+                conn, connect_s = self._connect(key, t)
+                entry = cache[key] = [conn, 0]
+            conn, served = entry
+            if conn.sock is not None:
+                conn.sock.settimeout(t)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+            except Exception as e:  # noqa: BLE001 - classified below
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                cache.pop(key, None)
+                # only a previously-working keep-alive socket earns a
+                # silent retry; timeouts are real latency, never retried
+                retryable = isinstance(
+                    e, (http.client.HTTPException, OSError)
+                ) and not isinstance(e, (socket.timeout, TimeoutError))
+                if served > 0 and attempt == 0 and retryable:
+                    continue
+                raise
+            if resp.will_close:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                cache.pop(key, None)
+            else:
+                entry[1] = served + 1
+            return resp.status, resp.msg, data, connect_s
+        raise RuntimeError("unreachable")  # pragma: no cover
 
 
 class Target:
@@ -114,6 +238,10 @@ class RequestRecord:
     # the tenant the request was issued for (per-tenant SLO verdicts
     # group records by tenant); None on a single-tenant run
     tenant: str | None = None
+    # seconds spent establishing TCP connections for this request (0.0
+    # when the keep-alive socket was reused); reported separately so
+    # connect cost never hides inside service latency
+    connect_ms: float = 0.0
 
 
 @dataclass
@@ -197,6 +325,10 @@ class LoadResult:
             "p50_ms": round(self.latency_quantile(0.50) * 1000, 2),
             "p99_ms": round(self.latency_quantile(0.99) * 1000, 2),
             "service_p99_ms": round(self.service_quantile(0.99) * 1000, 2),
+            "connects": sum(1 for r in self.records if r.connect_ms > 0),
+            "connect_p99_ms": round(
+                _quantile([r.connect_ms for r in self.records
+                           if r.connect_ms > 0], 0.99), 2),
             "queued_arrivals": self.queued_arrivals,
             "peak_inflight": self.peak_inflight,
             "retried": self.retried,
@@ -255,6 +387,8 @@ class OpenLoopEngine:
         # and retries on a surviving replica up to this many times — the
         # GET endpoints are idempotent, so failover cannot double-apply
         self.connect_retries = int(connect_retries)
+        # persistent connections, one per (worker thread, target)
+        self._client = KeepAliveClient(timeout_s=self.timeout_s)
         self._rr = 0
         self._lock = threading.Lock()
         self._inflight = 0
@@ -268,14 +402,12 @@ class OpenLoopEngine:
         while not self._stop.wait(self.readiness_poll_s):
             for t in self.targets:
                 try:
-                    with urllib.request.urlopen(
-                        f"{t.base_url}/readyz", timeout=self.timeout_s
-                    ) as resp:
-                        t.ready = resp.status == 200
-                except urllib.error.HTTPError as e:
+                    status, _, _, _ = self._client.request(
+                        f"{t.base_url}/readyz"
+                    )
                     # 404 = no /readyz resource on this server: treat as
                     # ready (bare routers); 503 = deliberately not ready
-                    t.ready = e.code == 404
+                    t.ready = status in (200, 404)
                 except Exception:
                     t.ready = False
 
@@ -319,8 +451,9 @@ class OpenLoopEngine:
 
     def _attempt(
         self, target: Target, user: int, ctx, tenant: str | None = None
-    ) -> tuple[bool, str, str, str | None]:
-        """One HTTP attempt against one target: (ok, kind, shed_stage, arm)."""
+    ) -> tuple[bool, str, str, str | None, float]:
+        """One HTTP attempt against one target:
+        (ok, kind, shed_stage, arm, connect_s)."""
         template = (
             self.tenant_templates.get(tenant, self.template)
             if tenant is not None
@@ -329,33 +462,31 @@ class OpenLoopEngine:
         path = template % user if "%d" in template else template
         if tenant is not None:
             path = f"{TENANT_PATH_PREFIX}{tenant}{path}"
+        headers = {}
+        if ctx is not None:
+            headers["traceparent"] = ctx.traceparent()
         try:
-            req = urllib.request.Request(target.base_url + path)
-            if ctx is not None:
-                req.add_header("traceparent", ctx.traceparent())
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
-                data = resp.read()
-                ok = 200 <= resp.status < 300
-                shed_stage = resp.headers.get(SHED_HEADER) or "full"
-                arm = resp.headers.get(ARM_HEADER)
-                if not ok:  # non-2xx that didn't raise (3xx)
-                    return ok, f"http-{resp.status // 100}xx", shed_stage, arm
-                if self.on_response is not None:
-                    try:
-                        self.on_response(user, resp.status, resp.headers, data)
-                    except Exception:  # noqa: BLE001
-                        pass
-                return ok, "ok", shed_stage, arm
-        except urllib.error.HTTPError as e:
-            # a 429 stamped by the shed ladder is the overload
-            # controller doing its job — account it as shed load,
-            # not as a failure
-            stage = e.headers.get(SHED_HEADER) if e.headers else None
-            if e.code == 429 and stage == "shed":
-                return False, "shed", "shed", None
-            return False, classify_error(e), "full", None
+            status, hdrs, data, connect_s = self._client.request(
+                target.base_url + path, headers=headers
+            )
         except Exception as e:  # noqa: BLE001 - classified, not swallowed
-            return False, classify_error(e), "full", None
+            return False, classify_error(e), "full", None, 0.0
+        shed_stage = hdrs.get(SHED_HEADER) or "full"
+        arm = hdrs.get(ARM_HEADER)
+        if 200 <= status < 300:
+            if self.on_response is not None:
+                try:
+                    self.on_response(user, status, hdrs, data)
+                except Exception:  # noqa: BLE001
+                    pass
+            return True, "ok", shed_stage, arm, connect_s
+        if status < 400:  # 3xx
+            return False, f"http-{status // 100}xx", shed_stage, arm, connect_s
+        # a 429 stamped by the shed ladder is the overload controller
+        # doing its job — account it as shed load, not as a failure
+        if status == 429 and hdrs.get(SHED_HEADER) == "shed":
+            return False, "shed", "shed", None, connect_s
+        return False, f"http-{status // 100}xx", "full", None, connect_s
 
     def _execute(
         self,
@@ -372,6 +503,7 @@ class OpenLoopEngine:
         kind = "ok"
         shed_stage = "full"
         arm = None
+        connect_s = 0.0
         # client root span: sampled requests ship their context as a
         # traceparent header, so the server's serving.request (and the
         # queue-wait/scan/rescore spans under it) land in the same trace
@@ -381,7 +513,10 @@ class OpenLoopEngine:
         else:
             retries = 0
             while True:
-                ok, kind, shed_stage, arm = self._attempt(target, user, ctx, tenant)
+                ok, kind, shed_stage, arm, c_s = self._attempt(
+                    target, user, ctx, tenant
+                )
+                connect_s += c_s
                 if kind != "connection" or retries >= self.connect_retries:
                     break
                 # a replica refusing connections is GONE (SIGKILLed, not
@@ -420,6 +555,7 @@ class OpenLoopEngine:
             arm=arm,
             user=user,
             tenant=tenant,
+            connect_ms=connect_s * 1000.0,
         )
         with self._lock:
             sink.append(rec)

@@ -20,7 +20,7 @@ import threading
 log = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["feature_store.cpp", "parse.cpp"]
+_SOURCES = ["feature_store.cpp", "parse.cpp", "httpfront.cpp"]
 _LOCK = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _lib_failed = False
@@ -223,3 +223,43 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_char, c.c_int64, c.POINTER(c.c_char),
         c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
     ]
+    # httpfront.cpp: epoll HTTP/1.1 front (serving/native_front.py owns
+    # the handle; ctypes releases the GIL for the blocking hf_poll)
+    u8p = c.POINTER(c.c_uint8)
+    lib.hf_create.restype = c.c_void_p
+    lib.hf_create.argtypes = [
+        c.c_int, c.c_int, c.c_int64, c.c_int64, c.c_double, c.c_int64,
+    ]
+    lib.hf_port.restype = c.c_int
+    lib.hf_port.argtypes = [c.c_void_p]
+    lib.hf_shutdown.argtypes = [c.c_void_p]
+    lib.hf_close.argtypes = [c.c_void_p]
+    lib.hf_poll.restype = c.c_int64
+    lib.hf_poll.argtypes = [c.c_void_p, u8p, c.c_int64, c.c_int]
+    lib.hf_respond.restype = c.c_int
+    lib.hf_respond.argtypes = [
+        c.c_void_p, c.c_uint32, c.c_uint32, u8p, c.c_int64, c.c_int,
+    ]
+    lib.hf_set_ladder.argtypes = [c.c_void_p, c.c_int, c.c_int, c.c_uint32]
+    lib.hf_set_tenants.argtypes = [c.c_void_p, u8p, c.c_int64]
+    lib.hf_set_exempt.argtypes = [c.c_void_p, u8p, c.c_int64]
+    lib.hf_set_context.argtypes = [c.c_void_p, u8p, c.c_int64]
+    lib.hf_set_shed_template.argtypes = [
+        c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, c.c_int64,
+    ]
+    lib.hf_set_snapshot.argtypes = [
+        c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, u8p, c.c_int64,
+        c.c_int64, c.c_int,
+    ]
+    lib.hf_cache_cap.argtypes = [c.c_void_p, c.c_int64]
+    lib.hf_cache_put.argtypes = [
+        c.c_void_p, u8p, c.c_int64, u8p, c.c_int64, u8p, c.c_int64,
+        c.c_int64,
+    ]
+    lib.hf_cache_clear.argtypes = [c.c_void_p]
+    lib.hf_cache_size.restype = c.c_int64
+    lib.hf_cache_size.argtypes = [c.c_void_p]
+    lib.hf_stats.restype = c.c_int64
+    lib.hf_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64), c.c_int64, c.c_int]
+    lib.hf_drain_trace.restype = c.c_int64
+    lib.hf_drain_trace.argtypes = [c.c_void_p, u8p, c.c_int64]

@@ -684,6 +684,7 @@ class ServingLayer:
         self._consume_thread: SupervisedThread | None = None
         self._server: HTTPServer | None = None
         self._server_thread: threading.Thread | None = None
+        self._native_front = None  # serving/native_front.NativeFront | None
         self._stop_event = threading.Event()
         self.health = ServingHealth()
         self.retry_policy = RetryPolicy.from_config(config, "oryx.serving.retry")
@@ -785,6 +786,7 @@ class ServingLayer:
         if (
             self._server is not None
             or self._server_thread is not None
+            or self._native_front is not None
             or self._update_consumer is not None
         ):
             raise RuntimeError(
@@ -901,19 +903,44 @@ class ServingLayer:
                 keyfile=self.key_file,
                 password=self.keystore_password,
             )
-        self._server = _PooledHTTPServer(
-            ("0.0.0.0", self.port), handler_cls, threads, tls_ctx=tls_ctx
-        )
-        if self.port == 0:
-            self.port = self._server.server_address[1]
-        self._server_thread = threading.Thread(
-            target=self._server.serve_forever, name="ServingHTTP", daemon=True
-        )
-        self._server_thread.start()
+        # native data plane (docs/serving-native.md): when the toolchain
+        # is present and oryx.serving.native.* allows it, the epoll C++
+        # front replaces the pooled stdlib server; it answers the cheap
+        # rungs in C++ and forwards everything else through the same
+        # _dispatch_parsed core. maybe_start() returns None on any
+        # decline (TLS, auth, disabled, no g++) and the stdlib server
+        # below serves identically — the bit-compatible fallback.
+        from oryx_tpu.serving import native_front as _native_mod
+
+        self._native_front = _native_mod.maybe_start(self, ctx, threads)
         from oryx_tpu.common import ledger
 
-        ledger.register("thread", self._server_thread, live=threading.Thread.is_alive)
-        log.info("ServingLayer listening on :%d%s", self.port, self.context_path or "/")
+        if self._native_front is not None:
+            self.port = self._native_front.port
+            ledger.register(
+                "thread",
+                self._native_front.poll_thread,
+                live=threading.Thread.is_alive,
+            )
+        else:
+            self._server = _PooledHTTPServer(
+                ("0.0.0.0", self.port), handler_cls, threads, tls_ctx=tls_ctx
+            )
+            if self.port == 0:
+                self.port = self._server.server_address[1]
+            self._server_thread = threading.Thread(
+                target=self._server.serve_forever, name="ServingHTTP", daemon=True
+            )
+            self._server_thread.start()
+            ledger.register(
+                "thread", self._server_thread, live=threading.Thread.is_alive
+            )
+        log.info(
+            "ServingLayer listening on :%d%s%s",
+            self.port,
+            self.context_path or "/",
+            " (native front)" if self._native_front is not None else "",
+        )
 
     def _consume_updates(self) -> None:
         self.model_manager.consume_blocks(self._health_blocks())
@@ -991,6 +1018,14 @@ class ServingLayer:
                                     "generation", self.health.live_generation
                                 )
                 self.health.mark_update()
+                if self._native_front is not None and _block_has_model(block):
+                    # a MODEL apply flips readiness / live_generation NOW;
+                    # callers that watch convergence in-process (fleet
+                    # wait_converged) probe /readyz immediately after, so
+                    # the native snapshots cannot wait for the next
+                    # control tick (push_snapshots is safe off the
+                    # control thread — begin_drain relies on that too)
+                    self._native_front.push_snapshots()
 
     # -- multi-tenant wiring (docs/multi-tenancy.md) ------------------------
 
@@ -1153,6 +1188,11 @@ class ServingLayer:
         normally. The first half of a zero-downtime rolling restart."""
         self.health.draining = True
         self.instance_metrics.gauge("serving.draining").set(1)
+        if self._native_front is not None:
+            # the native /readyz snapshot must flip to 503 NOW, not at
+            # the next control tick — load balancers poll readiness to
+            # decide where new traffic goes during a rolling restart
+            self._native_front.push_snapshots()
         log.info("ServingLayer :%d draining (readiness now 503)", self.port)
 
     def drain(self, timeout: float = 10.0) -> bool:
@@ -1180,6 +1220,8 @@ class ServingLayer:
                     self.inflight_requests,
                     drain_seconds,
                 )
+        if self._native_front is not None:
+            self._native_front.close()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -1429,10 +1471,111 @@ def _served_items(body):
     return ()
 
 
+def _check_auth(layer: ServingLayer, headers) -> None:
+    """Basic-auth gate shared by both fronts; raises 401 on failure."""
+    if not layer.user_name:
+        return
+    auth = headers.get("Authorization", "") or ""
+    if not auth.startswith("Basic "):
+        raise OryxServingException(401, "unauthorized")
+    try:
+        userpass = base64.b64decode(auth[6:]).decode("utf-8")
+    except Exception:
+        raise OryxServingException(401, "unauthorized")
+    import hmac
+
+    if not hmac.compare_digest(userpass, f"{layer.user_name}:{layer.password}"):
+        raise OryxServingException(401, "unauthorized")
+
+
+def gzip_compress(body: bytes) -> bytes:
+    """Deterministic response gzip (mtime pinned): the same body always
+    produces the same bytes, which is what lets the native/Python fronts
+    hold their byte-parity contract across the gzip rung."""
+    return gzip.compress(body, mtime=0)
+
+
+def _dispatch_parsed(layer, ctx, method: str, raw_path: str, headers, body,
+                     tenant_box):
+    """The front-agnostic request core: everything between "a parsed
+    request" and "a rendered (status, payload, content-type, extras)
+    tuple". Both the Python handler and the native front's dispatch
+    workers (serving/native_front.py) call this, so tenant resolution,
+    admission, tracing, experiments, and rendering cannot drift between
+    fronts. `headers` needs case-insensitive ``get`` plus ``items()``
+    with original casing (email.Message and native_front._Headers both
+    qualify); ``tenant_box[0]`` receives the resolved tenant even when
+    dispatch later raises."""
+    _check_auth(layer, headers)
+    split = urlsplit(raw_path)
+    path = split.path
+    if layer.context_path:
+        if not path.startswith(layer.context_path):
+            raise OryxServingException(404, "outside context path")
+        path = path[len(layer.context_path) :] or "/"
+    # tenant resolution (docs/multi-tenancy.md): the /t/<tenant>/
+    # prefix wins over the X-Oryx-Tenant header; untenanted
+    # data-plane requests fall to the default tenant. Resolved
+    # before routing so the stripped path matches the resources,
+    # and scoped over the dispatch so the batcher / admission /
+    # mux all see it.
+    tenant = None
+    if layer.tenants is not None:
+        tenant, path = _tenancy.split_tenant_path(path)
+        if tenant is None:
+            tenant = headers.get(_tenancy.TENANT_HEADER)
+        if tenant is None and not _overload.exempt(path):
+            tenant = layer.tenants.default_tenant
+        if tenant is not None and tenant not in layer.tenants:
+            raise OryxServingException(404, f"unknown tenant {tenant!r}")
+        tenant_box[0] = tenant
+    if headers.get("Content-Encoding") == "gzip":
+        body = gzip.decompress(body)
+    req = Request(
+        # HEAD routes like GET; the body is suppressed at send time
+        method="GET" if method == "HEAD" else method,
+        path=path,
+        params={},
+        query=parse_qs(split.query),
+        headers={k: v for k, v in headers.items()},
+        body=body,
+    )
+    # answer-cache key: path + raw query, i.e. the full request
+    # identity for the GET data plane the stale rung serves — the
+    # tenant rides in front so two tenants' answers for the same
+    # path can never alias in the cache
+    cache_key = path + ("?" + split.query if split.query else "")
+    if tenant is not None:
+        cache_key = f"/t/{tenant}{cache_key}"
+    attrs = {"path": path, "method": req.method}
+    if tenant is not None:
+        attrs["tenant"] = tenant
+    # request-lifecycle span: a sampled incoming traceparent is
+    # honored (the loadgen client's span becomes this span's
+    # parent, joined by trace id); header-less requests roll the
+    # root sampling dice. Untraced requests skip all of it.
+    incoming = tracing.parse_traceparent(headers.get("traceparent"))
+    with _tenancy.tenant_scope(tenant):
+        if incoming is not None and incoming.sampled:
+            with tracing.use(incoming):
+                with tracing.span("serving.request", attrs=attrs) as sp:
+                    response = _admit_and_route(layer, ctx, req, cache_key, sp)
+                    sp.set("status", getattr(response, "status", 200))
+        else:
+            with tracing.span("serving.request", attrs=attrs, root=True) as sp:
+                response = _admit_and_route(layer, ctx, req, cache_key, sp)
+                sp.set("status", getattr(response, "status", 200))
+    return render(response, headers.get("Accept", "application/json"))
+
+
 def _make_handler(layer: ServingLayer, ctx: ServingContext):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
         server_version = "oryx_tpu"
+        # keep-alive clients see Nagle + delayed-ACK stack into ~40 ms
+        # per-request stalls without this; the native front (httpfront.cpp)
+        # sets TCP_NODELAY on every accepted socket for the same reason
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # route to logging, not stderr
             log.debug("%s " + fmt, self.address_string(), *args)
@@ -1467,7 +1610,7 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
             body = payload
             headers = dict(extra)
             if len(body) > 1024 and "gzip" in self.headers.get("Accept-Encoding", ""):
-                body = gzip.compress(body)
+                body = gzip_compress(body)
                 headers["Content-Encoding"] = "gzip"
             self.send_response(status)
             self.send_header("Content-Type", ct)
@@ -1480,87 +1623,15 @@ def _make_handler(layer: ServingLayer, ctx: ServingContext):
 
         def _dispatch(self, method: str):
             self._tenant = None
-            if not self._authorized():
-                raise OryxServingException(401, "unauthorized")
-            split = urlsplit(self.path)
-            path = split.path
-            if layer.context_path:
-                if not path.startswith(layer.context_path):
-                    raise OryxServingException(404, "outside context path")
-                path = path[len(layer.context_path) :] or "/"
-            # tenant resolution (docs/multi-tenancy.md): the /t/<tenant>/
-            # prefix wins over the X-Oryx-Tenant header; untenanted
-            # data-plane requests fall to the default tenant. Resolved
-            # before routing so the stripped path matches the resources,
-            # and scoped over the dispatch so the batcher / admission /
-            # mux all see it.
-            tenant = None
-            if layer.tenants is not None:
-                tenant, path = _tenancy.split_tenant_path(path)
-                if tenant is None:
-                    tenant = self.headers.get(_tenancy.TENANT_HEADER)
-                if tenant is None and not _overload.exempt(path):
-                    tenant = layer.tenants.default_tenant
-                if tenant is not None and tenant not in layer.tenants:
-                    raise OryxServingException(404, f"unknown tenant {tenant!r}")
-                self._tenant = tenant
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
-            if self.headers.get("Content-Encoding") == "gzip":
-                body = gzip.decompress(body)
-            req = Request(
-                # HEAD routes like GET; the body is suppressed in _handle
-                method="GET" if method == "HEAD" else method,
-                path=path,
-                params={},
-                query=parse_qs(split.query),
-                headers={k: v for k, v in self.headers.items()},
-                body=body,
-            )
-            # answer-cache key: path + raw query, i.e. the full request
-            # identity for the GET data plane the stale rung serves — the
-            # tenant rides in front so two tenants' answers for the same
-            # path can never alias in the cache
-            cache_key = path + ("?" + split.query if split.query else "")
-            if tenant is not None:
-                cache_key = f"/t/{tenant}{cache_key}"
-            attrs = {"path": path, "method": req.method}
-            if tenant is not None:
-                attrs["tenant"] = tenant
-            # request-lifecycle span: a sampled incoming traceparent is
-            # honored (the loadgen client's span becomes this span's
-            # parent, joined by trace id); header-less requests roll the
-            # root sampling dice. Untraced requests skip all of it.
-            incoming = tracing.parse_traceparent(self.headers.get("traceparent"))
-            with _tenancy.tenant_scope(tenant):
-                if incoming is not None and incoming.sampled:
-                    with tracing.use(incoming):
-                        with tracing.span("serving.request", attrs=attrs) as sp:
-                            response = _admit_and_route(
-                                layer, ctx, req, cache_key, sp
-                            )
-                            sp.set("status", getattr(response, "status", 200))
-                else:
-                    with tracing.span(
-                        "serving.request", attrs=attrs, root=True
-                    ) as sp:
-                        response = _admit_and_route(layer, ctx, req, cache_key, sp)
-                        sp.set("status", getattr(response, "status", 200))
-            return render(response, self.headers.get("Accept", "application/json"))
-
-        def _authorized(self) -> bool:
-            if not layer.user_name:
-                return True
-            auth = self.headers.get("Authorization", "")
-            if not auth.startswith("Basic "):
-                return False
+            tenant_box = [None]
             try:
-                userpass = base64.b64decode(auth[6:]).decode("utf-8")
-            except Exception:
-                return False
-            import hmac
-
-            return hmac.compare_digest(userpass, f"{layer.user_name}:{layer.password}")
+                return _dispatch_parsed(
+                    layer, ctx, method, self.path, self.headers, body, tenant_box
+                )
+            finally:
+                self._tenant = tenant_box[0]
 
         def _send_error(self, status: int, message: str) -> None:
             # plain error body (ErrorResource.java renders status + message)

@@ -199,6 +199,12 @@ class AnswerCache:
         self._entries: OrderedDict[str, CachedAnswer] = OrderedDict()
         self.hits = 0
         self.misses = 0
+        # optional mirror hook (serving/native_front.py): called after
+        # each put, off the lock, so the native front can mirror the
+        # entry into its C++ answer cache. Must be cheap and
+        # non-blocking — the native front just enqueues and renders on
+        # its control tick, never on this (request) thread.
+        self.listener: Callable[[str, CachedAnswer], None] | None = None
 
     def put(self, key: str, answer: CachedAnswer) -> None:
         with self._lock:
@@ -206,6 +212,9 @@ class AnswerCache:
             self._entries.move_to_end(key)
             while len(self._entries) > self._max:
                 self._entries.popitem(last=False)
+        listener = self.listener
+        if listener is not None:
+            listener(key, answer)
 
     def get(self, key: str, champion_generation: str | None) -> CachedAnswer | None:
         with self._lock:

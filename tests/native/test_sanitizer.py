@@ -111,6 +111,35 @@ def test_feature_store_suite_clean_under_asan_ubsan(sanitized_env):
     assert "runtime error:" not in output, output[-8000:]
 
 
+def test_httpfront_suite_clean_under_asan_ubsan(sanitized_env):
+    """The native HTTP front under the instrumented build: the byte-parity
+    suite (real sockets, pipelining, keep-alive concurrency, slowloris
+    reaping, oversized-frame rejection, mid-request disconnects) replays
+    against an httpfront.cpp compiled with ASan+UBSan. The epoll loop,
+    per-connection buffer arithmetic, and the teardown path (hf_shutdown
+    unblocking hf_poll, then hf_close freeing connections) are exactly
+    the code ASan's heap checks and UBSan's overflow checks target."""
+    proc = _run(
+        sanitized_env,
+        "tests/serving/test_native_front.py",
+        "-k", "not fleet and not tenants",
+        timeout=600,
+    )
+    output = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"sanitized httpfront run failed:\n{output[-8000:]}"
+    assert "ERROR: AddressSanitizer" not in output, output[-8000:]
+    assert "runtime error:" not in output, output[-8000:]
+    # prove the native front actually ran (skipif would vacuously pass if
+    # the sanitized variant silently failed to load)
+    probe = _run(
+        sanitized_env,
+        "tests/serving/test_native_front.py::test_native_rejects_bad_wire",
+        "-rs",
+        timeout=300,
+    )
+    assert "native toolchain unavailable" not in probe.stdout, probe.stdout
+
+
 def test_build_native_cli_sanitize_exits_clean():
     """The CI entry point: `build_native.py --sanitize` succeeds with a
     toolchain present and exits 0 (clean skip) without one — never a

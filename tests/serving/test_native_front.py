@@ -1,0 +1,651 @@
+"""Native HTTP front: byte-parity against the Python data plane.
+
+The native front (oryx_tpu/native/httpfront.cpp + serving/native_front.py)
+is a *performance* feature with a *correctness* contract: a client must
+not be able to tell which front served it. These tests enforce that
+contract literally — same request bytes in, same response bytes out
+(modulo the Date header) — across routes, methods, error codes, content
+negotiation, the shed/stale overload rungs, tenants, and seeded fuzz
+with mid-run connection drops. Hardening tests cover the attack surface
+the Python front never had (slowloris, oversized frames, pipelining),
+and the fleet acceptance test proves a rolling restart with the native
+front enabled still loses zero requests.
+
+Documented divergences (docs/serving-native.md) are exactly the wire
+errors the Python front cannot express byte-identically: 400/413/431/
+501/505 answered natively carry ``Server: oryx_tpu`` without the
+Python version suffix. Everything that reaches dispatch is bit-equal.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+sys.path.insert(0, str(REPO_ROOT / "tools"))
+
+from oryx_tpu import bus, native
+from oryx_tpu.common import config as C
+from oryx_tpu.serving.layer import ServingLayer
+
+_HAVE_NATIVE = native.get_library() is not None and hasattr(
+    native.get_library(), "hf_create"
+)
+
+needs_native = pytest.mark.skipif(
+    not _HAVE_NATIVE, reason="native toolchain unavailable"
+)
+
+_DATE_RE = re.compile(rb"^Date: [^\r\n]+\r$", re.M)
+# /healthz reports wall-clock staleness; the two layers measure at
+# slightly different instants (and the native snapshot is rendered on the
+# control tick), so the float — and the Content-Length it perturbs — are
+# the only legitimately time-varying bytes in any body
+_STALENESS_RE = re.compile(rb'"staleness_seconds": [0-9.eE+-]+')
+_CLEN_RE = re.compile(rb"^Content-Length: \d+\r$", re.M)
+
+
+def make_config(broker, **overrides):
+    extra = "\n".join(f"{k} = {v}" for k, v in overrides.items())
+    return C.get_default().with_overlay(
+        f"""
+        oryx {{
+          input-topic.broker = "{broker}"
+          update-topic.broker = "{broker}"
+          serving {{
+            api.port = 0
+            model-manager-class = "oryx_tpu.example.serving:ExampleServingModelManager"
+            application-resources = "oryx_tpu.example.serving"
+            {extra}
+          }}
+        }}
+        """
+    )
+
+
+def raw(port, data: bytes, timeout=5.0) -> bytes:
+    """One connection: send request bytes, read to EOF."""
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.sendall(data)
+        chunks = []
+        while True:
+            try:
+                b = s.recv(65536)
+            except (TimeoutError, socket.timeout):
+                break
+            if not b:
+                break
+            chunks.append(b)
+    return b"".join(chunks)
+
+
+def request_bytes(method, path, headers=None, body=None) -> bytes:
+    h = {"Host": "127.0.0.1", "Connection": "close"}
+    if body is not None:
+        h["Content-Length"] = str(len(body))
+    if headers:
+        h.update(headers)
+    head = f"{method} {path} HTTP/1.1\r\n" + "".join(
+        f"{k}: {v}\r\n" for k, v in h.items()
+    )
+    return head.encode("latin-1") + b"\r\n" + (body or b"")
+
+
+def fetch(port, method="GET", path="/", headers=None, body=None) -> bytes:
+    return raw(port, request_bytes(method, path, headers=headers, body=body))
+
+
+def mask(resp: bytes) -> bytes:
+    """Strip the legitimately nondeterministic bytes before comparing."""
+    resp = _DATE_RE.sub(b"Date: <masked>\r", resp)
+    if b'"staleness_seconds"' in resp:
+        resp = _STALENESS_RE.sub(b'"staleness_seconds": 0', resp)
+        resp = _CLEN_RE.sub(b"Content-Length: <masked>\r", resp)
+    return resp
+
+
+def wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def publish_model(broker, payload: dict) -> None:
+    with broker.producer("OryxUpdate") as p:
+        p.send("MODEL", json.dumps(payload))
+
+
+def is_200(port, path="/ready") -> bool:
+    return fetch(port, path=path).startswith(b"HTTP/1.1 200")
+
+
+class Pair:
+    """Two identically configured layers on one broker, one per front."""
+
+    def __init__(self, broker_loc, **overrides):
+        self.broker_loc = broker_loc
+        self.broker = bus.get_broker(broker_loc)
+        self.native = ServingLayer(
+            make_config(broker_loc, **{"native.enabled": '"true"'}, **overrides)
+        )
+        self.python = ServingLayer(
+            make_config(broker_loc, **{"native.enabled": '"false"'}, **overrides)
+        )
+        self.native.start()
+        self.python.start()
+        assert self.native._native_front is not None, "native front must start"
+        assert self.python._native_front is None
+
+    def close(self):
+        self.native.close()
+        self.python.close()
+
+    def layers(self):
+        return (self.native, self.python)
+
+    def tick(self):
+        """Force a native control tick so pushed state is current."""
+        self.native._native_front.push_control()
+
+    def assert_parity(self, method, path, headers=None, body=None, label=""):
+        a = mask(fetch(self.native.port, method, path, headers, body))
+        b = mask(fetch(self.python.port, method, path, headers, body))
+        assert a == b, (
+            f"byte divergence on {method} {path} {label}\n"
+            f"native: {a!r}\npython: {b!r}"
+        )
+        return a
+
+
+@pytest.fixture()
+def pair(request):
+    name = re.sub(r"[^a-z0-9]+", "-", request.node.name.lower())[:48]
+    p = Pair(f"inproc://nf-{name}")
+    try:
+        yield p
+    finally:
+        p.close()
+
+
+def _pin_stage(layer, stage: int) -> None:
+    """Freeze the admission ladder at ``stage`` on one layer: the control
+    law stops moving it (evaluate no-ops) and the stage is set directly,
+    exactly like sustained pressure would."""
+    adm = layer.admission
+    assert adm is not None
+    adm.evaluate = lambda *a, **k: adm._stage  # instance attr shadows method
+    adm._stage = stage
+
+
+# -- byte parity: routes, methods, errors ------------------------------------
+
+
+@needs_native
+def test_parity_basic_routes(pair):
+    # before any model: snapshots say 503, dynamic routes too
+    pair.tick()
+    for path in ("/ready", "/healthz", "/readyz", "/distinct"):
+        pair.assert_parity("GET", path, label="(pre-model)")
+
+    publish_model(pair.broker, {"a": 2, "b": 1})
+    for layer in pair.layers():
+        assert wait_for(lambda l=layer: is_200(l.port)), "model not applied"
+    pair.tick()
+
+    for path in ("/", "/ready", "/healthz", "/readyz", "/distinct"):
+        pair.assert_parity("GET", path)
+    # query strings survive the forward verbatim
+    pair.assert_parity("GET", "/distinct?x=1&y=2")
+    pair.assert_parity("GET", "/distinct?x=%20a&x=b")
+    # error routes travel the same dispatch core
+    pair.assert_parity("GET", "/nope")
+    pair.assert_parity("DELETE", "/distinct")
+    pair.assert_parity("GET", "/../etc/passwd")
+    # mutations forward with bodies intact
+    pair.assert_parity("POST", "/add", body=b"hello native\n")
+    # HEAD mirrors GET headers, no body
+    head = pair.assert_parity("HEAD", "/distinct")
+    assert head.endswith(b"\r\n\r\n")
+    # content negotiation happens in Python for both fronts
+    pair.assert_parity("GET", "/distinct", headers={"Accept": "text/csv"})
+    pair.assert_parity(
+        "GET", "/distinct", headers={"Accept": "text/csv,application/json"}
+    )
+
+
+@needs_native
+def test_parity_gzip_large_body(pair):
+    # a model big enough that the rendered JSON crosses the 1 KiB gzip
+    # threshold — compression must be byte-identical (mtime=0 both sides)
+    publish_model(pair.broker, {f"key-{i:04d}": i for i in range(200)})
+    for layer in pair.layers():
+        assert wait_for(lambda l=layer: is_200(l.port))
+    pair.tick()
+    resp = pair.assert_parity(
+        "GET", "/distinct", headers={"Accept-Encoding": "gzip"}
+    )
+    assert b"Content-Encoding: gzip" in resp
+    # identity requests skip compression identically
+    plain = pair.assert_parity("GET", "/distinct")
+    assert b"Content-Encoding" not in plain
+
+
+# -- byte parity: overload rungs ---------------------------------------------
+
+
+@needs_native
+def test_parity_shed_rung(pair):
+    publish_model(pair.broker, {"a": 1})
+    for layer in pair.layers():
+        assert wait_for(lambda l=layer: is_200(l.port))
+    for layer in pair.layers():
+        _pin_stage(layer, 3)  # STAGE_SHED
+    pair.tick()
+
+    shed = pair.assert_parity("GET", "/distinct", label="(stage=shed)")
+    assert shed.startswith(b"HTTP/1.1 429")
+    assert b"Retry-After:" in shed
+    assert b"X-Oryx-Shed-Stage: shed" in shed
+    # mutations shed too
+    post = pair.assert_parity("POST", "/add", body=b"x y\n", label="(shed)")
+    assert post.startswith(b"HTTP/1.1 429")
+    # exempt paths never shed — still answered at full quality
+    ready = pair.assert_parity("GET", "/ready", label="(shed-exempt)")
+    assert ready.startswith(b"HTTP/1.1 200")
+    pair.assert_parity("GET", "/healthz", label="(shed-exempt)")
+
+    # native answered the shed fast-path in C++, not via dispatch
+    pair.tick()
+    from oryx_tpu.common import metrics
+
+    snap = metrics.registry.snapshot()
+    assert snap.get("serving.http.native-answered.shed", {}).get("value", 0) > 0
+
+
+@needs_native
+def test_parity_stale_rung(pair):
+    publish_model(pair.broker, {"a": 7, "b": 9})
+    for layer in pair.layers():
+        assert wait_for(lambda l=layer: is_200(l.port))
+    # the example app's JSON models carry no generation id, so stamp one:
+    # the champion tracker is what gates both caches (Python AnswerCache
+    # lookups and the C++ mirror's generation tag)
+    for layer in pair.layers():
+        layer.health.live_generation = "gen-A"
+    # prime: a full-quality 200 GET populates the answer cache on both
+    primed = pair.assert_parity("GET", "/distinct", label="(prime)")
+    assert primed.startswith(b"HTTP/1.1 200")
+    pair.tick()  # mirrors the cache entry into C++
+
+    for layer in pair.layers():
+        _pin_stage(layer, 2)  # STAGE_STALE
+    pair.tick()
+
+    stale = pair.assert_parity("GET", "/distinct", label="(stage=stale)")
+    assert stale.startswith(b"HTTP/1.1 200")
+    assert b"X-Oryx-Shed-Stage: stale" in stale
+    # HEAD of a cached answer strips the body identically
+    pair.assert_parity("HEAD", "/distinct", label="(stale HEAD)")
+    # a miss (different query) falls through to dispatch on both
+    pair.assert_parity("GET", "/distinct?other=1", label="(stale miss)")
+
+    # champion swap invalidates both caches — full dispatch again, parity
+    for layer in pair.layers():
+        layer.health.live_generation = "gen-B"
+    pair.tick()
+    swapped = pair.assert_parity("GET", "/distinct", label="(post-swap)")
+    assert swapped.startswith(b"HTTP/1.1 200")
+
+
+# -- seeded fuzz with chaos drops --------------------------------------------
+
+
+@needs_native
+def test_parity_fuzz_with_connection_drops(pair):
+    import random
+
+    publish_model(pair.broker, {"a": 2, "b": 1, "c": 3})
+    for layer in pair.layers():
+        assert wait_for(lambda l=layer: is_200(l.port))
+    pair.tick()
+
+    rng = random.Random(1234)
+    paths = ["/", "/ready", "/distinct", "/nope", "/distinct?q=%d", "/add"]
+    accepts = [None, "application/json", "text/csv", "*/*"]
+    for i in range(40):
+        path = rng.choice(paths)
+        if "%d" in path:
+            path = path % rng.randrange(100)
+        method = "POST" if path == "/add" else rng.choice(["GET", "HEAD"])
+        headers = {}
+        a = rng.choice(accepts)
+        if a:
+            headers["Accept"] = a
+        if rng.random() < 0.3:
+            headers["X-Fuzz"] = f"v{i}"
+        body = b"x %d\n" % i if method == "POST" else None
+        pair.assert_parity(method, path, headers or None, body, label=f"#{i}")
+        if rng.random() < 0.25:
+            # chaos drop: half a request then a hard close, on both
+            # fronts — the NEXT request must be unaffected
+            frag = f"GET /distinct HTTP/1.1\r\nHost: x\r\nX-Part: {i}".encode()
+            for layer in pair.layers():
+                s = socket.create_connection(("127.0.0.1", layer.port), 5)
+                s.sendall(frag)
+                s.close()
+
+
+# -- hardening: the native parser's own attack surface -----------------------
+
+
+@needs_native
+def test_native_rejects_oversized_header():
+    p = Pair("inproc://nf-hard-hdr", **{"native.max-header-bytes": "512"})
+    try:
+        resp = raw(
+            p.native.port,
+            b"GET / HTTP/1.1\r\nHost: x\r\nX-Big: " + b"a" * 1024 + b"\r\n\r\n",
+        )
+        assert resp.startswith(b"HTTP/1.1 431"), resp[:64]
+    finally:
+        p.close()
+
+
+@needs_native
+def test_native_rejects_oversized_body():
+    p = Pair("inproc://nf-hard-body", **{"native.max-body-bytes": "1024"})
+    try:
+        resp = fetch(p.native.port, "POST", "/add", body=b"z" * 4096)
+        assert resp.startswith(b"HTTP/1.1 413"), resp[:64]
+    finally:
+        p.close()
+
+
+@needs_native
+def test_native_rejects_bad_wire(pair):
+    port = pair.native.port
+    assert raw(port, b"BREW / HTTP/1.1\r\nHost: x\r\n\r\n").startswith(
+        b"HTTP/1.1 501"
+    )
+    assert raw(port, b"GET / HTTP/2.0\r\nHost: x\r\n\r\n").startswith(
+        b"HTTP/1.1 505"
+    )
+    assert raw(port, b"complete garbage\r\n\r\n").startswith(b"HTTP/1.1 400")
+    # native wire errors carry the native Server token (documented
+    # divergence: these never reach Python, which isn't running the parse)
+    resp = raw(port, b"nonsense\r\n\r\n")
+    assert b"Server: oryx_tpu\r\n" in resp
+
+
+@needs_native
+def test_native_slowloris_reaped():
+    p = Pair("inproc://nf-slowloris", **{"native.idle-timeout-s": "0.5"})
+    try:
+        s = socket.create_connection(("127.0.0.1", p.native.port), 5)
+        s.sendall(b"GET /ready HTTP/1.1\r\nHost: x\r\nX-Slow")  # never finishes
+        s.settimeout(5.0)
+        t0 = time.monotonic()
+        got = s.recv(4096)  # server must reap: EOF or a 408-style close
+        elapsed = time.monotonic() - t0
+        # either an error response then close, or a silent close — but
+        # within bounded time, never a hang
+        assert elapsed < 4.0
+        if got:
+            assert got.startswith(b"HTTP/1.1 408") or not got
+        s.close()
+        # and the listener still serves new connections afterwards
+        assert fetch(p.native.port, path="/healthz").startswith(b"HTTP/1.1 ")
+    finally:
+        p.close()
+
+
+@needs_native
+def test_native_pipelined_burst_order(pair):
+    publish_model(pair.broker, {"a": 1})
+    assert wait_for(lambda: is_200(pair.native.port))
+    pair.tick()
+    reqs = b"".join(
+        f"GET /distinct?i={i} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        for i in range(5)
+    ) + b"GET /ready HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+    resp = raw(pair.native.port, reqs)
+    statuses = re.findall(rb"HTTP/1\.1 (\d{3})", resp)
+    assert statuses == [b"200"] * 6, statuses
+    # responses come back in request order: the echoed query index is
+    # monotonically increasing in the body stream
+    order = [int(m) for m in re.findall(rb"\?i=(\d)", reqs)]
+    assert order == sorted(order)
+
+
+@needs_native
+def test_native_keepalive_concurrent(pair):
+    import http.client
+
+    publish_model(pair.broker, {"a": 1, "b": 2})
+    assert wait_for(lambda: is_200(pair.native.port))
+    errors = []
+
+    def hammer(n):
+        conn = http.client.HTTPConnection("127.0.0.1", pair.native.port, timeout=10)
+        try:
+            for i in range(20):
+                conn.request("GET", "/distinct")
+                r = conn.getresponse()
+                body = r.read()
+                if r.status != 200 or not body:
+                    errors.append((n, i, r.status))
+        except Exception as e:  # noqa: BLE001
+            errors.append((n, "exc", repr(e)))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=hammer, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors[:5]
+
+
+@needs_native
+def test_native_mid_request_disconnect_is_isolated(pair):
+    publish_model(pair.broker, {"a": 1})
+    assert wait_for(lambda: is_200(pair.native.port))
+    # a client that sends a full request then vanishes before reading
+    s = socket.create_connection(("127.0.0.1", pair.native.port), 5)
+    s.sendall(b"GET /distinct HTTP/1.1\r\nHost: x\r\n\r\n")
+    s.close()
+    # the next, well-behaved client is unaffected
+    for _ in range(3):
+        assert fetch(pair.native.port, path="/distinct").startswith(
+            b"HTTP/1.1 200"
+        )
+
+
+# -- fallback: bit-compatible when the native path is unavailable ------------
+
+
+def test_fallback_enabled_false_serves_identically():
+    broker_loc = "inproc://nf-fallback-off"
+    broker = bus.get_broker(broker_loc)
+    layer = ServingLayer(make_config(broker_loc, **{"native.enabled": '"false"'}))
+    layer.start()
+    try:
+        assert layer._native_front is None
+        publish_model(broker, {"a": 5})
+        assert wait_for(lambda: is_200(layer.port))
+        resp = fetch(layer.port, path="/distinct")
+        assert resp.startswith(b"HTTP/1.1 200")
+        assert json.loads(resp.split(b"\r\n\r\n", 1)[1]) == {"a": 5}
+    finally:
+        layer.close()
+
+
+def test_fallback_auto_without_toolchain(monkeypatch):
+    monkeypatch.setattr(native, "get_library", lambda *a, **k: None)
+    broker_loc = "inproc://nf-fallback-auto"
+    broker = bus.get_broker(broker_loc)
+    layer = ServingLayer(make_config(broker_loc))  # enabled = "auto"
+    layer.start()
+    try:
+        assert layer._native_front is None  # silent, bit-compatible fallback
+        publish_model(broker, {"k": 1})
+        assert wait_for(lambda: is_200(layer.port))
+        assert fetch(layer.port, path="/distinct").startswith(b"HTTP/1.1 200")
+    finally:
+        layer.close()
+
+
+def test_forced_true_without_toolchain_falls_back(monkeypatch, caplog):
+    monkeypatch.setattr(native, "get_library", lambda *a, **k: None)
+    layer = ServingLayer(
+        make_config("inproc://nf-forced", **{"native.enabled": '"true"'})
+    )
+    with caplog.at_level("WARNING"):
+        layer.start()
+    try:
+        assert layer._native_front is None
+        assert any("falling back" in r.message for r in caplog.records)
+    finally:
+        layer.close()
+
+
+@needs_native
+def test_native_declines_with_auth():
+    layer = ServingLayer(
+        make_config(
+            "inproc://nf-auth-decline",
+            **{
+                "native.enabled": '"true"',
+                "api.user-name": '"u"',
+                "api.password": '"p"',
+                "api.allow-insecure-auth": "true",
+            },
+        )
+    )
+    layer.start()
+    try:
+        # auth would be bypassed by native snapshot answers — must decline
+        assert layer._native_front is None
+        resp = fetch(layer.port, path="/ready")
+        assert resp.startswith(b"HTTP/1.1 401")
+    finally:
+        layer.close()
+
+
+# -- tenants: parity through the multi-tenant mux ----------------------------
+
+
+@needs_native
+@pytest.mark.fleet
+def test_parity_tenants(tmp_path):
+    from fleet import FleetHarness
+
+    tenants = {
+        "acme": {"weight": 2.0, "slo_p99_ms": 500.0},
+        "bob": {"weight": 1.0, "slo_p99_ms": 500.0},
+    }
+    fn = FleetHarness(
+        1,
+        str(tmp_path / "native"),
+        bus_name="nf-ten-native",
+        overlay='oryx.serving.native.enabled = "true"',
+        tenants=tenants,
+    )
+    fp = FleetHarness(
+        1,
+        str(tmp_path / "python"),
+        bus_name="nf-ten-python",
+        overlay='oryx.serving.native.enabled = "false"',
+        tenants=tenants,
+    )
+    with fn, fp:
+        assert fn.replicas[0]._native_front is not None
+        assert fp.replicas[0]._native_front is None
+        for fleet in (fn, fp):
+            want = {
+                tid: fleet.publish_tenant(tid, metric=0.9) for tid in tenants
+            }
+            assert fleet.wait_tenants_converged(want, timeout=20.0)
+        np_, pp = fn.replicas[0].port, fp.replicas[0].port
+        fn.replicas[0]._native_front.push_control()
+
+        def parity(method, path, headers=None):
+            a = mask(fetch(np_, method, path, headers))
+            b = mask(fetch(pp, method, path, headers))
+            assert a == b, f"tenant divergence on {method} {path}\n{a!r}\n{b!r}"
+            return a
+
+        # path-scoped, header-scoped, and default-tenant forms
+        r = parity("GET", "/t/acme/probe/recommend/u1")
+        assert r.startswith(b"HTTP/1.1 200")
+        parity("GET", "/probe/recommend/u1", {"X-Oryx-Tenant": "bob"})
+        parity("GET", "/probe/recommend/u7")  # default tenant
+        parity("GET", "/t/nope/probe/recommend/u1")  # unknown tenant
+        parity("GET", "/t/acme/nope")
+        # tenant-scoped health snapshot stays identical too
+        parity("GET", "/t/acme/ready")
+
+
+# -- fleet acceptance: native front under rolling restart --------------------
+
+
+@needs_native
+@pytest.mark.fleet
+def test_native_fleet_rolling_restart_zero_downtime(tmp_path):
+    from fleet import FleetHarness
+
+    from oryx_tpu.loadgen import (
+        Action,
+        OpenLoopEngine,
+        PoissonProcess,
+        PowerLawUsers,
+        ScenarioRunner,
+    )
+
+    with FleetHarness(
+        2,
+        str(tmp_path),
+        bus_name="nf-fleet-restart",
+        overlay='oryx.serving.native.enabled = "true"',
+    ) as fleet:
+        for replica in fleet.replicas:
+            assert replica._native_front is not None
+        gen = fleet.publish(metric=0.90)
+        assert fleet.wait_converged(gen, timeout=15.0)
+
+        engine = OpenLoopEngine(
+            fleet.targets, template="/probe/recommend/u%d", readiness_poll_s=0.1
+        )
+        runner = ScenarioRunner(
+            [
+                Action(0.8, "restart", {"replica": 0, "drain_s": 5.0}),
+                Action(2.4, "restart", {"replica": 1, "drain_s": 5.0}),
+            ],
+            fleet.handlers(),
+        )
+        runner.start()
+        result = engine.run(
+            PoissonProcess(rate=40.0, seed=5), PowerLawUsers(10_000, seed=5), 5.0
+        )
+        runner.join(timeout=15.0)
+
+        assert not runner.errors, runner.errors
+        assert result.failed == 0, dict(result.error_kinds)
+        assert result.ok == result.offered > 0
+        # the restarted replicas came back with native fronts too
+        for replica in fleet.replicas:
+            assert replica._native_front is not None
+        assert fleet.wait_converged(gen, timeout=10.0)

@@ -55,8 +55,6 @@ import subprocess
 import sys
 import threading
 import time
-import urllib.error
-import urllib.request
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
@@ -66,6 +64,7 @@ from oryx_tpu import bus
 from oryx_tpu.bus import faultbus
 from oryx_tpu.bus.core import KeyMessage
 from oryx_tpu.common import config as C
+from oryx_tpu.loadgen import engine
 from oryx_tpu.loadgen import (
     OpenLoopEngine,
     Scenario,
@@ -87,13 +86,13 @@ UPDATE_TOPIC = "OryxUpdate"
 INPUT_TOPIC = "OryxInput"
 
 
+# persistent control-plane connections (keep-alive; thread-local inside)
+_client = engine.KeepAliveClient(timeout_s=10.0)
+
+
 def _http(method: str, url: str, timeout: float = 10.0):
-    req = urllib.request.Request(url, method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.read()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read()
+    status, _, body, _ = _client.request(url, method=method, timeout=timeout)
+    return status, body
 
 
 class FleetHarness:
