@@ -56,8 +56,10 @@ ORYX_BENCH_ONLY (comma list of metric names); ORYX_BENCH_ATTEMPTS,
 ORYX_BENCH_INIT_TIMEOUT; ORYX_BENCH_TRIALS / ORYX_BENCH_TRIALS_CHEAP
 (noise protocol, default 3/5); ORYX_BENCH_CL_USERS/CL_SECONDS
 (closed-loop serving); ORYX_BENCH_TRACE_PREFILL/ITEMS/SECONDS/ENVELOPE
-(tracing-overhead); ORYX_TB_* (training shapes, see
-tools/train_benchmark.py).
+(tracing-overhead); ORYX_BENCH_MAINTAIN_ITEMS/FEATURES/SECONDS/INTERVAL/
+FRESH_BUDGET (live-maintenance ANN rows); ORYX_BENCH_COLD_ITEMS/COLD_RAM_MB
+(cold-tier store row, sized down to free disk); ORYX_TB_* (training
+shapes, see tools/train_benchmark.py).
 """
 
 import json
@@ -2614,6 +2616,366 @@ def bench_tenancy_overhead() -> None:
         )
 
 
+def bench_serving_maintain() -> None:
+    """Always-fresh ANN maintenance acceptance rows at the >=10M-item
+    shape: steady-state qps + per-dispatch p99 of the probed IVF scan
+    while a continuous fold-in stream AND the background IndexMaintainer
+    (snapshot -> compact_ivf -> install) run against the same index,
+    next to a no-maintenance baseline measured first on the same
+    catalog. Acceptance: p99 under maintenance within 1.5x the baseline
+    p99 (median AND best of >= 3 trials must miss before the row
+    hard-fails; a median-only miss is `noise-suspect` per the repo's
+    noise protocol), ZERO full re-clusters on any path (build_ivf is
+    wrapped and counted for the whole measured window), plus a
+    freshness-seconds row (fold-in -> clustered-visibility lag the
+    maintainer observed) and a recall@10 row against the exact f32
+    ranking over the union catalog after the final drain."""
+    import threading
+
+    import numpy as np
+
+    from oryx_tpu.common import metrics
+    from oryx_tpu.ops import ivf as ivf_ops
+    from oryx_tpu.serving import maintain as maintain_mod
+
+    items = int(os.environ.get("ORYX_BENCH_MAINTAIN_ITEMS", 10_000_000))
+    features = int(os.environ.get("ORYX_BENCH_MAINTAIN_FEATURES", 50))
+    batch = int(os.environ.get("ORYX_BENCH_ANN_BATCH", 256))
+    seconds = float(os.environ.get("ORYX_BENCH_MAINTAIN_SECONDS", 6.0))
+    interval = float(os.environ.get("ORYX_BENCH_MAINTAIN_INTERVAL", 1.0))
+    fold_rate = float(os.environ.get("ORYX_BENCH_MAINTAIN_RATE", 1000.0))
+    fresh_budget = float(os.environ.get("ORYX_BENCH_MAINTAIN_FRESH_BUDGET", 10.0))
+    how_many = 10
+    cells = max(64, int(round(items**0.5 / 8)) * 8)
+    nprobe = max(8, int(round(0.0025 * cells)))
+    label_m = f"{items // 1_000_000}M" if items >= 1_000_000 else f"{items // 1000}K"
+
+    mat, queries = _ann_mixture(items, features, cells, 7117, batch)
+    old_qb = ivf_ops.QUERY_BLOCK
+    ivf_ops.configure_ann(query_block=4)
+    t0 = time.perf_counter()
+    index = ivf_ops.build_ivf(mat, n_cells=cells, seed=7, overlay_capacity=2048)
+    build_sec = time.perf_counter() - t0
+    print(
+        f"bench[serving-maintain {features}f x {label_m}]: build_ivf "
+        f"{build_sec:.0f}s ({index.n_cells} cells, nprobe {nprobe})",
+        file=sys.stderr,
+    )
+
+    lock = threading.Lock()
+    holder = {"index": index}
+
+    class _OpsModel:
+        """ops-level maintenance protocol (the serving-model half of
+        serving/maintain.py's contract) over a plain index holder."""
+
+        def set_index_pressure_callback(self, cb):
+            self._cb = cb
+
+        def maintenance_snapshot(self, watermark, force=False):
+            with lock:
+                idx = holder["index"]
+                if not force and not ivf_ops.needs_maintenance(idx, watermark=watermark):
+                    return None
+                return idx, ivf_ops.snapshot_pending(idx)
+
+        def install_compacted(self, new_index, stats):
+            with lock:
+                cur = holder["index"]
+                snap_born = stats.get("born") or {}
+                feat = new_index.features
+                rids, raws = [], []
+                for item, slot in (cur.ov_map or {}).items():
+                    b = (cur.ov_born or {}).get(item, 0.0)
+                    if item not in snap_born or b > snap_born[item]:
+                        rids.append(item)
+                        raws.append(np.asarray(cur.ov_raw_host[slot][:feat], np.float32))
+                for item, (raw, b) in (cur.pending_spill or {}).items():
+                    if item not in snap_born or b > snap_born[item]:
+                        rids.append(item)
+                        raws.append(np.asarray(raw[:feat], np.float32))
+                if rids:
+                    new_index = ivf_ops.update_rows(
+                        new_index, np.asarray(rids, np.int64), np.stack(raws)
+                    )
+                    stats["replayed"] = len(rids)
+                holder["index"] = new_index
+                return True
+
+    def run_trials(tag: str) -> tuple[list, list, list]:
+        """(per-trial qps, per-trial p99 ms, all walls) over _TRIALS
+        `seconds`-long passes of batch dispatches on the live index."""
+        qps_t, p99_t, walls_all = [], [], []
+        ivf_ops.top_k(holder["index"], queries, how_many, nprobe=nprobe)  # warm
+        for _ in range(_TRIALS):
+            walls = []
+            start = time.perf_counter()
+            deadline = start + seconds
+            served = 0
+            while time.perf_counter() < deadline:
+                td = time.perf_counter()
+                ivf_ops.top_k(holder["index"], queries, how_many, nprobe=nprobe)
+                walls.append(time.perf_counter() - td)
+                served += batch
+            qps_t.append(served / (time.perf_counter() - start))
+            p99_t.append(float(np.percentile(np.array(walls) * 1000.0, 99)))
+            walls_all.extend(walls)
+        print(
+            f"bench[serving-maintain]: {tag} qps {statistics.median(qps_t):.0f}, "
+            f"p99 {statistics.median(p99_t):.1f} ms",
+            file=sys.stderr,
+        )
+        return qps_t, p99_t, walls_all
+
+    # phase A: no fold-ins, no maintainer — the baseline the 1.5x bound frames
+    base_qps_t, base_p99_t, _ = run_trials("baseline")
+    base_qps = statistics.median(base_qps_t)
+    base_p99 = statistics.median(base_p99_t)
+
+    # full-re-cluster tripwire: the request path and the maintenance loop
+    # must never call build_ivf during the measured window
+    real_build = ivf_ops.build_ivf
+    recluster = [0]
+
+    def counting_build(*a, **k):
+        recluster[0] += 1
+        return real_build(*a, **k)
+
+    ivf_ops.build_ivf = counting_build
+    folded_log: dict[int, np.ndarray] = {}
+    fresh_samples: list[float] = []
+    stop = threading.Event()
+    model = _OpsModel()
+    maint = maintain_mod.IndexMaintainer(
+        lambda: model, interval_sec=interval, watermark=0.5, seed=11
+    )
+
+    def fold_loop():
+        gen = np.random.default_rng(99)
+        next_id = len(mat)
+        seen = maint.compactions
+        while not stop.is_set():
+            vals = (
+                mat[gen.integers(0, len(mat), 64)]
+                + 0.1 * gen.standard_normal((64, features)).astype(np.float32)
+            ).astype(np.float32)
+            ids = np.arange(next_id, next_id + 64, dtype=np.int64)
+            next_id += 64
+            with lock:
+                holder["index"] = ivf_ops.update_rows(holder["index"], ids, vals)
+            for i, v in zip(ids.tolist(), vals):
+                folded_log[i] = v
+            if maint.compactions != seen:
+                seen = maint.compactions
+                fresh_samples.append(
+                    metrics.registry.gauge(maintain_mod.FRESHNESS_GAUGE).value
+                )
+            stop.wait(64.0 / fold_rate)
+
+    folder = threading.Thread(target=fold_loop, daemon=True)
+    maint.start()
+    folder.start()
+    try:
+        m_qps_t, m_p99_t, _ = run_trials("under maintenance")
+    finally:
+        stop.set()
+        folder.join(timeout=10)
+        maint.close()
+        ivf_ops.build_ivf = real_build
+    # final forced drain so the recall row sees every fold-in clustered
+    maint.run_once(force=True)
+    if maint.last_stats and maint.last_stats.get("born"):
+        fresh_samples.append(metrics.registry.gauge(maintain_mod.FRESHNESS_GAUGE).value)
+    ivf_ops.configure_ann(query_block=old_qb)
+
+    m_p99 = statistics.median(m_p99_t)
+    ratio = m_p99 / max(base_p99, 1e-9)
+    best_ratio = min(m_p99_t) / max(base_p99, 1e-9)
+    # the 1.5x bound presumes a spare core for the background compaction
+    # (the design's deployment shape); on a single-core host the OS
+    # time-slices compaction against the scan, so the row records the
+    # honest ratio but only multi-core hosts hard-fail on it
+    cores = os.cpu_count() or 1
+    detail = (
+        f"p99 {m_p99:.1f} ms under maintenance vs {base_p99:.1f} ms baseline "
+        f"({ratio:.2f}x, bound 1.5x"
+        f"{' — advisory: single-core host' if cores < 2 else ''}), "
+        f"{maint.compactions} compactions, ~{fold_rate:.0f} items/s folded "
+        f"({len(folded_log)} total), {recluster[0]} full re-clusters "
+        f"(must be 0), {_TRIALS} x {seconds:.0f}s trials"
+    )
+    print(f"bench[serving-maintain]: {detail}", file=sys.stderr)
+    _emit(
+        f"ALS /recommend ANN p99 under live maintenance, {features}f x "
+        f"{label_m} items, vs 1.5x no-maintenance p99",
+        m_p99,
+        "ms",
+        1.5 * base_p99 / max(m_p99, 1e-9),
+        order=84,
+        detail=detail,
+        base_p99_ms=round(base_p99, 2),
+        compactions=maint.compactions,
+        folded=len(folded_log),
+        recluster_calls=recluster[0],
+        noise_suspect=ratio > 1.5 >= best_ratio,
+        trials=_TRIALS,
+        spread=[round(min(m_p99_t), 2), round(max(m_p99_t), 2)],
+    )
+    qps, vs, tf = _rate_row(m_qps_t, base_qps)
+    _emit(
+        f"ALS /recommend ANN steady-state qps under live maintenance, "
+        f"{features}f x {label_m} items, vs no-maintenance qps",
+        qps,
+        "queries/sec",
+        vs,
+        order=85,
+        detail=f"baseline {base_qps:.0f} qps on the same catalog",
+        base_qps=round(base_qps, 1),
+        **tf,
+    )
+    if fresh_samples:
+        fr = statistics.median(fresh_samples)
+        _emit(
+            f"ANN freshness under continuous fold-ins, {features}f x {label_m} "
+            f"items, vs {fresh_budget:.0f}s budget",
+            fr,
+            "seconds",
+            fresh_budget / max(fr, 1e-9),
+            order=85,
+            detail=f"fold-in -> clustered-visibility lag at each of "
+            f"{len(fresh_samples)} compactions, maintain interval {interval}s",
+            trials=len(fresh_samples),
+            spread=[round(min(fresh_samples), 3), round(max(fresh_samples), 3)],
+        )
+    # recall@10 vs the exact f32 ranking over the union catalog (truth
+    # computed per-probe: base-matrix scores + folded-row scores merged)
+    probes = min(16, batch)
+    fids = np.asarray(sorted(folded_log), np.int64)
+    fvals = np.stack([folded_log[i] for i in fids.tolist()]) if len(fids) else None
+    final = holder["index"]
+    aidx, _ = ivf_ops.top_k(final, queries[:probes], how_many, nprobe=nprobe)
+    hits = 0
+    for r in range(probes):
+        q = queries[r]
+        t_base = mat @ q
+        scores = np.concatenate([t_base, fvals @ q]) if fvals is not None else t_base
+        ids_all = (
+            np.concatenate([np.arange(len(mat), dtype=np.int64), fids])
+            if fvals is not None
+            else np.arange(len(mat), dtype=np.int64)
+        )
+        kth = np.partition(scores, -how_many)[-how_many]
+        truth = dict(zip(ids_all.tolist(), scores.tolist()))
+        got = [int(i) for i in np.asarray(aidx[r]) if int(i) >= 0]
+        hits += sum(1 for i in got if truth.get(i, -np.inf) >= kth - 1e-4)
+    recall = hits / (probes * how_many)
+    _emit(
+        f"ALS /recommend ANN recall after maintenance drain, {features}f x "
+        f"{label_m} items, vs 0.95 floor",
+        recall,
+        "recall@10",
+        recall / 0.95,
+        order=85,
+        detail=f"{probes} probes, nprobe {nprobe} of {final.n_cells} cells, "
+        f"union catalog = {len(mat)} built + {len(folded_log)} folded live, "
+        "tie-tolerant at 1e-4",
+        folded=len(folded_log),
+    )
+    if ratio > 1.5 and best_ratio > 1.5 and cores >= 2:
+        raise RuntimeError(
+            f"maintenance p99 {m_p99:.1f} ms breaches 1.5x baseline "
+            f"{base_p99:.1f} ms in every trial"
+        )
+    if recluster[0]:
+        raise RuntimeError(
+            f"{recluster[0]} full re-cluster(s) during the maintenance window"
+        )
+
+
+def bench_store_tier_cold() -> None:
+    """The 100M-item cold-tier capacity row, sized to free disk: the
+    tiered cell store holds a catalog far past host RAM as mmap'd disk
+    cells (int8-plane bytes per item), and the row measures sequential
+    cold-scan bandwidth through `read_cell` — disk -> pinned-RAM
+    promotion under a RAM budget that forces continuous LRU eviction, so
+    every pass stays cold like a worst-case probe storm."""
+    import shutil as _sh
+
+    import numpy as np
+
+    from oryx_tpu.native.store import make_tier_store
+
+    features = int(os.environ.get("ORYX_BENCH_MAINTAIN_FEATURES", 50))
+    target = int(os.environ.get("ORYX_BENCH_COLD_ITEMS", 100_000_000))
+    ram_budget = int(os.environ.get("ORYX_BENCH_COLD_RAM_MB", 256)) << 20
+    items_per_cell = 65_536
+    import tempfile
+
+    spill = tempfile.mkdtemp(prefix="oryx-bench-cold-")
+    free = _sh.disk_usage(spill).free
+    items = min(target, int(free * 0.4 / features))
+    n_cells = max(1, (items + items_per_cell - 1) // items_per_cell)
+    items = n_cells * items_per_cell
+    label_m = f"{items // 1_000_000}M" if items >= 1_000_000 else f"{items // 1000}K"
+    sized_down = items < target
+
+    st = make_tier_store(n_cells, ram_budget, spill)
+    try:
+        gen = np.random.default_rng(31)
+        # one random payload reused per cell: content is irrelevant to the
+        # mmap/LRU data path and generating the full catalog would bench
+        # the RNG, not the store
+        block = gen.integers(-127, 128, (items_per_cell, features)).astype(np.int8)
+        t0 = time.perf_counter()
+        for c in range(n_cells):
+            st.put_cell(c, block)
+        write_sec = time.perf_counter() - t0
+        total_bytes = n_cells * block.nbytes
+        print(
+            f"bench[store-tier]: {label_m} items / {n_cells} cells / "
+            f"{total_bytes / 1e9:.1f} GB written in {write_sec:.0f}s "
+            f"({'sized to disk' if sized_down else 'full target'})",
+            file=sys.stderr,
+        )
+        rates = []
+        for _ in range(_TRIALS):
+            t1 = time.perf_counter()
+            for c in range(n_cells):
+                buf = st.read_cell(c)
+                assert buf is not None
+            rates.append(total_bytes / (time.perf_counter() - t1) / 1e9)
+        gbps, vs, tf = _rate_row(rates, 0.5)
+        s = st.stats()
+        detail = (
+            f"{n_cells} cells x {items_per_cell} items x {features} B "
+            f"(int8 plane), RAM budget {ram_budget >> 20} MB "
+            f"({s['ram_cells']} cells resident), {s['demotions']} LRU "
+            f"demotions, {tf['trials']} sequential cold passes; "
+            f"{items / max(statistics.median(rates), 1e-9) / 1e9 * features:.1f}s "
+            "per full-catalog pass"
+        )
+        print(f"bench[store-tier]: {detail}", file=sys.stderr)
+        _emit(
+            f"tiered item store cold-tier scan, {label_m} items mmap'd on "
+            f"disk{' (sized to free disk)' if sized_down else ''}, "
+            "vs 0.5 GB/s floor",
+            gbps,
+            "GB/s",
+            vs,
+            order=83,
+            detail=detail,
+            items=items,
+            cells=n_cells,
+            disk_gb=round(total_bytes / 1e9, 2),
+            ram_cells=s["ram_cells"],
+            backend=f"host/{os.cpu_count()}-core",
+            **tf,
+        )
+    finally:
+        st.close()
+        _sh.rmtree(spill, ignore_errors=True)
+
+
 BENCHES = [
     ("kmeans", bench_kmeans),
     ("als", bench_als),
@@ -2628,6 +2990,8 @@ BENCHES = [
     ("rdf", bench_rdf),
     ("serving-large", bench_serving_large),
     ("serving-ann", bench_serving_ann),
+    ("serving-maintain", bench_serving_maintain),
+    ("store-tier", bench_store_tier_cold),
     ("serving-closed", bench_serving_closed_loop),
     ("serving-native", bench_native_front),
     ("serving-open", bench_serving_open_loop),

@@ -9,7 +9,8 @@ rejects any key the matching reference.conf block (the single source
 of truth for each knob set) does not declare.
 
 Linted prefixes:
-  oryx.serving.scan.ann   — ANN tier of the serving scan
+  oryx.serving.scan.ann   — ANN tier of the serving scan (incl. maintain.*)
+  oryx.serving.store.tier — tiered HBM/RAM/disk item store
   oryx.serving.ab         — online experiment traffic split (docs/experiments.md)
   oryx.serving.overload   — admission control / shed ladder
   oryx.fleet.autoscale    — predictive fleet autoscaler
@@ -44,6 +45,7 @@ LINTED_PREFIXES = (
     "oryx.serving.ab",
     "oryx.serving.native",
     "oryx.serving.overload",
+    "oryx.serving.store.tier",
     "oryx.speed.parse",
     "oryx.speed.pipeline",
     "oryx.tenancy",

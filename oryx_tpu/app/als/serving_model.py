@@ -106,6 +106,18 @@ class ALSServingModel(ServingModel):
         # whether membership may have shrunk (rotation) forcing a rebuild
         self._dirty_ids: set[str] = set()
         self._y_full_rebuild = True
+        # ANN maintenance handshake (serving/maintain.py): the build epoch
+        # bumps on every full rebuild/index swap so a compaction whose
+        # snapshot predates the current id space is discarded at install;
+        # the pressure callback wakes the maintainer when a fold-in batch
+        # crosses the overlay watermark or spills
+        self._y_build_epoch = 0
+        self._y_snapshot_epoch = -1
+        # bumps on every rotation (retain_recent_and_item_ids): an index
+        # adoption built from a pre-rotation store snapshot is discarded
+        self._y_rotation_epoch = 0
+        self._index_pressure_cb = None
+        self._index_generation: str | None = None
         # device copy of X (query matrix for index-submitted /recommend)
         self._x_ids: list[str] = []
         self._x_index: dict[str, int] = {}
@@ -249,6 +261,7 @@ class ALSServingModel(ServingModel):
         with self._cache_lock:
             self._y_dirty = True
             self._y_full_rebuild = True  # membership may have shrunk
+            self._y_rotation_epoch += 1
 
     def retain_recent_and_known_items(self, user_ids: set[str]) -> None:
         with self._known_lock.write():
@@ -275,22 +288,35 @@ class ALSServingModel(ServingModel):
             return False  # a dirty id has no vector anymore
         new_ids = [d for d in dirty if d not in self._y_index]
         if len(self._y_ids) + len(new_ids) > topn_ops.capacity(self._y_matrix):
-            return False
+            # an IVF index with a maintainer attached absorbs the growth:
+            # the overlay spills its oldest entries to the compaction
+            # queue instead of forcing a request-path re-cluster
+            if not (
+                isinstance(self._y_matrix, ivf_ops.IVFIndex)
+                and self._index_pressure_cb is not None
+            ):
+                return False
         for d in new_ids:  # append into the padded region
             self._y_index[d] = len(self._y_ids)
             self._y_ids.append(d)
         rows = np.fromiter(
             (self._y_index[d] for d in dirty), dtype=np.int32, count=len(dirty)
         )
-        try:
-            self._y_matrix = topn_ops.update_rows(
-                self._y_matrix, rows, vals, n_items=len(self._y_ids)
-            )
-        except ivf_ops.IVFOverlayFull:
-            # the ANN index's pending overlay is out of slots; fall back
-            # to a full rebuild, which re-clusters and re-buckets every
-            # accumulated fold-in into fresh cells
-            return False
+        # never raises on overflow: the IVF overlay degrades by spilling
+        # its oldest entries to the maintainer's pending queue, so the
+        # fold-in path stays O(batch) under any pressure — the background
+        # compaction (serving/maintain.py) drains the spill, woken here
+        # when the overlay crosses its watermark
+        self._y_matrix = topn_ops.update_rows(
+            self._y_matrix, rows, vals, n_items=len(self._y_ids)
+        )
+        cb = self._index_pressure_cb
+        if (
+            cb is not None
+            and isinstance(self._y_matrix, ivf_ops.IVFIndex)
+            and ivf_ops.needs_maintenance(self._y_matrix)
+        ):
+            cb()
         return True
 
     def _ensure_y_matrix(self, force: bool = False):
@@ -332,8 +358,12 @@ class ALSServingModel(ServingModel):
                             # into an IVF routing table. Rebuilds ride the
                             # same MODEL/UP topic path as the exact scan —
                             # in-between fold-ins stay visible through the
-                            # index's pending overlay (update_rows above)
-                            self._y_matrix = ivf_ops.build_ivf(mat)
+                            # index's pending overlay (update_rows above).
+                            # With tiering on, the host plane moves into
+                            # the HBM->RAM->disk cell store right here.
+                            self._y_matrix = ivf_ops.attach_tiered_plane(
+                                ivf_ops.build_ivf(mat)
+                            )
                         else:
                             self._y_matrix = topn_ops.upload(mat, dtype=dtype)
                     else:
@@ -344,6 +374,9 @@ class ALSServingModel(ServingModel):
                             self.lsh.partitions_for(mat) if len(ids) else None
                         )
                     self._y_full_rebuild = False
+                    # id space changed: in-flight compaction snapshots are
+                    # now stale and must be discarded at install
+                    self._y_build_epoch += 1
                 self._dirty_ids.clear()
                 self._y_dirty = False
                 self._y_built_at = now
@@ -631,6 +664,142 @@ class ALSServingModel(ServingModel):
             out.sort(key=lambda t: -t[1])
         return out[:how_many]
 
+    # -- ANN maintenance protocol (serving/maintain.py) ----------------------
+
+    def set_index_pressure_callback(self, cb) -> None:
+        """Wire the maintainer's wake-up: called (under the cache lock)
+        when a fold-in batch crosses the overlay watermark or spills."""
+        with self._cache_lock:
+            self._index_pressure_cb = cb
+
+    @property
+    def index_generation(self) -> str | None:
+        """The published index generation this model's layout came from,
+        or None when the clustering is locally built."""
+        return self._index_generation
+
+    def note_published_index(self, generation_id: str) -> None:
+        """This replica just PUBLISHED this generation (its installed
+        layout is the generation): dedup the self-delivery off the
+        update topic instead of rebuilding from our own centroids."""
+        with self._cache_lock:
+            self._index_generation = str(generation_id)
+
+    def maintenance_snapshot(self, watermark: float = 0.5, force: bool = False):
+        """(index, pending snapshot) for one background compaction pass,
+        or None when there is nothing to compact (no IVF index, a forced
+        rebuild pending, or overlay pressure below the watermark). The
+        snapshot deep-copies the overlay's raw rows under the cache lock
+        — O(overlay), never O(catalog) — so compaction runs off-lock
+        against stable inputs while fold-ins keep landing."""
+        with self._cache_lock:
+            idx = self._y_matrix
+            if not isinstance(idx, ivf_ops.IVFIndex):
+                return None
+            if self._y_full_rebuild:
+                return None  # rotation owns the next layout
+            if not force and not ivf_ops.needs_maintenance(idx, watermark=watermark):
+                return None
+            snap = ivf_ops.snapshot_pending(idx)
+            self._y_snapshot_epoch = self._y_build_epoch
+            return idx, snap
+
+    def install_compacted(self, new_index, stats: dict) -> bool:
+        """Swap a compacted index in (one pointer write under the cache
+        lock). Fold-ins that landed after the snapshot are replayed onto
+        the new layout first — detected by comparing each live overlay /
+        spill entry's fold-in time against the snapshot's — so no update
+        is lost across the swap. Returns False (result discarded) when a
+        full rebuild or rotation changed the id space mid-compaction."""
+        with self._cache_lock:
+            cur = self._y_matrix
+            if (
+                not isinstance(cur, ivf_ops.IVFIndex)
+                or self._y_full_rebuild
+                or self._y_build_epoch != self._y_snapshot_epoch
+            ):
+                return False
+            snap_born = stats.get("born") or {}
+            feat = cur.features
+            replay_ids: list[int] = []
+            replay_rows: list[np.ndarray] = []
+            if cur.ov_raw_host is not None:
+                cur_born = cur.ov_born or {}
+                for item, slot in cur.ov_map.items():
+                    b = cur_born.get(item, 0.0)
+                    if item not in snap_born or b > snap_born[item]:
+                        replay_ids.append(int(item))
+                        replay_rows.append(cur.ov_raw_host[slot, :feat].copy())
+            for item, (raw, b) in (cur.pending_spill or {}).items():
+                if item not in snap_born or b > snap_born[item]:
+                    replay_ids.append(int(item))
+                    replay_rows.append(np.asarray(raw)[:feat].copy())
+            if replay_ids:
+                new_index = ivf_ops.update_rows(
+                    new_index,
+                    np.asarray(replay_ids, np.int64),
+                    np.stack(replay_rows),
+                    n_items=len(self._y_ids),
+                )
+                stats["replayed"] = len(replay_ids)
+            self._y_matrix = new_index
+            self._y_snapshot_epoch = -1  # consumed
+            return True
+
+    def apply_index_generation(self, ref: str) -> bool:
+        """Adopt a published index generation (INDEX-REF): rebuild the
+        IVF layout over THIS replica's item store seeded with the
+        generation's centroids — same cell geometry fleet-wide without
+        shipping item planes — and swap with zero downtime (the build
+        runs off-lock; requests keep scanning the old index until one
+        pointer write under the cache lock). Returns True on swap."""
+        from oryx_tpu.serving import maintain as maintain_mod
+
+        loaded = maintain_mod.read_index_generation(ref)
+        if loaded is None:
+            return False
+        gid, manifest, cents = loaded
+        if self._index_generation == gid:
+            return False  # duplicate delivery
+        if int(manifest.get("features") or cents.shape[1]) != self.features:
+            log.warning(
+                "index generation %s features mismatch (%s != %d); skipped",
+                gid, manifest.get("features"), self.features,
+            )
+            return False
+        if self.lsh is not None or self.shard_items or self.score_dtype != "int8":
+            return False  # index generations only drive the IVF scan mode
+        with self._cache_lock:
+            rot0 = self._y_rotation_epoch
+            # ids dirty NOW are covered by the store snapshot below — the
+            # build includes their current values, so they stop being
+            # dirty once the swap lands (writes racing the build re-dirty)
+            dirty0 = set(self._dirty_ids)
+        ids, mat = self.y.to_matrix()
+        if not ivf_ops.ann_active(len(ids)):
+            return False
+        new = ivf_ops.attach_tiered_plane(ivf_ops.build_ivf(mat, centroids=cents))
+        with self._cache_lock:
+            if self._y_rotation_epoch != rot0:
+                # a rotation raced the build: its rebuild must win
+                # (membership may have shrunk since our store snapshot)
+                return False
+            self._y_ids = list(ids)
+            self._y_index = {id_: i for i, id_ in enumerate(ids)}
+            self._y_matrix = new
+            # built from the CURRENT store: any pending full rebuild is
+            # satisfied by this layout
+            self._y_full_rebuild = False
+            self._y_build_epoch += 1
+            self._y_snapshot_epoch = -1
+            self._y_built_at = time.monotonic()
+            # ids written after the to_matrix snapshot stay in _dirty_ids:
+            # the next refresh tick folds them into the fresh overlay
+            self._dirty_ids.difference_update(dirty0)
+            self._y_dirty = bool(self._dirty_ids)
+            self._index_generation = gid
+        return True
+
     def all_item_ids(self) -> list[str]:
         return self.y.ids()
 
@@ -791,6 +960,19 @@ class ALSServingModelManager(AbstractServingModelManager):
                         x_ids | set(self.model.all_user_ids())
                     )
                     self.model.set_expected(x_ids, y_ids)
+            elif key == "INDEX-REF":
+                # ANN index generation (serving/maintain.py): rebuild this
+                # replica's IVF layout seeded with the published centroids
+                # and swap with zero downtime; unusable refs are dropped
+                # (the local layout keeps serving)
+                if self.model is not None:
+                    try:
+                        self.model.apply_index_generation(message)
+                    except Exception:
+                        log.warning(
+                            "dropped unusable index generation %r", message,
+                            exc_info=True,
+                        )
             else:
                 raise ValueError(f"bad key {key}")
             self._consumed += 1

@@ -263,3 +263,21 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hf_stats.argtypes = [c.c_void_p, c.POINTER(c.c_uint64), c.c_int64, c.c_int]
     lib.hf_drain_trace.restype = c.c_int64
     lib.hf_drain_trace.argtypes = [c.c_void_p, u8p, c.c_int64]
+    # tiered cell store (ts_*): RAM->disk item-plane tiers + async
+    # prefetch (native/store.py TieredHostPlane owns the handle)
+    i64p = c.POINTER(c.c_int64)
+    lib.ts_create.restype = c.c_void_p
+    lib.ts_create.argtypes = [c.c_char_p, c.c_int64, c.c_int64, c.c_int64]
+    lib.ts_destroy.argtypes = [c.c_void_p]
+    lib.ts_put_cell.restype = c.c_int64
+    lib.ts_put_cell.argtypes = [c.c_void_p, c.c_int64, u8p, c.c_int64]
+    lib.ts_cell_bytes.restype = c.c_int64
+    lib.ts_cell_bytes.argtypes = [c.c_void_p, c.c_int64]
+    lib.ts_read_cell.restype = c.c_int64
+    lib.ts_read_cell.argtypes = [c.c_void_p, c.c_int64, u8p, c.c_int64]
+    lib.ts_prefetch.restype = c.c_int64
+    lib.ts_prefetch.argtypes = [c.c_void_p, i64p, c.c_int64]
+    lib.ts_residency.restype = c.c_int64
+    lib.ts_residency.argtypes = [c.c_void_p, i64p, c.c_int64]
+    lib.ts_stats.argtypes = [c.c_void_p, i64p]
+    lib.ts_drop_ram.argtypes = [c.c_void_p, c.c_int64]

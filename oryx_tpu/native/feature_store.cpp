@@ -14,13 +14,23 @@
 //
 // Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
 
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
 #include <charconv>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <functional>
+#include <list>
 #include <mutex>
 #include <shared_mutex>
 #include <string>
@@ -724,6 +734,324 @@ int64_t parse_float_csv(const char* buf, int64_t len, float* out, int64_t cap) {
     }
   }
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Tiered cell store (ts_*): the RAM->disk half of the serving layer's
+// three-tier item plane (docs/serving-scan.md). Cell blocks — the
+// cell-contiguous f32 slabs the IVF host scan gathers — live in one
+// append-only backing file mmap'd as the COLD tier; a byte-budgeted LRU
+// of malloc'd copies is the WARM tier; the HOT (device/HBM-standing)
+// tier is the Python-side ndarray cache in native/store.py. Reads promote
+// (disk -> RAM) and count hit/miss; ts_prefetch enqueues cells for a
+// background thread so probed cells stream RAM-ward ahead of the scan —
+// GIL-free, since ctypes releases the GIL around every call.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct TierStore {
+  std::string path;
+  int fd = -1;
+
+  // cell table + mapping, under one shared mutex (writes are rare: the
+  // maintainer re-tiers after compaction; reads/promotes dominate)
+  mutable std::shared_mutex mu;
+  struct CellRef {
+    int64_t off = -1;
+    int64_t bytes = 0;
+  };
+  std::vector<CellRef> cells;
+  int64_t file_bytes = 0;
+  uint8_t* map = nullptr;
+  int64_t map_bytes = 0;
+
+  // warm tier: cell id -> heap copy, LRU-evicted under a byte budget
+  std::mutex ram_mu;
+  std::unordered_map<int64_t, std::vector<uint8_t>> ram;
+  std::list<int64_t> lru;  // front = most recently touched
+  std::unordered_map<int64_t, std::list<int64_t>::iterator> lru_pos;
+  int64_t ram_budget = 0;
+  int64_t ram_bytes = 0;
+
+  std::atomic<int64_t> hits{0};
+  std::atomic<int64_t> misses{0};
+  std::atomic<int64_t> promotions{0};
+  std::atomic<int64_t> demotions{0};
+
+  // prefetch worker
+  std::thread worker;
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<int64_t> queue;
+  bool stop = false;
+};
+
+// caller holds no locks; copies cell bytes out of the mmap (growing the
+// mapping first if the cell was appended after the last map). Returns
+// bytes copied or -1.
+int64_t tier_disk_read(TierStore* t, int64_t cell, uint8_t* out, int64_t cap) {
+  std::shared_lock rlock(t->mu);
+  if (cell < 0 || cell >= static_cast<int64_t>(t->cells.size())) return -1;
+  TierStore::CellRef ref = t->cells[cell];
+  if (ref.off < 0) return -1;
+  if (ref.bytes > cap) return -1;
+  if (ref.off + ref.bytes > t->map_bytes) {
+    rlock.unlock();
+    std::unique_lock wlock(t->mu);
+    if (ref.off + ref.bytes > t->map_bytes) {  // re-check under the write lock
+      if (t->map != nullptr) munmap(t->map, t->map_bytes);
+      t->map = nullptr;
+      t->map_bytes = 0;
+      void* m = mmap(nullptr, t->file_bytes, PROT_READ, MAP_SHARED, t->fd, 0);
+      if (m == MAP_FAILED) return -1;
+      t->map = static_cast<uint8_t*>(m);
+      t->map_bytes = t->file_bytes;
+    }
+    std::memcpy(out, t->map + ref.off, ref.bytes);
+    return ref.bytes;
+  }
+  std::memcpy(out, t->map + ref.off, ref.bytes);
+  return ref.bytes;
+}
+
+// promote a cell into the warm tier (no-op if present); evicts LRU tail
+// cells past the byte budget. Returns 1 if the cell is RAM-resident on
+// exit.
+int tier_promote(TierStore* t, int64_t cell) {
+  {
+    std::lock_guard g(t->ram_mu);
+    auto it = t->ram.find(cell);
+    if (it != t->ram.end()) {
+      auto pos = t->lru_pos.find(cell);
+      t->lru.erase(pos->second);
+      t->lru.push_front(cell);
+      pos->second = t->lru.begin();
+      return 1;
+    }
+  }
+  int64_t bytes;
+  {
+    std::shared_lock rlock(t->mu);
+    if (cell < 0 || cell >= static_cast<int64_t>(t->cells.size())) return 0;
+    bytes = t->cells[cell].bytes;
+    if (t->cells[cell].off < 0) return 0;
+  }
+  if (bytes > t->ram_budget) return 0;  // would evict everything: skip
+  std::vector<uint8_t> buf(bytes);
+  if (tier_disk_read(t, cell, buf.data(), bytes) != bytes) return 0;
+  std::lock_guard g(t->ram_mu);
+  if (t->ram.count(cell)) return 1;  // raced another promote: keep theirs
+  while (t->ram_bytes + bytes > t->ram_budget && !t->lru.empty()) {
+    int64_t victim = t->lru.back();
+    t->lru.pop_back();
+    t->lru_pos.erase(victim);
+    auto vit = t->ram.find(victim);
+    t->ram_bytes -= static_cast<int64_t>(vit->second.size());
+    t->ram.erase(vit);
+    t->demotions.fetch_add(1, std::memory_order_relaxed);
+  }
+  t->ram_bytes += bytes;
+  t->ram.emplace(cell, std::move(buf));
+  t->lru.push_front(cell);
+  t->lru_pos[cell] = t->lru.begin();
+  t->promotions.fetch_add(1, std::memory_order_relaxed);
+  return 1;
+}
+
+void tier_worker(TierStore* t) {
+  for (;;) {
+    int64_t cell;
+    {
+      std::unique_lock lk(t->q_mu);
+      t->q_cv.wait(lk, [t] { return t->stop || !t->queue.empty(); });
+      if (t->stop) return;
+      cell = t->queue.front();
+      t->queue.pop_front();
+    }
+    tier_promote(t, cell);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_create(const char* dir, int64_t dir_len, int64_t n_cells,
+                int64_t ram_budget_bytes) {
+  if (n_cells <= 0 || ram_budget_bytes < 0) return nullptr;
+  auto* t = new TierStore();
+  t->path = std::string(dir, dir_len) + "/cells.bin";
+  t->fd = open(t->path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (t->fd < 0) {
+    delete t;
+    return nullptr;
+  }
+  t->cells.resize(n_cells);
+  t->ram_budget = ram_budget_bytes;
+  t->worker = std::thread(tier_worker, t);
+  return t;
+}
+
+void ts_destroy(void* p) {
+  auto* t = static_cast<TierStore*>(p);
+  if (t == nullptr) return;
+  {
+    std::lock_guard lk(t->q_mu);
+    t->stop = true;
+  }
+  t->q_cv.notify_all();
+  if (t->worker.joinable()) t->worker.join();
+  if (t->map != nullptr) munmap(t->map, t->map_bytes);
+  if (t->fd >= 0) {
+    close(t->fd);
+    unlink(t->path.c_str());
+  }
+  delete t;
+}
+
+// Append a cell block to the cold tier (the backing file). Rewriting a
+// cell appends fresh bytes and abandons the old extent — compaction
+// replaces the whole store, so the file never accretes past one
+// generation of churn. Returns 0, or -1 on I/O failure.
+int64_t ts_put_cell(void* p, int64_t cell, const uint8_t* data,
+                    int64_t nbytes) {
+  auto* t = static_cast<TierStore*>(p);
+  if (cell < 0 || nbytes < 0) return -1;
+  std::unique_lock wlock(t->mu);
+  if (cell >= static_cast<int64_t>(t->cells.size())) return -1;
+  int64_t off = t->file_bytes;
+  int64_t done = 0;
+  while (done < nbytes) {
+    ssize_t w = pwrite(t->fd, data + done, nbytes - done, off + done);
+    if (w <= 0) {
+      if (w < 0 && errno == EINTR) continue;
+      return -1;
+    }
+    done += w;
+  }
+  t->cells[cell] = {off, nbytes};
+  t->file_bytes = off + nbytes;
+  // drop any stale warm copy (readers must see the new bytes)
+  std::lock_guard g(t->ram_mu);
+  auto it = t->ram.find(cell);
+  if (it != t->ram.end()) {
+    t->ram_bytes -= static_cast<int64_t>(it->second.size());
+    t->ram.erase(it);
+    auto pos = t->lru_pos.find(cell);
+    t->lru.erase(pos->second);
+    t->lru_pos.erase(pos);
+  }
+  return 0;
+}
+
+int64_t ts_cell_bytes(void* p, int64_t cell) {
+  auto* t = static_cast<TierStore*>(p);
+  std::shared_lock rlock(t->mu);
+  if (cell < 0 || cell >= static_cast<int64_t>(t->cells.size())) return -1;
+  if (t->cells[cell].off < 0) return -1;
+  return t->cells[cell].bytes;
+}
+
+// Read a cell into out[cap]: warm tier first (hit), else the mmap'd cold
+// tier (miss) with promotion so the next probe of this cell is a hit.
+// Returns bytes copied, or -1 (unknown cell / cap too small).
+int64_t ts_read_cell(void* p, int64_t cell, uint8_t* out, int64_t cap) {
+  auto* t = static_cast<TierStore*>(p);
+  {
+    std::lock_guard g(t->ram_mu);
+    auto it = t->ram.find(cell);
+    if (it != t->ram.end()) {
+      int64_t bytes = static_cast<int64_t>(it->second.size());
+      if (bytes > cap) return -1;
+      std::memcpy(out, it->second.data(), bytes);
+      auto pos = t->lru_pos.find(cell);
+      t->lru.erase(pos->second);
+      t->lru.push_front(cell);
+      pos->second = t->lru.begin();
+      t->hits.fetch_add(1, std::memory_order_relaxed);
+      return bytes;
+    }
+  }
+  int64_t bytes = tier_disk_read(t, cell, out, cap);
+  if (bytes < 0) return -1;
+  t->misses.fetch_add(1, std::memory_order_relaxed);
+  tier_promote(t, cell);
+  return bytes;
+}
+
+// Queue cells for background disk->RAM promotion; returns the number
+// actually enqueued (RAM-resident cells are skipped).
+int64_t ts_prefetch(void* p, const int64_t* cells, int64_t n) {
+  auto* t = static_cast<TierStore*>(p);
+  int64_t queued = 0;
+  {
+    std::lock_guard g(t->ram_mu);
+    std::lock_guard lk(t->q_mu);
+    for (int64_t i = 0; i < n; ++i) {
+      if (t->ram.count(cells[i])) continue;
+      t->queue.push_back(cells[i]);
+      ++queued;
+    }
+  }
+  if (queued) t->q_cv.notify_all();
+  return queued;
+}
+
+// Per-cell residency: 0 = no data, 1 = disk only, 2 = RAM. Returns the
+// cell count written (min(n_cells, cap)).
+int64_t ts_residency(void* p, int64_t* out, int64_t cap) {
+  auto* t = static_cast<TierStore*>(p);
+  std::shared_lock rlock(t->mu);
+  std::lock_guard g(t->ram_mu);
+  int64_t n = std::min<int64_t>(t->cells.size(), cap);
+  for (int64_t c = 0; c < n; ++c) {
+    if (t->cells[c].off < 0)
+      out[c] = 0;
+    else
+      out[c] = t->ram.count(c) ? 2 : 1;
+  }
+  return n;
+}
+
+// out8 = [ram_cells, disk_cells, hits, misses, promotions, demotions,
+//         ram_bytes, prefetch_queue_len]
+void ts_stats(void* p, int64_t* out8) {
+  auto* t = static_cast<TierStore*>(p);
+  int64_t disk = 0;
+  {
+    std::shared_lock rlock(t->mu);
+    for (const auto& c : t->cells)
+      if (c.off >= 0) ++disk;
+  }
+  {
+    std::lock_guard g(t->ram_mu);
+    out8[0] = static_cast<int64_t>(t->ram.size());
+    out8[6] = t->ram_bytes;
+  }
+  out8[1] = disk;
+  out8[2] = t->hits.load(std::memory_order_relaxed);
+  out8[3] = t->misses.load(std::memory_order_relaxed);
+  out8[4] = t->promotions.load(std::memory_order_relaxed);
+  out8[5] = t->demotions.load(std::memory_order_relaxed);
+  std::lock_guard lk(t->q_mu);
+  out8[7] = static_cast<int64_t>(t->queue.size());
+}
+
+// Demote a cell out of the warm tier (tests drive eviction directly).
+void ts_drop_ram(void* p, int64_t cell) {
+  auto* t = static_cast<TierStore*>(p);
+  std::lock_guard g(t->ram_mu);
+  auto it = t->ram.find(cell);
+  if (it == t->ram.end()) return;
+  t->ram_bytes -= static_cast<int64_t>(it->second.size());
+  t->ram.erase(it);
+  auto pos = t->lru_pos.find(cell);
+  t->lru.erase(pos->second);
+  t->lru_pos.erase(pos);
+  t->demotions.fetch_add(1, std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -17,6 +17,7 @@ from typing import Callable, Iterable
 
 import numpy as np
 
+from oryx_tpu.common import metrics
 from oryx_tpu.native import get_library
 
 
@@ -451,3 +452,507 @@ def make_feature_vectors(num_shards: int = 16):
     from oryx_tpu.app.als.common import FeatureVectors
 
     return FeatureVectors()
+
+
+# -- tiered HBM->RAM->disk cell plane -----------------------------------------
+#
+# Large-catalog mode for the IVF host plane: instead of one flat
+# [n_slots, kf] float32 array that must fit RAM, cells live in a
+# three-tier store — a small LRU of decoded ndarrays (the device/HBM
+# working set; on the CPU stage-1 path this is the set of cells handed
+# straight to BLAS), a byte-budgeted warm tier of pinned host-RAM
+# copies, and an mmap'd append-only disk file holding every cell. The
+# scan gathers probed tiles through ``TieredHostPlane.gather_tiles``;
+# the batcher calls ``IVFIndex.prefetch_for_queries`` while a group
+# assembles so disk->RAM promotion overlaps batching instead of
+# stalling the matmul. Backed by the GIL-free ts_* C++ store when the
+# native library is available, with a semantics-identical pure-Python
+# fallback (PyTieredCellStore) otherwise.
+
+# residency codes (ts_residency / PyTieredCellStore.residency)
+TIER_ABSENT = 0
+TIER_DISK = 1
+TIER_RAM = 2
+
+_TIER_LOCK = threading.Lock()
+_TIER_CONFIG = {
+    "enabled": False,
+    "hot_cells": 32,  # decoded-ndarray LRU entries (the "HBM" tier)
+    "ram_bytes": 256 << 20,  # warm-tier byte budget
+    "spill_dir": None,  # cold-tier directory; None -> per-plane tempdir
+}
+
+
+def configure_tier(
+    enabled: bool | None = None,
+    hot_cells: int | None = None,
+    ram_bytes: int | None = None,
+    spill_dir: str | None = None,
+) -> dict:
+    """Set the tiered-store knobs (oryx.serving.store.tier.* in
+    reference.conf); None leaves a knob unchanged. Returns the resulting
+    config. Applies to planes built afterwards — live planes keep the
+    budgets they were created with."""
+    with _TIER_LOCK:
+        if enabled is not None:
+            _TIER_CONFIG["enabled"] = bool(enabled)
+        if hot_cells is not None:
+            _TIER_CONFIG["hot_cells"] = max(1, int(hot_cells))
+        if ram_bytes is not None:
+            _TIER_CONFIG["ram_bytes"] = max(0, int(ram_bytes))
+        if spill_dir is not None:
+            _TIER_CONFIG["spill_dir"] = str(spill_dir) or None
+        return dict(_TIER_CONFIG)
+
+
+def tier_config() -> dict:
+    with _TIER_LOCK:
+        return dict(_TIER_CONFIG)
+
+
+def tier_active() -> bool:
+    """Should newly built IVF host planes move into the tiered store?"""
+    with _TIER_LOCK:
+        return bool(_TIER_CONFIG["enabled"])
+
+
+class NativeTieredCellStore:
+    """ctypes wrapper for the ts_* two-tier (RAM + disk) cell store."""
+
+    def __init__(self, n_cells: int, ram_budget_bytes: int, directory: str):
+        self._lib = get_library()
+        if self._lib is None:  # pragma: no cover - caller checks first
+            raise RuntimeError("native library unavailable")
+        self._n_cells = int(n_cells)
+        d = directory.encode("utf-8")
+        self._ptr = self._lib.ts_create(
+            d, len(d), self._n_cells, int(ram_budget_bytes)
+        )
+        if not self._ptr:
+            raise RuntimeError(f"ts_create failed for {directory}")
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        self.close()
+
+    def close(self) -> None:
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr and self._lib is not None:
+            self._lib.ts_destroy(ptr)
+
+    def put_cell(self, cell: int, data: np.ndarray) -> None:
+        buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+        rc = self._lib.ts_put_cell(
+            self._ptr,
+            int(cell),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            buf.nbytes,
+        )
+        if rc < 0:
+            raise ValueError(f"ts_put_cell({cell}) failed")
+
+    def cell_bytes(self, cell: int) -> int:
+        return int(self._lib.ts_cell_bytes(self._ptr, int(cell)))
+
+    def read_cell(self, cell: int) -> np.ndarray | None:
+        """Cell payload as a fresh uint8 array (RAM hit or disk read +
+        warm-tier promotion), or None when the cell was never written."""
+        nbytes = self.cell_bytes(cell)
+        if nbytes < 0:
+            return None
+        out = np.empty(nbytes, dtype=np.uint8)
+        got = self._lib.ts_read_cell(
+            self._ptr,
+            int(cell),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            nbytes,
+        )
+        return out if got == nbytes else None
+
+    def prefetch(self, cells: np.ndarray) -> int:
+        arr = np.ascontiguousarray(cells, dtype=np.int64)
+        if not len(arr):
+            return 0
+        return int(
+            self._lib.ts_prefetch(
+                self._ptr,
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(arr),
+            )
+        )
+
+    def residency(self) -> np.ndarray:
+        out = np.zeros(self._n_cells, dtype=np.int64)
+        self._lib.ts_residency(
+            self._ptr,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._n_cells,
+        )
+        return out
+
+    def stats(self) -> dict:
+        out = np.zeros(8, dtype=np.int64)
+        self._lib.ts_stats(
+            self._ptr, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        )
+        keys = (
+            "ram_cells", "disk_cells", "hits", "misses",
+            "promotions", "demotions", "ram_bytes", "queue_len",
+        )
+        return dict(zip(keys, out.tolist()))
+
+    def drop_ram(self, cell: int) -> None:
+        self._lib.ts_drop_ram(self._ptr, int(cell))
+
+
+class PyTieredCellStore:
+    """Pure-Python fallback with the ts_* semantics: append-only disk
+    file + byte-budgeted LRU warm tier + background prefetch thread.
+    Same counters, same residency codes — the tier tests run both."""
+
+    def __init__(self, n_cells: int, ram_budget_bytes: int, directory: str):
+        self._path = os.path.join(directory, "cells.bin")
+        self._fd = os.open(self._path, os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o644)
+        self._n_cells = int(n_cells)
+        self._off: list[tuple[int, int]] = [(-1, 0)] * self._n_cells
+        self._file_bytes = 0
+        self._budget = int(ram_budget_bytes)
+        self._mu = threading.Lock()  # offsets + warm tier + counters
+        self._ram: dict[int, bytes] = {}  # insertion order == LRU order
+        self._ram_bytes = 0
+        self._hits = self._misses = 0
+        self._promotions = self._demotions = 0
+        self._q: list[int] = []
+        self._cv = threading.Condition()
+        self._stopped = False
+        self._worker = threading.Thread(
+            target=self._run, name="py-tier-prefetch", daemon=True
+        )
+        self._worker.start()
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        self.close()
+
+    def close(self) -> None:
+        with self._cv:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._cv.notify_all()
+        self._worker.join(timeout=5)
+        with self._mu:
+            fd, self._fd = self._fd, -1
+        if fd >= 0:
+            os.close(fd)
+            try:
+                os.unlink(self._path)
+            except OSError:  # pragma: no cover - already swept
+                pass
+
+    # -- warm-tier internals (caller holds self._mu) --------------------------
+
+    def _promote_locked(self, cell: int, data: bytes) -> None:
+        if cell in self._ram:
+            self._ram[cell] = self._ram.pop(cell)  # LRU touch
+            return
+        self._ram[cell] = data
+        self._ram_bytes += len(data)
+        self._promotions += 1
+        while self._ram_bytes > self._budget and len(self._ram) > 1:
+            old, buf = next(iter(self._ram.items()))
+            del self._ram[old]
+            self._ram_bytes -= len(buf)
+            self._demotions += 1
+
+    def _pread(self, cell: int) -> bytes | None:
+        off, nbytes = self._off[cell]
+        if off < 0 or self._fd < 0:
+            return None
+        return os.pread(self._fd, nbytes, off)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                cell = self._q.pop(0)
+            with self._mu:
+                if cell in self._ram:
+                    continue
+                data = self._pread(cell)
+                if data is not None:
+                    self._promote_locked(cell, data)
+
+    # -- ts_* surface ---------------------------------------------------------
+
+    def put_cell(self, cell: int, data: np.ndarray) -> None:
+        buf = np.ascontiguousarray(data).view(np.uint8).reshape(-1).tobytes()
+        with self._mu:
+            if not 0 <= cell < self._n_cells:
+                raise ValueError(f"cell {cell} out of range")
+            os.pwrite(self._fd, buf, self._file_bytes)
+            self._off[cell] = (self._file_bytes, len(buf))
+            self._file_bytes += len(buf)
+            stale = self._ram.pop(cell, None)  # rewritten: drop stale copy
+            if stale is not None:
+                self._ram_bytes -= len(stale)
+
+    def cell_bytes(self, cell: int) -> int:
+        with self._mu:
+            if not 0 <= cell < self._n_cells:
+                return -1
+            off, nbytes = self._off[cell]
+            return nbytes if off >= 0 else -1
+
+    def read_cell(self, cell: int) -> np.ndarray | None:
+        with self._mu:
+            data = self._ram.get(cell)
+            if data is not None:
+                self._hits += 1
+                self._ram[cell] = self._ram.pop(cell)  # LRU touch
+            else:
+                data = self._pread(cell)
+                if data is None:
+                    return None
+                self._misses += 1
+                self._promote_locked(cell, data)
+        return np.frombuffer(data, dtype=np.uint8).copy()
+
+    def prefetch(self, cells: np.ndarray) -> int:
+        queued = 0
+        with self._mu:
+            want = [int(c) for c in np.asarray(cells).tolist() if c not in self._ram]
+        if not want:
+            return 0
+        with self._cv:
+            for c in want:
+                if c not in self._q:
+                    self._q.append(c)
+                    queued += 1
+            self._cv.notify()
+        return queued
+
+    def residency(self) -> np.ndarray:
+        out = np.zeros(self._n_cells, dtype=np.int64)
+        with self._mu:
+            for c in range(self._n_cells):
+                if self._off[c][0] < 0:
+                    out[c] = TIER_ABSENT
+                else:
+                    out[c] = TIER_RAM if c in self._ram else TIER_DISK
+        return out
+
+    def stats(self) -> dict:
+        with self._mu:
+            disk = sum(1 for off, _ in self._off if off >= 0)
+            snap = {
+                "ram_cells": len(self._ram),
+                "disk_cells": disk,
+                "hits": self._hits,
+                "misses": self._misses,
+                "promotions": self._promotions,
+                "demotions": self._demotions,
+                "ram_bytes": self._ram_bytes,
+            }
+        with self._cv:
+            snap["queue_len"] = len(self._q)
+        return snap
+
+    def drop_ram(self, cell: int) -> None:
+        with self._mu:
+            buf = self._ram.pop(cell, None)
+            if buf is not None:
+                self._ram_bytes -= len(buf)
+                self._demotions += 1
+
+
+def make_tier_store(n_cells: int, ram_budget_bytes: int, directory: str):
+    """Native ts_* store when the library is available, else the
+    pure-Python fallback — same surface either way."""
+    os.makedirs(directory, exist_ok=True)
+    if get_library() is not None:
+        return NativeTieredCellStore(n_cells, ram_budget_bytes, directory)
+    return PyTieredCellStore(n_cells, ram_budget_bytes, directory)
+
+
+class TieredHostPlane:
+    """IVF host stage-1 plane served out of the tiered cell store.
+
+    Holds the per-cell geometry (tile_start/tile_count in tile units),
+    a decoded-ndarray LRU (the hot tier: cells handed straight to the
+    BLAS gather, sized in cells), the routing arrays the batcher's
+    prefetch hint needs, and the underlying cell store. ``gather_tiles``
+    is the scan-path entry point — drop-in for the flat
+    ``plane3[tl].reshape(-1, kf)`` block take in ``ivf._host_topk``.
+    """
+
+    def __init__(
+        self,
+        store,
+        *,
+        tile_start: np.ndarray,
+        tile_count: np.ndarray,
+        tile_slots: int,
+        kf: int,
+        centroids: np.ndarray,
+        centroid_norms: np.ndarray,
+        hot_cells: int,
+        spill_dir: str,
+        owns_dir: bool,
+    ):
+        self._store = store
+        self._tile_start = np.asarray(tile_start, np.int64)
+        self._tile_count = np.asarray(tile_count, np.int64)
+        self._ts = int(tile_slots)
+        self._kf = int(kf)
+        self._cent = np.ascontiguousarray(centroids, np.float32)
+        self._cnorms = np.asarray(centroid_norms, np.float32)
+        self._hot_cap = max(1, int(hot_cells))
+        self._hot: dict[int, np.ndarray] = {}  # insertion order == LRU
+        self._mu = threading.Lock()
+        self._spill_dir = spill_dir
+        self._owns_dir = owns_dir
+        n_tiles = int((self._tile_start + self._tile_count).max(initial=0))
+        # tile -> owning cell (cells are tile-contiguous by construction)
+        self._tile_cell = np.full(n_tiles, -1, np.int64)
+        for c in range(len(self._tile_start)):
+            s, n = int(self._tile_start[c]), int(self._tile_count[c])
+            self._tile_cell[s : s + n] = c
+
+    @classmethod
+    def build(
+        cls,
+        host_plane: np.ndarray,
+        *,
+        tile_start: np.ndarray,
+        tile_count: np.ndarray,
+        tile_slots: int,
+        centroids: np.ndarray,
+        centroid_norms: np.ndarray,
+        store=None,
+        hot_cells: int | None = None,
+        ram_bytes: int | None = None,
+        spill_dir: str | None = None,
+    ) -> "TieredHostPlane":
+        """Spill a flat [n_slots, kf] host plane into the cell store,
+        cell by cell, and return the serving handle. Config knobs
+        default to ``configure_tier``'s current values; pass ``store``
+        to adopt a prebuilt one (tests)."""
+        cfg = tier_config()
+        hot = cfg["hot_cells"] if hot_cells is None else int(hot_cells)
+        budget = cfg["ram_bytes"] if ram_bytes is None else int(ram_bytes)
+        base = cfg["spill_dir"] if spill_dir is None else spill_dir
+        owns_dir = False
+        if store is None:
+            if base is None:
+                import tempfile
+
+                base = tempfile.mkdtemp(prefix="oryx-tier-")
+                owns_dir = True
+            else:
+                os.makedirs(base, exist_ok=True)
+            store = make_tier_store(len(tile_start), budget, base)
+        plane = np.ascontiguousarray(host_plane, np.float32)
+        kf = plane.shape[1]
+        ts = int(tile_slots)
+        starts = np.asarray(tile_start, np.int64)
+        counts = np.asarray(tile_count, np.int64)
+        for c in range(len(starts)):
+            if counts[c] <= 0:
+                continue
+            lo = int(starts[c]) * ts
+            hi = lo + int(counts[c]) * ts
+            store.put_cell(c, plane[lo:hi])
+        return cls(
+            store,
+            tile_start=starts,
+            tile_count=counts,
+            tile_slots=ts,
+            kf=kf,
+            centroids=centroids,
+            centroid_norms=centroid_norms,
+            hot_cells=hot,
+            spill_dir=base or "",
+            owns_dir=owns_dir,
+        )
+
+    # -- scan-path surface ----------------------------------------------------
+
+    def routing_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(centroids [kf_pad, n_cells] f32, norms [n_cells]) for the
+        batcher's host-side prefetch routing."""
+        return self._cent, self._cnorms
+
+    def _cell_array(self, cell: int) -> np.ndarray:
+        """Decoded [count*ts, kf] f32 for one cell: hot-LRU hit, or a
+        store read (RAM hit / disk promotion) + hot insert. Counts the
+        prefetch hit/miss outcome: a gather that finds the cell already
+        decoded or warm means the prefetch (or locality) won the race;
+        a disk read on the scan path means it lost."""
+        with self._mu:
+            arr = self._hot.get(cell)
+            if arr is not None:
+                self._hot[cell] = self._hot.pop(cell)  # LRU touch
+                metrics.registry.counter("serving.store.prefetch.hit").inc()
+                return arr
+        warm = self._store.residency()[cell] == TIER_RAM
+        buf = self._store.read_cell(cell)
+        if buf is None:  # pragma: no cover - geometry guarantees writes
+            raise KeyError(f"tier cell {cell} missing")
+        if warm:
+            metrics.registry.counter("serving.store.prefetch.hit").inc()
+        else:
+            metrics.registry.counter("serving.store.prefetch.miss").inc()
+        arr = buf.view(np.float32).reshape(-1, self._kf)
+        with self._mu:
+            self._hot[cell] = arr
+            while len(self._hot) > self._hot_cap:
+                del self._hot[next(iter(self._hot))]
+        return arr
+
+    def gather_tiles(self, tl) -> np.ndarray:
+        """Probed tiles as one [len(tl)*ts, kf] f32 slab (tile order
+        preserved — the caller's slot-id arrays line up row for row)."""
+        tl = np.asarray(tl, np.int64)
+        out = np.empty((len(tl) * self._ts, self._kf), np.float32)
+        for j, t in enumerate(tl.tolist()):
+            c = int(self._tile_cell[t])
+            arr = self._cell_array(c)
+            o = (t - int(self._tile_start[c])) * self._ts
+            out[j * self._ts : (j + 1) * self._ts] = arr[o : o + self._ts]
+        self._publish_gauges()
+        return out
+
+    def prefetch_cells(self, cells) -> int:
+        """Advisory disk->RAM promotion hint for probed cells (async;
+        the store's worker thread does the reads)."""
+        arr = np.asarray(cells, np.int64)
+        with self._mu:
+            cold = arr[[int(c) not in self._hot for c in arr.tolist()]]
+        n = self._store.prefetch(cold) if len(cold) else 0
+        self._publish_gauges()
+        return n
+
+    def _publish_gauges(self) -> None:
+        st = self._store.stats()
+        with self._mu:
+            hot = len(self._hot)
+        metrics.registry.gauge("serving.store.tier.hbm.cells").set(hot)
+        metrics.registry.gauge("serving.store.tier.ram.cells").set(st["ram_cells"])
+        metrics.registry.gauge("serving.store.tier.disk.cells").set(st["disk_cells"])
+
+    def stats(self) -> dict:
+        st = self._store.stats()
+        with self._mu:
+            st["hot_cells"] = len(self._hot)
+        return st
+
+    def close(self) -> None:
+        store, self._store = self._store, None
+        if store is not None:
+            store.close()
+        if self._owns_dir and self._spill_dir:
+            import shutil
+
+            shutil.rmtree(self._spill_dir, ignore_errors=True)

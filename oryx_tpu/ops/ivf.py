@@ -34,9 +34,23 @@ cell structure (their slot id is tombstoned) and land in a small
 device-resident overlay of dequantized rows that every query scans
 exactly and merges before the final top-k. The overlay holds the rows'
 two-plane DEQUANTIZED values, so overlay scores match a fresh upload's
-quantized scores to f32 rounding. A full overlay raises
-:class:`IVFOverlayFull`; callers rebuild the index (the serving model's
-full-rebuild path).
+quantized scores to f32 rounding. A full overlay never stalls the
+request path: the OLDEST overlay entries spill to a host-side pending
+queue (``pending_spill``) and their slots are reused — spilled rows go
+invisible until the next compaction folds them back into the clustered
+layout, a bounded-freshness trade instead of the old synchronous
+full re-cluster (:class:`IVFOverlayFull` is kept for compatibility but
+no longer raised here).
+
+Maintenance: ``compact_ivf`` folds the overlay + spill queue back into
+the cell-contiguous layout WITHOUT retraining the coarse quantizer —
+retained rows keep their quantized codes verbatim (per-row quantization
+is deterministic, so the compacted planes are bit-identical to a
+from-scratch build over the same item set), tombstoned slots are
+garbage-collected, oversized cells split via a local 2-means and
+undersized cells merge into their nearest surviving neighbour
+(SPFresh-style LIRE rebalancing, DiskANN-style background rebuild).
+``oryx_tpu/serving/maintain.py`` drives it off the request path.
 
 Exactness contract: with ``nprobe >= n_cells`` every cell is probed, the
 candidate set is the whole catalog ordered by ascending item id, and the
@@ -51,6 +65,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import time
 
 import jax
 import jax.numpy as jnp
@@ -148,7 +163,13 @@ def ann_active(n_items: int) -> bool:
 
 
 class IVFOverlayFull(RuntimeError):
-    """The pending-overlay list is out of slots: rebuild the index."""
+    """The pending-overlay list is out of slots.
+
+    Kept for API compatibility: since the spill queue landed,
+    ``update_rows`` degrades by spilling the oldest overlay entries to
+    ``pending_spill`` instead of raising — no caller sees this on the
+    request path anymore. Compaction (``compact_ivf``) drains the queue.
+    """
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -208,6 +229,24 @@ class IVFIndex:
     ov_rows_host: np.ndarray | None = None  # [cap, kf_pad] f32
     ov_ids_host: np.ndarray | None = None  # [cap] int32
     ov_norms_host: np.ndarray | None = None  # [cap] f32
+    # maintenance bookkeeping (host-side, mutated in place under the
+    # caller's serialization, like ov_map):
+    # RAW pre-quantization values of each overlay slot — compaction
+    # requantizes from these so the compacted codes are bit-identical to
+    # a from-scratch build over the same item set (requantizing the
+    # DEQUANTIZED overlay values would shift the per-row scale)
+    ov_raw_host: np.ndarray | None = None  # [cap, kf_pad] f32
+    # item id -> fold-in wall-clock seconds (freshness accounting)
+    ov_born: dict | None = None
+    # overlay-overflow spill queue: item id -> (raw row [kf_pad] f32,
+    # born seconds). Spilled rows are INVISIBLE to queries until the
+    # next compaction folds them back in — the bounded-freshness degrade
+    # that replaced the request-path full re-cluster.
+    pending_spill: dict | None = None
+    # optional tiered host plane (native/store.py TieredHostPlane): when
+    # set, host stage-1 gathers probed tiles through the HBM->RAM->disk
+    # cell store instead of the flat host_plane array
+    tier: object | None = None
 
     @property
     def n_cells(self) -> int:
@@ -231,6 +270,38 @@ class IVFIndex:
         if not p:
             p = int(round(PROBE_FRACTION * self.n_cells))
         return max(1, min(int(p), self.n_cells))
+
+    def prefetch_for_queries(
+        self, queries, nprobe: int | None = None, cosine: bool = False
+    ) -> int:
+        """Advisory async prefetch of the cells these queries will probe.
+
+        The batcher calls this while a scan group assembles (ahead of the
+        actual dispatch), so the tier store's disk->RAM copies overlap
+        with batching + routing instead of stalling the scan. Routing
+        here is a host-side numpy dot (exactness is irrelevant for a
+        prefetch hint; the scan re-routes on device). No-op without an
+        attached tier. Returns the number of cells hinted."""
+        tier = self.tier
+        if tier is None:
+            return 0
+        np_ = self.resolve_nprobe(nprobe)
+        if np_ >= self.n_cells:
+            return 0  # full probe touches everything; nothing to target
+        q = np.atleast_2d(np.asarray(queries, dtype=np.float32))
+        cent, cnorms = tier.routing_arrays()
+        qpad = np.zeros((q.shape[0], cent.shape[0]), np.float32)
+        qpad[:, : q.shape[1]] = q
+        sc = qpad @ cent
+        if cosine:
+            sc = sc / np.maximum(cnorms[None, :], 1e-12)
+        if np_ < sc.shape[1]:
+            part = np.argpartition(-sc, np_ - 1, axis=1)[:, :np_]
+        else:
+            part = np.broadcast_to(np.arange(sc.shape[1]), sc.shape)
+        hinted = np.unique(part)
+        tier.prefetch_cells(hinted)
+        return int(len(hinted))
 
 
 # -- build --------------------------------------------------------------------
@@ -282,6 +353,7 @@ def build_ivf(
     train_sample: int = 200_000,
     iterations: int = 8,
     overlay_capacity: int | None = None,
+    centroids: np.ndarray | None = None,
 ) -> IVFIndex:
     """Cluster, permute cell-contiguous, quantize, and ship to device.
 
@@ -290,6 +362,12 @@ def build_ivf(
     centroids in device blocks. Rows quantize with the exact scan's
     per-row rules, streamed in million-row slices so the host transient
     stays bounded at 10M+ items.
+
+    ``centroids`` short-circuits the training: the catalog lays out onto
+    the GIVEN [cells, feat] coarse quantizer (assignment + layout only,
+    no Lloyd iterations). This is how a replica swaps onto a published
+    index generation — every replica reproduces the maintainer's
+    clustering over its own item store without re-running kmeans.
     """
     mat = np.asarray(matrix, dtype=np.float32)
     n, feat = mat.shape
@@ -298,25 +376,33 @@ def build_ivf(
     chunk = max(8, int(pt._CHUNK))
     tile_chunks = max(1, TILE_CHUNKS)
     tile_slots = tile_chunks * chunk
-    cells = int(n_cells if n_cells is not None else (N_CELLS or round(math.sqrt(n))))
-    cells = max(1, min(cells, n))
+    if centroids is not None:
+        centers = np.ascontiguousarray(centroids, dtype=np.float32)[:, :feat]
+        cells = len(centers)
+    else:
+        cells = int(
+            n_cells if n_cells is not None else (N_CELLS or round(math.sqrt(n)))
+        )
+        cells = max(1, min(cells, n))
 
-    from oryx_tpu.ops.kmeans import train_kmeans
+        from oryx_tpu.ops.kmeans import train_kmeans
 
-    rng = np.random.default_rng(seed)
-    sample = (
-        mat[rng.choice(n, train_sample, replace=False)] if n > train_sample else mat
-    )
-    minibatch = 32_768 if len(sample) > 65_536 else None
-    centers, _counts, _cost = train_kmeans(
-        sample,
-        cells,
-        iterations=iterations,
-        init="k-means||",
-        seed=seed,
-        minibatch_size=minibatch,
-    )
-    centers = np.asarray(centers, dtype=np.float32)
+        rng = np.random.default_rng(seed)
+        sample = (
+            mat[rng.choice(n, train_sample, replace=False)]
+            if n > train_sample
+            else mat
+        )
+        minibatch = 32_768 if len(sample) > 65_536 else None
+        centers, _counts, _cost = train_kmeans(
+            sample,
+            cells,
+            iterations=iterations,
+            init="k-means||",
+            seed=seed,
+            minibatch_size=minibatch,
+        )
+        centers = np.asarray(centers, dtype=np.float32)
 
     assign = _assign_cells(mat, centers)
     order = np.argsort(assign, kind="stable")  # within-cell: ascending id
@@ -402,6 +488,9 @@ def build_ivf(
         ov_rows_host=np.zeros((cap, kf_pad), np.float32) if host1 else None,
         ov_ids_host=np.full((cap,), -1, np.int32) if host1 else None,
         ov_norms_host=np.zeros((cap,), np.float32) if host1 else None,
+        ov_raw_host=np.zeros((cap, kf_pad), np.float32),
+        ov_born={},
+        pending_spill={},
     )
 
 
@@ -486,7 +575,11 @@ def _host_topk(index: IVFIndex, qpad: np.ndarray, cells: np.ndarray, k: int, cos
     lists = _group_tile_lists(index, cells[order], g)
     ts = index.tile_chunks * index.chunk
     n_tiles = index.n_slots // ts
-    plane3 = index.host_plane.reshape(n_tiles, ts, kf)
+    # tiered plane: probed tiles gather through the HBM->RAM->disk cell
+    # store (promotion + residency tracked there); the flat array path
+    # stays the default. slot ids / norms are 8 B/slot — always RAM.
+    tier = index.tier
+    plane3 = None if tier is not None else index.host_plane.reshape(n_tiles, ts, kf)
     sids3 = index.slot_ids_host.reshape(n_tiles, ts)
     norms3 = index.norms_host.reshape(n_tiles, ts)
     used = index.ov_used
@@ -504,7 +597,10 @@ def _host_topk(index: IVFIndex, qpad: np.ndarray, cells: np.ndarray, k: int, cos
         rows = order[gi * g : (gi + 1) * g]
         qg = qpad[rows]
         if len(tl):
-            slab = plane3[tl].reshape(-1, kf)  # contiguous block take
+            if tier is not None:
+                slab = tier.gather_tiles(tl)  # [len(tl)*ts, kf] f32
+            else:
+                slab = plane3[tl].reshape(-1, kf)  # contiguous block take
             sc = slab @ qg.T  # [S, group] final-precision scores
             ssid = sids3[tl].reshape(-1).astype(np.int64)
             if cosine:
@@ -862,7 +958,11 @@ def top_k_device(
             cosine=cosine,
         )
     )
-    if index.host_plane is not None:
+    if index.host_plane is not None or index.tier is not None:
+        if index.tier is not None:
+            # issue the async disk->RAM copies for every probed cell
+            # before the group loop scans them in sequence
+            index.tier.prefetch_cells(np.unique(cells))
         vals_np, ids_np = _host_topk(index, qpad, cells, kk, cosine)
         return jnp.asarray(vals_np), jnp.asarray(ids_np)
     # probe-locality sort: queries sharing a best cell land in the same
@@ -973,8 +1073,15 @@ def update_rows(
     speed-layer fold-in is visible on the very next request regardless of
     which cells it routes to. Overlay rows store the two-plane
     DEQUANTIZED values (q1*s1 + q2*s2), so their scores match what a full
-    rebuild would serve to f32 rounding. Raises :class:`IVFOverlayFull`
-    when the overlay is out of slots (callers rebuild)."""
+    rebuild would serve to f32 rounding.
+
+    A full overlay DEGRADES instead of raising: the oldest overlay
+    entries are evicted to ``index.pending_spill`` (raw values + fold-in
+    time) and their slots reused, so the fold-in path stays O(batch)
+    regardless of pressure — the spilled rows go invisible until
+    ``compact_ivf`` folds them back. Re-updating an overlaid item
+    refreshes its recency (and its spill entry, if any, is superseded).
+    """
     rows = np.asarray(rows, dtype=np.int64)
     values = np.ascontiguousarray(np.atleast_2d(values), dtype=np.float32)
     if len(rows) == 0:
@@ -989,18 +1096,46 @@ def update_rows(
 
     cap = index.ov_rows.shape[0]
     ov_map = index.ov_map
+    spill = index.pending_spill if index.pending_spill is not None else {}
+    born = index.ov_born if index.ov_born is not None else {}
+    now = time.time()
     used = index.ov_used
     pos = np.empty(len(ids), np.int32)
     fresh = 0
     for i, item in enumerate(ids):
         item = int(item)
+        spill.pop(item, None)  # a fresh value supersedes any spilled one
         if item in ov_map:
-            pos[i] = ov_map[item]
+            # keep the slot but refresh recency (dict order = age order)
+            pos[i] = ov_map.pop(item)
+            ov_map[item] = int(pos[i])
+        elif used + fresh >= cap:
+            if ov_map:
+                # overlay full: evict the OLDEST entry to the spill queue
+                # and reuse its slot (the scatter below overwrites it)
+                old_id, old_slot = next(iter(ov_map.items()))
+                ov_map.pop(old_id)
+                if index.ov_raw_host is not None:
+                    spill[old_id] = (
+                        index.ov_raw_host[old_slot].copy(),
+                        born.pop(old_id, now),
+                    )
+                else:
+                    born.pop(old_id, None)
+                pos[i] = old_slot
+                ov_map[item] = int(old_slot)
+            else:
+                # every slot already belongs to THIS batch's fresh
+                # entries (they join ov_map only after the scatter): the
+                # incoming row spills directly — its raw value is right
+                # here in vals, no slot round-trip needed
+                if index.ov_raw_host is not None:
+                    raw = np.zeros(index.mat_t.shape[0], np.float32)
+                    raw[: vals.shape[1]] = vals[i]
+                    spill[item] = (raw, now)
+                born.pop(item, None)
+                pos[i] = -1
         else:
-            if used + fresh >= cap:
-                raise IVFOverlayFull(
-                    f"pending overlay full ({cap} rows): rebuild the IVF index"
-                )
             pos[i] = used + fresh
             fresh += 1
     dead = np.array(
@@ -1011,6 +1146,29 @@ def update_rows(
         ],
         dtype=np.int32,
     )
+
+    keep = pos >= 0
+    if not keep.all():
+        # direct-spilled rows skip the overlay scatter, but any base-slot
+        # versions of them still die (dead above covers them) and the
+        # host mirror forgets the base mapping so lookups go to the spill
+        for i in np.flatnonzero(~keep):
+            item = int(ids[i])
+            if item < len(index.id_to_slot):
+                index.id_to_slot[item] = -1
+        ids, vals, pos = ids[keep], vals[keep], pos[keep]
+        if len(ids) == 0:
+            if len(dead) and index.slot_ids_host is not None:
+                index.slot_ids_host[dead] = -1
+            slot_ids = index.slot_ids
+            if len(dead):
+                slot_ids = slot_ids.at[jnp.asarray(dead)].set(-1)
+            return dataclasses.replace(
+                index,
+                slot_ids=slot_ids,
+                n_items=max(count, index.n_items),
+                ov_used=used + fresh,
+            )
 
     q, s = pt._quantize_rows(vals)
     q2, s2 = pt._quantize_residual(vals, q, s)
@@ -1055,15 +1213,20 @@ def update_rows(
         ov_norms = ov_norms.at[pos_b].set(jnp.asarray(bucket(nrm.astype(np.float32))))
 
     # host bookkeeping (see class docstring: serialized by the caller)
-    if index.host_plane is not None:
+    if index.slot_ids_host is not None:
         if len(dead):
             index.slot_ids_host[dead] = -1  # tombstone in the host mirror
         index.ov_rows_host[pos] = deq_pad
         index.ov_ids_host[pos] = ids.astype(np.int32)
         index.ov_norms_host[pos] = nrm.astype(np.float32)
+    if index.ov_raw_host is not None:
+        raw_pad = np.zeros((len(ids), kf_pad), np.float32)
+        raw_pad[:, : vals.shape[1]] = vals
+        index.ov_raw_host[pos] = raw_pad
     for i, item in enumerate(ids):
         item = int(item)
         ov_map[item] = int(pos[i])
+        born[item] = now
         if item < len(index.id_to_slot):
             index.id_to_slot[item] = -1
     return dataclasses.replace(
@@ -1079,5 +1242,368 @@ def update_rows(
 
 def capacity(index: IVFIndex) -> int:
     """Rows the handle can represent without a rebuild: the built catalog
-    plus whatever overlay slots remain for appended items."""
+    plus whatever overlay slots remain for appended items. (With a
+    maintainer attached callers may exceed this — the overlay spills and
+    compaction absorbs the growth — but absent one this is the honest
+    always-visible bound.)"""
     return index.n_items + (index.ov_rows.shape[0] - index.ov_used)
+
+
+# -- maintenance (background compaction; serving/maintain.py drives) ----------
+
+
+@dataclasses.dataclass
+class PendingSnapshot:
+    """A consistent copy of everything compaction folds in: the overlay's
+    raw rows plus the spill queue, with per-item fold-in times."""
+
+    ids: np.ndarray  # [m] int64 item ids
+    raw: np.ndarray  # [m, kf_pad] f32 RAW (pre-quantization) values
+    born: dict  # item id -> fold-in wall-clock seconds
+    taken_at: float  # wall-clock seconds at snapshot
+
+
+def snapshot_pending(index: IVFIndex) -> PendingSnapshot:
+    """Copy the overlay + spill queue out of the index.
+
+    Call under the OWNER's serialization (the serving model's cache
+    lock): ``compact_ivf`` then runs entirely on immutable device arrays
+    plus these copies, so concurrent fold-ins mutating the live host
+    bookkeeping (``ov_map``/``ov_raw_host``/``pending_spill``) never race
+    the background compaction."""
+    ids: list[int] = []
+    rows: list[np.ndarray] = []
+    born: dict[int, float] = {}
+    src_born = index.ov_born or {}
+    now = time.time()
+    for item, slot in index.ov_map.items():
+        ids.append(item)
+        rows.append(index.ov_raw_host[slot].copy())
+        born[item] = src_born.get(item, now)
+    for item, (raw, b) in (index.pending_spill or {}).items():
+        ids.append(item)
+        rows.append(np.asarray(raw, dtype=np.float32))
+        born[item] = float(b)
+    kf_pad = index.mat_t.shape[0]
+    raw = (
+        np.vstack(rows).astype(np.float32, copy=False)
+        if rows
+        else np.zeros((0, kf_pad), np.float32)
+    )
+    return PendingSnapshot(np.asarray(ids, np.int64), raw, born, now)
+
+
+def needs_maintenance(index, watermark: float = 0.5) -> bool:
+    """Is it time to compact? True once anything spilled (those rows are
+    invisible until compaction) or the overlay passed the watermark."""
+    if not isinstance(index, IVFIndex):
+        return False
+    if index.pending_spill:
+        return True
+    cap = index.ov_rows.shape[0]
+    return index.ov_used >= max(1, int(float(watermark) * cap))
+
+
+def compact_ivf(
+    index: IVFIndex,
+    pending: PendingSnapshot | None = None,
+    *,
+    seed: int = 0,
+    split_max_items: int = 0,
+    merge_min_items: int = 0,
+) -> tuple[IVFIndex, dict]:
+    """Fold the overlay + spill queue into a fresh cell-contiguous layout
+    WITHOUT retraining the coarse quantizer (the no-stop-the-world
+    rebuild: SPFresh's LIRE rebalancing applied to the IVF tier).
+
+    - retained rows keep their quantized codes/scales/norms VERBATIM —
+      per-row quantization is deterministic, so the compacted planes are
+      bit-identical to a from-scratch ``build_ivf`` over the same item
+      set (the full-probe exactness contract transfers);
+    - pending rows quantize fresh from their RAW values and assign to
+      their nearest centroid;
+    - tombstoned slots are garbage-collected by omission;
+    - cells grown past ``split_max_items`` split via a local 2-means
+      (children replace the parent centroid); cells starved below
+      ``merge_min_items`` dissolve into their members' nearest surviving
+      centroid. Zero thresholds auto-derive from the mean cell load
+      (4x mean splits, mean/8 merges).
+
+    Returns ``(new_index, stats)``; the new index starts with an empty
+    overlay and spill queue. Runs on the caller's thread — the maintainer
+    calls it OFF the request path and swaps the result in under the
+    model's lock."""
+    if pending is None:
+        pending = snapshot_pending(index)
+    feat = index.features
+    chunk = index.chunk
+    tile_chunks = index.tile_chunks
+    ts = tile_chunks * chunk
+    cells0 = index.n_cells
+
+    # slot -> cell from the tile spans (cells are laid out contiguously
+    # from slot 0 in cell order; the trailing guard tile maps to no cell)
+    spans = (index.tile_count_host * ts).astype(np.int64)
+    slot_cell = np.full(index.n_slots, -1, np.int64)
+    slot_cell[: int(spans.sum())] = np.repeat(
+        np.arange(cells0, dtype=np.int64), spans
+    )
+
+    sids = np.asarray(index.slot_ids)
+    live = np.flatnonzero(sids >= 0)
+    r_ids = sids[live].astype(np.int64)
+    r_cell = slot_cell[live]
+    r_q = np.asarray(index.mat_rows)[live][:, :feat]
+    r_q2 = np.ascontiguousarray(np.asarray(index.resid)[:, live].T)[:, :feat]
+    r_s = np.asarray(index.scales)[0, live]
+    r_s2 = np.asarray(index.resid_scales)[0, live]
+    r_n = np.asarray(index.norms)[0, live]
+
+    centers = np.ascontiguousarray(np.asarray(index.centroids_t).T[:, :feat])
+    p_ids = pending.ids
+    if len(p_ids):
+        p_raw = np.ascontiguousarray(pending.raw[:, :feat])
+        p_cell = _assign_cells(p_raw, centers).astype(np.int64)
+        p_q, p_s = pt._quantize_rows(p_raw)
+        p_q2, p_s2 = pt._quantize_residual(p_raw, p_q, p_s)
+        p_n = np.linalg.norm(p_raw, axis=1)
+        ids = np.concatenate([r_ids, p_ids])
+        cell = np.concatenate([r_cell, p_cell])
+        q = np.vstack([r_q, p_q])
+        q2 = np.vstack([r_q2, p_q2])
+        s = np.concatenate([r_s, p_s])
+        s2 = np.concatenate([r_s2, p_s2])
+        nv = np.concatenate([r_n, p_n])
+    else:
+        ids, cell, q, q2, s, s2, nv = r_ids, r_cell, r_q, r_q2, r_s, r_s2, r_n
+    n = len(ids)
+    if n == 0:
+        raise ValueError("compaction would produce an empty index")
+
+    mean = max(1, n // max(1, cells0))
+    merge_min = int(merge_min_items) or max(1, mean // 8)
+    split_max = int(split_max_items) or max(mean * 4, merge_min + 1)
+
+    # -- merges: starved cells dissolve into their nearest survivor ------
+    counts = np.bincount(cell, minlength=cells0)
+    victims = np.flatnonzero(counts < merge_min)
+    merges = 0
+    if len(victims) == cells0:  # keep the fattest cell alive
+        victims = victims[victims != int(np.argmax(counts))]
+    if len(victims):
+        surv = np.setdiff1d(np.arange(cells0), victims, assume_unique=True)
+        remap = np.full(cells0, -1, np.int64)
+        remap[surv] = np.arange(len(surv))
+        cell = remap[cell]
+        mov = np.flatnonzero(cell < 0)
+        if len(mov):
+            deq = (
+                q[mov].astype(np.float32) * s[mov, None]
+                + q2[mov].astype(np.float32) * s2[mov, None]
+            )
+            cell[mov] = _assign_cells(deq, centers[surv]).astype(np.int64)
+        centers = np.ascontiguousarray(centers[surv])
+        merges = int(len(victims))
+
+    # -- splits: overloaded cells split via a local 2-means --------------
+    splits = 0
+    counts = np.bincount(cell, minlength=len(centers))
+    big = np.flatnonzero(counts > split_max)
+    if len(big):
+        from oryx_tpu.ops.kmeans import train_kmeans
+
+        order_c = np.argsort(cell, kind="stable")
+        bounds = np.zeros(len(centers) + 1, np.int64)
+        np.cumsum(counts, out=bounds[1:])
+        extra: list[np.ndarray] = []
+        for c in big:
+            mem = order_c[bounds[c] : bounds[c + 1]]
+            deq = (
+                q[mem].astype(np.float32) * s[mem, None]
+                + q2[mem].astype(np.float32) * s2[mem, None]
+            )
+            minibatch = 32_768 if len(deq) > 65_536 else None
+            sub_c, _cnt, _cost = train_kmeans(
+                deq,
+                2,
+                iterations=4,
+                init="k-means||",
+                seed=seed + 17 * int(c),
+                minibatch_size=minibatch,
+            )
+            sub_c = np.asarray(sub_c, dtype=np.float32)
+            half = _assign_cells(deq, sub_c)
+            if half.min() == half.max():
+                continue  # degenerate split (all rows one side): skip
+            centers[c] = sub_c[0]
+            cell[mem[half == 1]] = len(centers) + len(extra)
+            extra.append(sub_c[1])
+            splits += 1
+        if extra:
+            centers = np.vstack([centers] + [e[None, :] for e in extra])
+
+    n_items = max(index.n_items, int(ids.max()) + 1)
+    new_index = _assemble_layout(
+        ids,
+        cell,
+        centers,
+        q,
+        q2,
+        s,
+        s2,
+        nv,
+        feat=feat,
+        chunk=chunk,
+        tile_chunks=tile_chunks,
+        cap=index.ov_rows.shape[0],
+        n_items=n_items,
+        host1=index.slot_ids_host is not None,
+    )
+    stats = {
+        "folded": int(len(p_ids)),
+        "live": int(len(r_ids)),
+        "cells": int(len(centers)),
+        "splits": int(splits),
+        "merges": merges,
+        "born": dict(pending.born),
+        "taken_at": pending.taken_at,
+    }
+    return new_index, stats
+
+
+def _assemble_layout(
+    ids: np.ndarray,
+    cell: np.ndarray,
+    centers: np.ndarray,
+    q: np.ndarray,
+    q2: np.ndarray,
+    s: np.ndarray,
+    s2: np.ndarray,
+    norms_v: np.ndarray,
+    *,
+    feat: int,
+    chunk: int,
+    tile_chunks: int,
+    cap: int,
+    n_items: int,
+    host1: bool,
+) -> IVFIndex:
+    """Lay (ids, cell assignment, codes) out cell-contiguous and
+    tile-aligned — ``build_ivf``'s layout stage over PRE-QUANTIZED rows.
+    Within a cell items order by ascending id, preserving the exact
+    path's tie direction."""
+    n = len(ids)
+    cells = len(centers)
+    tile_slots = tile_chunks * chunk
+    order = np.lexsort((ids, cell))  # cell-major, ascending id within
+    counts = np.bincount(cell, minlength=cells).astype(np.int64)
+    chunk_counts = -(-counts // chunk)
+    tile_counts = -(-chunk_counts // tile_chunks)
+    spans = tile_counts * tile_slots
+    item_starts = np.zeros(cells + 1, np.int64)
+    np.cumsum(counts, out=item_starts[1:])
+    slot_base = np.zeros(cells + 1, np.int64)
+    np.cumsum(spans, out=slot_base[1:])
+    n_slots = int(slot_base[-1]) + tile_slots  # +1 guard tile
+    pos_in_cell = np.arange(n, dtype=np.int64) - np.repeat(
+        item_starts[:-1], counts
+    )
+    slots_sorted = np.repeat(slot_base[:-1], counts) + pos_in_cell
+
+    kf_pad = pt._ceil_to(feat, pt._INT8_FEAT_MULTIPLE)
+    mat_t = np.zeros((kf_pad, n_slots), np.int8)
+    resid = np.zeros((kf_pad, n_slots), np.int8)
+    mat_rows = np.zeros((n_slots, kf_pad), np.int8)
+    scales = np.ones((1, n_slots), np.float32)
+    rscales = np.ones((1, n_slots), np.float32)
+    norms = np.zeros((1, n_slots), np.float32)
+    slot_ids = np.full(n_slots, -1, np.int32)
+    slot_ids[slots_sorted] = ids[order].astype(np.int32)
+    id_to_slot = np.full(n_items, -1, np.int32)
+    id_to_slot[ids[order]] = slots_sorted.astype(np.int32)
+    host_plane = np.zeros((n_slots, kf_pad), np.float32) if host1 else None
+    slice_rows = 1_000_000  # bounds the host transient like build_ivf
+    for beg in range(0, n, slice_rows):
+        rows = order[beg : beg + slice_rows]
+        sl = slots_sorted[beg : beg + slice_rows]
+        qs_ = q[rows][:, :feat]
+        q2s_ = q2[rows][:, :feat]
+        ss_ = s[rows]
+        s2s_ = s2[rows]
+        mat_t[:feat, sl] = qs_.T
+        resid[:feat, sl] = q2s_.T
+        mat_rows[sl, :feat] = qs_
+        scales[0, sl] = ss_
+        rscales[0, sl] = s2s_
+        norms[0, sl] = norms_v[rows]
+        if host_plane is not None:
+            host_plane[sl, :feat] = (
+                qs_.astype(np.float32) * ss_[:, None]
+                + q2s_.astype(np.float32) * s2s_[:, None]
+            )
+
+    cent_t = np.zeros((kf_pad, cells), np.float32)
+    cent_t[:feat] = centers.T
+
+    return IVFIndex(
+        mat_t=jnp.asarray(mat_t),
+        resid=jnp.asarray(resid),
+        mat_rows=jnp.asarray(mat_rows),
+        scales=jnp.asarray(scales),
+        resid_scales=jnp.asarray(rscales),
+        norms=jnp.asarray(norms),
+        slot_ids=jnp.asarray(slot_ids),
+        centroids_t=jnp.asarray(cent_t),
+        centroid_norms=jnp.asarray(np.linalg.norm(centers, axis=1)),
+        chunk_start=jnp.asarray((slot_base[:-1] // chunk).astype(np.int32)),
+        chunk_count=jnp.asarray(chunk_counts.astype(np.int32)),
+        ov_rows=jnp.zeros((cap, kf_pad), jnp.float32),
+        ov_ids=jnp.full((cap,), -1, jnp.int32),
+        ov_norms=jnp.zeros((cap,), jnp.float32),
+        n_items=n_items,
+        features=feat,
+        chunk=chunk,
+        tile_chunks=tile_chunks,
+        chunk_count_host=chunk_counts,
+        tile_start_host=slot_base[:-1] // tile_slots,
+        tile_count_host=tile_counts,
+        id_to_slot=id_to_slot,
+        ov_map={},
+        ov_used=0,
+        host_plane=host_plane,
+        slot_ids_host=slot_ids.copy() if host1 else None,
+        norms_host=norms[0].copy() if host1 else None,
+        ov_rows_host=np.zeros((cap, kf_pad), np.float32) if host1 else None,
+        ov_ids_host=np.full((cap,), -1, np.int32) if host1 else None,
+        ov_norms_host=np.zeros((cap,), np.float32) if host1 else None,
+        ov_raw_host=np.zeros((cap, kf_pad), np.float32),
+        ov_born={},
+        pending_spill={},
+    )
+
+
+# -- tiered host plane (native/store.py) --------------------------------------
+
+
+def attach_tiered_plane(index: IVFIndex, plane=None) -> IVFIndex:
+    """Move the host stage-1 plane into the tiered HBM->RAM->disk cell
+    store. Returns a new handle with ``tier`` set and the flat
+    ``host_plane`` dropped (the hot tier's working set replaces it);
+    a no-op when tiering is off or the index has no host plane. Pass a
+    prebuilt ``plane`` to adopt one (tests)."""
+    if index.host_plane is None or index.tier is not None:
+        return index
+    if plane is None:
+        from oryx_tpu.native import store as fstore
+
+        if not fstore.tier_active():
+            return index
+        plane = fstore.TieredHostPlane.build(
+            index.host_plane,
+            tile_start=np.asarray(index.tile_start_host, np.int64),
+            tile_count=np.asarray(index.tile_count_host, np.int64),
+            tile_slots=index.tile_chunks * index.chunk,
+            centroids=np.ascontiguousarray(np.asarray(index.centroids_t)),
+            centroid_norms=np.asarray(index.centroid_norms),
+        )
+    return dataclasses.replace(index, tier=plane, host_plane=None)

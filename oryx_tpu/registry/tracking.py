@@ -45,11 +45,13 @@ from oryx_tpu.registry.store import generation_id_from_ref
 log = logging.getLogger(__name__)
 
 _MODEL_KEYS = (b"MODEL", b"MODEL-REF")
+_INDEX_KEY = b"INDEX-REF"
 
 LIVE_GENERATION_GAUGE = "serving.model.live-generation"
 CHALLENGER_GENERATION_GAUGE = "serving.model.challenger-generation"
 DUPLICATES_COUNTER = "serving.model.duplicates-suppressed"
 FLEET_SKEW_GAUGE = "serving.model.generation-skew"
+INDEX_GENERATION_GAUGE = "serving.index.generation"
 
 
 def record_fleet_skew(live_generations) -> int:
@@ -91,6 +93,10 @@ class GenerationTracker:
     def __init__(self, health=None, experiments=None) -> None:
         self.live_generation: str | None = None
         self.challenger_generation: str | None = None
+        # ANN index generations (serving/maintain.py) ride the same topic
+        # as INDEX-REF records and get the same duplicate suppression —
+        # an at-least-once redelivery must not re-trigger an index rebuild
+        self.live_index_generation: str | None = None
         self._health = health
         # ExperimentCoordinator (or any object with wants_challenger /
         # on_challenger); None keeps the single-generation behavior
@@ -111,6 +117,13 @@ class GenerationTracker:
             metrics.registry.gauge(CHALLENGER_GENERATION_GAUGE).set(int(generation_id))
         if self._experiments is not None:
             self._experiments.on_challenger(generation_id)
+
+    def _set_index(self, generation_id: str | None) -> None:
+        self.live_index_generation = generation_id
+        if self._health is not None:
+            self._health.live_index_generation = generation_id
+        if generation_id is not None and generation_id.isdigit():
+            metrics.registry.gauge(INDEX_GENERATION_GAUGE).set(int(generation_id))
 
     def promote_challenger(self) -> None:
         """The online gate promoted the challenger: it becomes the live
@@ -135,10 +148,22 @@ class GenerationTracker:
             return block
         keys = block.keys
         is_model = (keys == _MODEL_KEYS[0]) | (keys == _MODEL_KEYS[1])
-        if not bool(is_model.any()):
+        is_index = keys == _INDEX_KEY
+        if not bool(is_model.any()) and not bool(is_index.any()):
             return block
         keep = np.ones(len(block), dtype=bool)
         msgs = block.messages
+        for i in np.flatnonzero(is_index):
+            message = msgs[i].decode("utf-8", "replace")
+            generation = generation_id_from_ref(message)
+            if generation is not None and generation == self.live_index_generation:
+                keep[i] = False
+                metrics.registry.counter(DUPLICATES_COUNTER).inc()
+                log.info(
+                    "suppressed duplicate INDEX-REF for index generation %s", generation
+                )
+            else:
+                self._set_index(generation)
         for i in np.flatnonzero(is_model):
             key = keys[i].decode("utf-8", "replace")
             message = msgs[i].decode("utf-8", "replace")

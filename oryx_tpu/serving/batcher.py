@@ -602,6 +602,16 @@ class TopNBatcher:
                 return
             nprobe = self._group_nprobe(entries)
             queries = np.stack([e.query for e in entries])
+            # tiered item store: hint the cells this group will probe so
+            # the store's disk->RAM promotions overlap the dispatch below
+            # instead of stalling the stage-1 gather (advisory; no-op on
+            # flat-plane indexes)
+            prefetch = getattr(entries[0].uploaded, "prefetch_for_queries", None)
+            if prefetch is not None:
+                try:
+                    prefetch(queries, nprobe=nprobe, cosine=cosine)
+                except Exception:  # never let a hint fail a dispatch
+                    pass
             kk = _k_bucket(max(e.k for e in entries))
             if len(entries) > self.MULTI_THRESHOLD:
                 # fused multi-scan: pads to a multiple of scan_batch

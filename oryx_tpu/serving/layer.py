@@ -677,8 +677,41 @@ class ServingLayer:
                 ).lower()
             ),
         )
+        # background ANN maintenance loop (docs/serving-scan.md): the
+        # incremental overlay->clustered compaction + index-generation
+        # publication knobs ride the same ann config block
+        from oryx_tpu.serving.maintain import configure_maintain
+
+        configure_maintain(
+            enabled=config.get_optional_bool("oryx.serving.scan.ann.maintain.enabled"),
+            interval_sec=config.get_optional_float(
+                "oryx.serving.scan.ann.maintain.interval-sec"
+            ),
+            watermark=config.get_optional_float(
+                "oryx.serving.scan.ann.maintain.watermark"
+            ),
+            split_max_items=config.get_optional_int(
+                "oryx.serving.scan.ann.maintain.split-max-items"
+            ),
+            merge_min_items=config.get_optional_int(
+                "oryx.serving.scan.ann.maintain.merge-min-items"
+            ),
+            publish=config.get_optional_bool("oryx.serving.scan.ann.maintain.publish"),
+        )
+        # tiered HBM->RAM->disk item store (native/store.py): catalogs
+        # bigger than RAM keep serving out of the cell store
+        from oryx_tpu.native.store import configure_tier
+
+        tier_ram_mb = config.get_optional_int("oryx.serving.store.tier.ram-mb")
+        configure_tier(
+            enabled=config.get_optional_bool("oryx.serving.store.tier.enabled"),
+            hot_cells=config.get_optional_int("oryx.serving.store.tier.hot-cells"),
+            ram_bytes=None if tier_ram_mb is None else int(tier_ram_mb) << 20,
+            spill_dir=config.get_optional_string("oryx.serving.store.tier.spill-dir"),
+        )
 
         self.model_manager = None
+        self._index_maintainer = None
         self.input_producer = None
         self._update_consumer = None
         self._consume_thread: SupervisedThread | None = None
@@ -849,6 +882,44 @@ class ServingLayer:
                 )
                 self.health.consume_thread = self._consume_thread
                 self._consume_thread.start()
+
+        # background ANN index maintenance: compaction loop + (optional)
+        # index-generation publication over the update topic. Duck-typed
+        # on get_model so any manager whose models speak the maintenance
+        # protocol (app/als) gets the loop; others are left alone.
+        from oryx_tpu.serving import maintain as maintain_mod
+
+        if (
+            self.model_manager is not None
+            and maintain_mod.maintain_enabled()
+            and hasattr(self.model_manager, "get_model")
+        ):
+            publish_fn = None
+            if (
+                maintain_mod.MAINTAIN_PUBLISH
+                and self.registry_store is not None
+                and update_broker_loc
+                and update_topic
+            ):
+
+                def publish_fn(index, stats):
+                    ref = maintain_mod.write_index_generation(
+                        self.registry_store.model_dir, index, stats=stats
+                    )
+                    # shares the rollback path's lazy update-topic producer
+                    # (and its lock: publications serialize with rollbacks)
+                    with self._rollback_lock:
+                        if self._rollback_producer is None:
+                            self._rollback_producer = get_broker(
+                                update_broker_loc
+                            ).producer(update_topic)
+                        self._rollback_producer.send(maintain_mod.INDEX_REF_KEY, ref)
+                    return ref
+
+            self._index_maintainer = maintain_mod.IndexMaintainer(
+                self.model_manager.get_model, publish_fn=publish_fn
+            )
+            self._index_maintainer.start()
 
         rollback_publisher = None
         if self.registry_store is not None and update_broker_loc and update_topic:
@@ -1253,6 +1324,9 @@ class ServingLayer:
                             rt.thread.name,
                         )
                         metrics.registry.counter("layer.threads.leaked").inc()
+        if self._index_maintainer is not None:
+            # before the manager: the loop snapshots through get_model
+            self._index_maintainer.close()
         if self.model_manager is not None:
             self.model_manager.close()
         if self.experiments is not None:

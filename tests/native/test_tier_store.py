@@ -1,0 +1,278 @@
+"""Tiered HBM->RAM->disk cell store (native ts_* plane + the Python
+fallback): residency transitions, LRU eviction under the byte budget,
+async prefetch, and the `TieredHostPlane` serving surface — tiered
+gathers must be byte-identical to the flat host plane they replace, and
+the probed IVF scan must return bit-identical results either way."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from oryx_tpu.common import metrics
+from oryx_tpu.native import get_library
+from oryx_tpu.native.store import (
+    TIER_ABSENT,
+    TIER_DISK,
+    TIER_RAM,
+    PyTieredCellStore,
+    TieredHostPlane,
+    configure_tier,
+    tier_config,
+)
+
+
+def _make_store(kind, n_cells, budget, tmp_path):
+    if kind == "native":
+        if get_library() is None:
+            pytest.skip("native library unavailable")
+        from oryx_tpu.native.store import NativeTieredCellStore
+
+        return NativeTieredCellStore(n_cells, budget, str(tmp_path))
+    return PyTieredCellStore(n_cells, budget, str(tmp_path))
+
+
+@pytest.fixture(params=["python", "native"])
+def store_kind(request):
+    return request.param
+
+
+def test_put_read_roundtrip_and_residency(store_kind, tmp_path):
+    st = _make_store(store_kind, 8, 1 << 20, tmp_path)
+    try:
+        gen = np.random.default_rng(0)
+        cells = {c: gen.standard_normal((16, 8)).astype(np.float32) for c in (0, 3, 7)}
+        for c, data in cells.items():
+            st.put_cell(c, data)
+        res = st.residency()
+        assert res[1] == TIER_ABSENT and st.read_cell(1) is None
+        for c in cells:
+            assert res[c] in (TIER_DISK, TIER_RAM)
+        for c, data in cells.items():
+            buf = st.read_cell(c)
+            np.testing.assert_array_equal(
+                buf.view(np.float32).reshape(16, 8), data
+            )
+        # a read promotes: the cell is now warm
+        assert st.residency()[0] == TIER_RAM
+        s = st.stats()
+        assert s["disk_cells"] == 3 and s["ram_cells"] >= 1
+        # rewrite supersedes: the next read sees the new bytes
+        st.put_cell(3, cells[3] * 2.0)
+        np.testing.assert_array_equal(
+            st.read_cell(3).view(np.float32).reshape(16, 8), cells[3] * 2.0
+        )
+    finally:
+        st.close()
+
+
+def test_ram_budget_evicts_lru(store_kind, tmp_path):
+    cell_bytes = 16 * 8 * 4
+    st = _make_store(store_kind, 8, int(cell_bytes * 2.5), tmp_path)
+    try:
+        gen = np.random.default_rng(1)
+        for c in range(6):
+            st.put_cell(c, gen.standard_normal((16, 8)).astype(np.float32))
+        for c in range(6):
+            st.read_cell(c)
+        s = st.stats()
+        assert s["ram_cells"] <= 2
+        assert s["demotions"] >= 4
+        assert s["ram_bytes"] <= int(cell_bytes * 2.5)
+        # the LAST reads stayed; the first were evicted
+        res = st.residency()
+        assert res[5] == TIER_RAM and res[0] == TIER_DISK
+    finally:
+        st.close()
+
+
+def test_prefetch_promotes_async(store_kind, tmp_path):
+    st = _make_store(store_kind, 4, 1 << 20, tmp_path)
+    try:
+        gen = np.random.default_rng(2)
+        for c in range(4):
+            st.put_cell(c, gen.standard_normal((8, 4)).astype(np.float32))
+        st.prefetch(np.array([0, 2], np.int64))
+        deadline = 50
+        while deadline:
+            res = st.residency()
+            if res[0] == TIER_RAM and res[2] == TIER_RAM:
+                break
+            threading.Event().wait(0.02)
+            deadline -= 1
+        assert res[0] == TIER_RAM and res[2] == TIER_RAM
+        assert res[1] == TIER_DISK and res[3] == TIER_DISK
+        # prefetched cells hit without a scan-path miss
+        m0 = st.stats()["misses"]
+        st.read_cell(0)
+        assert st.stats()["misses"] == m0
+        st.drop_ram(0)
+        assert st.residency()[0] == TIER_DISK
+    finally:
+        st.close()
+
+
+def test_configure_tier_roundtrip():
+    snap = tier_config()
+    try:
+        cfg = configure_tier(enabled=True, hot_cells=7, ram_bytes=123, spill_dir="/x")
+        assert cfg["enabled"] and cfg["hot_cells"] == 7
+        assert cfg["ram_bytes"] == 123 and cfg["spill_dir"] == "/x"
+        # None leaves knobs unchanged
+        cfg = configure_tier(hot_cells=9)
+        assert cfg["hot_cells"] == 9 and cfg["ram_bytes"] == 123
+    finally:
+        configure_tier(**snap)
+
+
+def _plane_case(n_cells=6, tiles_per_cell=(2, 1, 3, 1, 2, 1), ts=8, kf=16, seed=3):
+    gen = np.random.default_rng(seed)
+    counts = np.asarray(tiles_per_cell, np.int64)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int64)
+    n_slots = int(counts.sum()) * ts
+    plane = gen.standard_normal((n_slots, kf)).astype(np.float32)
+    cents = gen.standard_normal((kf, n_cells)).astype(np.float32)
+    cnorms = np.linalg.norm(cents, axis=0)
+    return plane, starts, counts, ts, kf, cents, cnorms
+
+
+def test_tiered_plane_gather_matches_flat(tmp_path):
+    plane, starts, counts, ts, kf, cents, cnorms = _plane_case()
+    tp = TieredHostPlane.build(
+        plane,
+        tile_start=starts,
+        tile_count=counts,
+        tile_slots=ts,
+        centroids=cents,
+        centroid_norms=cnorms,
+        hot_cells=2,
+        ram_bytes=1 << 20,
+        spill_dir=str(tmp_path),
+    )
+    try:
+        tl = np.array([0, 3, 9, 4, 0, 7], np.int64)  # repeats + disorder
+        got = tp.gather_tiles(tl)
+        want = np.concatenate([plane[t * ts : (t + 1) * ts] for t in tl.tolist()])
+        np.testing.assert_array_equal(got, want)
+        c, n = tp.routing_arrays()
+        np.testing.assert_array_equal(c, cents)
+        np.testing.assert_array_equal(n, cnorms)
+        assert tp.stats()["hot_cells"] <= 2  # hot LRU bounded
+    finally:
+        tp.close()
+
+
+def test_tiered_plane_prefetch_counters(tmp_path):
+    plane, starts, counts, ts, kf, cents, cnorms = _plane_case(seed=5)
+    tp = TieredHostPlane.build(
+        plane,
+        tile_start=starts,
+        tile_count=counts,
+        tile_slots=ts,
+        centroids=cents,
+        centroid_norms=cnorms,
+        hot_cells=1,
+        ram_bytes=1 << 20,
+        spill_dir=str(tmp_path),
+    )
+    try:
+        hit0 = metrics.registry.counter("serving.store.prefetch.hit").value
+        miss0 = metrics.registry.counter("serving.store.prefetch.miss").value
+        tp.prefetch_cells(np.array([2], np.int64))
+        deadline = 50
+        while deadline and tp._store.residency()[2] != TIER_RAM:
+            threading.Event().wait(0.02)
+            deadline -= 1
+        tp.gather_tiles(np.array([int(starts[2])], np.int64))  # warm -> hit
+        assert metrics.registry.counter("serving.store.prefetch.hit").value > hit0
+        tp.gather_tiles(np.array([int(starts[4])], np.int64))  # cold -> miss
+        assert metrics.registry.counter("serving.store.prefetch.miss").value > miss0
+        # gauges published
+        assert metrics.registry.gauge("serving.store.tier.disk.cells").value >= 1
+    finally:
+        tp.close()
+
+
+def test_attach_tiered_plane_scan_parity(tmp_path):
+    """The IVF scan over a tiered plane is the SAME retrieval: probed and
+    full-probe results bit-identical to the flat host plane's."""
+    from oryx_tpu.ops import ivf as ivf_ops
+
+    snap_knobs = (ivf_ops.HOST_STAGE1,)
+    snap_tier = tier_config()
+    try:
+        ivf_ops.configure_ann(host_stage1=True)
+        gen = np.random.default_rng(7)
+        centers = gen.standard_normal((16, 24)).astype(np.float32)
+        mat = (
+            centers[gen.integers(0, 16, 6_000)]
+            + 0.3 * gen.standard_normal((6_000, 24)).astype(np.float32)
+        ).astype(np.float32)
+        queries = (
+            centers[gen.integers(0, 16, 4)]
+            + 0.3 * gen.standard_normal((4, 24)).astype(np.float32)
+        ).astype(np.float32)
+        flat = ivf_ops.build_ivf(mat, n_cells=16, seed=1)
+        assert flat.host_plane is not None
+        configure_tier(enabled=True, hot_cells=4, ram_bytes=1 << 20,
+                       spill_dir=str(tmp_path))
+        tiered = ivf_ops.attach_tiered_plane(
+            ivf_ops.build_ivf(mat, n_cells=16, seed=1)
+        )
+        assert tiered.tier is not None and tiered.host_plane is None
+        try:
+            for nprobe in (4, 16):
+                fi, fv = ivf_ops.top_k(flat, queries, 10, nprobe=nprobe)
+                ti, tv = ivf_ops.top_k(tiered, queries, 10, nprobe=nprobe)
+                assert np.array_equal(np.asarray(fi), np.asarray(ti))
+                assert np.array_equal(np.asarray(fv), np.asarray(tv))
+            # the advisory prefetch hint warms probed cells
+            hinted = tiered.prefetch_for_queries(queries, nprobe=4)
+            assert hinted >= 0
+        finally:
+            tiered.tier.close()
+    finally:
+        (ivf_ops.HOST_STAGE1,) = snap_knobs
+        configure_tier(**snap_tier)
+
+
+def test_concurrent_readers_and_prefetch(store_kind, tmp_path):
+    """Hammer reads + prefetch + drops from several threads: no torn
+    payloads, counters stay coherent."""
+    st = _make_store(store_kind, 16, 4 * 16 * 8 * 4, tmp_path)
+    try:
+        gen = np.random.default_rng(11)
+        ref = {}
+        for c in range(16):
+            ref[c] = gen.standard_normal((16, 8)).astype(np.float32)
+            st.put_cell(c, ref[c])
+        errs = []
+
+        def reader(seed):
+            r = np.random.default_rng(seed)
+            for _ in range(200):
+                c = int(r.integers(0, 16))
+                buf = st.read_cell(c)
+                if buf is None or not np.array_equal(
+                    buf.view(np.float32).reshape(16, 8), ref[c]
+                ):
+                    errs.append(c)
+
+        def churner():
+            r = np.random.default_rng(99)
+            for _ in range(200):
+                st.prefetch(r.integers(0, 16, 4).astype(np.int64))
+                st.drop_ram(int(r.integers(0, 16)))
+
+        threads = [threading.Thread(target=reader, args=(s,)) for s in range(4)]
+        threads.append(threading.Thread(target=churner))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        s = st.stats()
+        assert s["disk_cells"] == 16
+        assert s["hits"] + s["misses"] == 4 * 200
+    finally:
+        st.close()

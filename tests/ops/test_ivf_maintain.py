@@ -1,0 +1,217 @@
+"""Incremental IVF maintenance (`compact_ivf`): the no-stop-the-world
+compaction the serving maintenance loop runs. Contracts under test:
+
+- retained rows keep their quantized codes verbatim and pending rows
+  requantize from raw values, so a compacted index is BIT-FOR-BIT the
+  index a from-scratch `build_ivf` (seeded with the same centroids)
+  produces over the same item set — at full probe, ids AND score bits;
+- tombstoned slots are garbage-collected by omission;
+- overloaded cells split, starved cells merge, and the full-probe
+  exactness contract survives both;
+- `snapshot_pending` + the `born` clock give the maintainer a stable
+  off-lock view of the overlay and spill queue.
+
+Tier-1 `-m scan` suite: small catalogs, CPU XLA.
+"""
+
+import numpy as np
+import pytest
+
+from oryx_tpu.ops import ivf as ivf_ops
+
+pytestmark = pytest.mark.scan
+
+K = 10
+
+
+@pytest.fixture(autouse=True)
+def _restore_ann_knobs():
+    snap = (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    )
+    yield
+    (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    ) = snap
+
+
+def _case(n=6_000, f=24, b=6, n_centers=24, seed=0, spread=0.3):
+    gen = np.random.default_rng(seed)
+    centers = gen.standard_normal((n_centers, f)).astype(np.float32)
+    mat = (
+        centers[gen.integers(0, n_centers, n)]
+        + spread * gen.standard_normal((n, f)).astype(np.float32)
+    )
+    queries = (
+        centers[gen.integers(0, n_centers, b)]
+        + spread * gen.standard_normal((b, f)).astype(np.float32)
+    )
+    return mat.astype(np.float32), queries.astype(np.float32)
+
+
+def test_compaction_matches_from_scratch_build_bit_for_bit():
+    """Fold new items past the overlay into the spill queue, compact,
+    and compare against `build_ivf` seeded with the compacted centroids
+    over the union catalog: identical full-probe ids AND score bits."""
+    mat, queries = _case(seed=3)
+    gen = np.random.default_rng(4)
+    index = ivf_ops.build_ivf(mat, n_cells=16, seed=7, overlay_capacity=32)
+    new = gen.standard_normal((80, mat.shape[1])).astype(np.float32)
+    ids = np.arange(len(mat), len(mat) + 80)
+    index = ivf_ops.update_rows(index, ids, new)
+    assert index.ov_used == 32 and len(index.pending_spill) == 48
+
+    compacted, stats = ivf_ops.compact_ivf(index, seed=5)
+    assert stats["folded"] == 80 and stats["live"] == len(mat)
+    assert compacted.ov_used == 0 and not compacted.pending_spill
+
+    full = np.vstack([mat, new])
+    feat = compacted.features
+    cents = np.ascontiguousarray(
+        np.asarray(compacted.centroids_t).T[:, :feat]
+    )
+    rebuilt = ivf_ops.build_ivf(
+        full, centroids=cents, overlay_capacity=32
+    )
+    aidx, avals = ivf_ops.top_k(compacted, queries, K, nprobe=compacted.n_cells)
+    bidx, bvals = ivf_ops.top_k(rebuilt, queries, K, nprobe=rebuilt.n_cells)
+    assert np.array_equal(np.asarray(aidx), np.asarray(bidx))
+    assert np.array_equal(np.asarray(avals), np.asarray(bvals))
+
+
+def test_compaction_garbage_collects_tombstones():
+    """Updated rows tombstone their clustered copy; compaction drops the
+    dead slots entirely — each id occupies exactly one live slot and the
+    superseded value never scores again."""
+    mat, queries = _case(seed=9)
+    index = ivf_ops.build_ivf(mat, n_cells=16, seed=2, overlay_capacity=64)
+    touched = np.arange(0, 600, 13)
+    index = ivf_ops.update_rows(index, touched, mat[touched] + 1.0)
+    dead_before = int((np.asarray(index.slot_ids) == -1).sum())
+
+    compacted, _stats = ivf_ops.compact_ivf(index, seed=2)
+    sids = np.asarray(compacted.slot_ids)
+    live = sids[sids >= 0]
+    assert len(live) == len(set(live.tolist())) == len(mat)
+    # the layout shrank by at least the tombstone count (modulo padding)
+    assert int((sids == -1).sum()) <= dead_before
+    # updated values (not the originals) serve from the clustered layout
+    q = mat[touched[0]] / np.linalg.norm(mat[touched[0]])
+    idx, vals = ivf_ops.top_k(
+        compacted, q[None, :].astype(np.float32), K, nprobe=compacted.n_cells
+    )
+    row = list(np.asarray(idx[0]))
+    assert row.count(int(touched[0])) <= 1
+
+
+def test_split_grows_cells_and_keeps_full_probe_exact():
+    mat, queries = _case(n=5_000, n_centers=4, seed=11)
+    index = ivf_ops.build_ivf(mat, n_cells=4, seed=3, overlay_capacity=16)
+    index = ivf_ops.update_rows(
+        index, np.array([len(mat)]), queries[:1].astype(np.float32)
+    )
+    compacted, stats = ivf_ops.compact_ivf(
+        index, seed=4, split_max_items=400, merge_min_items=1
+    )
+    assert stats["splits"] > 0
+    assert compacted.n_cells > 4
+    full = np.vstack([mat, queries[:1]])
+    ref = queries @ full.T
+    idx, _vals = ivf_ops.top_k(compacted, queries, K, nprobe=compacted.n_cells)
+    for r in range(len(queries)):
+        kth = np.partition(ref[r], -K)[-K]
+        rows = np.asarray(idx[r])
+        assert (ref[r][rows] >= kth - 1e-4).all()
+
+
+def test_merge_dissolves_starved_cells():
+    """Cells starved below the merge floor dissolve into survivors; the
+    members reassign to their nearest surviving centroid and stay
+    retrievable."""
+    gen = np.random.default_rng(21)
+    f = 16
+    blob = gen.standard_normal(f).astype(np.float32)
+    mat = np.concatenate(
+        [
+            np.tile(blob, (3_000, 1))
+            + 0.1 * gen.standard_normal((3_000, f)).astype(np.float32),
+            # a handful of outliers: their cells starve
+            5.0 * gen.standard_normal((6, f)).astype(np.float32),
+        ]
+    ).astype(np.float32)
+    index = ivf_ops.build_ivf(mat, n_cells=12, seed=6, overlay_capacity=16)
+    index = ivf_ops.update_rows(index, np.array([0]), mat[0:1] + 0.01)
+    compacted, stats = ivf_ops.compact_ivf(
+        index, seed=6, merge_min_items=4, split_max_items=10_000_000
+    )
+    assert stats["merges"] > 0
+    assert compacted.n_cells < 12
+    # every outlier still retrievable at full probe
+    for j in range(3_000, 3_006):
+        q = (mat[j] / np.linalg.norm(mat[j]))[None, :].astype(np.float32)
+        idx, _ = ivf_ops.top_k(compacted, q, 1, nprobe=compacted.n_cells)
+        assert int(idx[0, 0]) == j
+
+
+def test_snapshot_pending_is_a_stable_copy():
+    mat, _ = _case(n=3_000, seed=15)
+    index = ivf_ops.build_ivf(mat, n_cells=8, seed=8, overlay_capacity=8)
+    ids = np.arange(len(mat), len(mat) + 12)
+    vals = np.random.default_rng(1).standard_normal(
+        (12, mat.shape[1])
+    ).astype(np.float32)
+    index = ivf_ops.update_rows(index, ids, vals)
+    snap = ivf_ops.snapshot_pending(index)
+    assert set(snap.ids.tolist()) == set(ids.tolist())
+    assert set(snap.born) == set(ids.tolist())
+    # mutating the live index after the snapshot must not leak into it
+    before = snap.raw.copy()
+    ivf_ops.update_rows(index, ids[:3], vals[:3] * 9.0)
+    assert np.array_equal(snap.raw, before)
+
+
+def test_needs_maintenance_watermark_and_spill():
+    mat, _ = _case(n=3_000, seed=17)
+    index = ivf_ops.build_ivf(mat, n_cells=8, seed=9, overlay_capacity=8)
+    assert not ivf_ops.needs_maintenance(index)
+    index = ivf_ops.update_rows(
+        index, np.array([len(mat)]), mat[:1].astype(np.float32)
+    )
+    assert not ivf_ops.needs_maintenance(index, watermark=0.5)
+    assert ivf_ops.needs_maintenance(index, watermark=0.01)
+    ids = np.arange(len(mat), len(mat) + 10)
+    index = ivf_ops.update_rows(
+        index, ids, np.tile(mat[:1], (10, 1)).astype(np.float32)
+    )
+    assert index.pending_spill  # overflowed
+    assert ivf_ops.needs_maintenance(index, watermark=0.99)
+
+
+def test_capacity_counts_free_overlay_slots():
+    mat, _ = _case(n=2_000, seed=19)
+    index = ivf_ops.build_ivf(mat, n_cells=8, seed=1, overlay_capacity=16)
+    from oryx_tpu.ops import topn as topn_ops
+
+    assert topn_ops.capacity(index) == len(mat) + 16
+    index = ivf_ops.update_rows(
+        index,
+        np.arange(len(mat), len(mat) + 4),
+        mat[:4].astype(np.float32),
+    )
+    assert topn_ops.capacity(index) == index.n_items + 12

@@ -250,15 +250,49 @@ def test_update_rows_visible_through_ann():
     assert list(np.asarray(idx[0])).count(4321) == 1
 
 
-def test_overlay_overflow_raises():
+def test_overlay_overflow_spills_oldest():
+    """Overlay exhaustion is no longer an error: the OLDEST entry moves
+    to the pending-spill queue (invisible until the maintenance loop
+    compacts it) and its slot serves the new fold-in. No request-path
+    re-cluster, no exception."""
     mat, _ = _clustered_case(n=4_000, f=16, b=1, seed=19)
     index = ivf_ops.build_ivf(mat, n_cells=16, seed=7, overlay_capacity=8)
     rows = np.arange(8)
     index = ivf_ops.update_rows(index, rows, mat[rows] + 0.5)
-    with pytest.raises(ivf_ops.IVFOverlayFull):
-        ivf_ops.update_rows(index, np.array([100]), mat[100:101] + 0.5)
-    # rewriting already-overlaid rows needs no fresh slots: still fine
-    ivf_ops.update_rows(index, rows[:4], mat[rows[:4]] + 1.0)
+    assert index.ov_used == 8 and not index.pending_spill
+    index = ivf_ops.update_rows(index, np.array([100]), mat[100:101] + 0.5)
+    # row 0 (the oldest fold-in) spilled; 100 took its slot
+    assert set(index.pending_spill) == {0}
+    assert 100 in index.ov_map and 0 not in index.ov_map
+    np.testing.assert_allclose(
+        index.pending_spill[0][0][:16], mat[0] + 0.5, rtol=1e-6
+    )
+    # rewriting already-overlaid rows needs no fresh slots: no new spill
+    index = ivf_ops.update_rows(index, rows[1:4], mat[rows[1:4]] + 1.0)
+    assert set(index.pending_spill) == {0}
+    # re-updating a SPILLED id supersedes the spilled value: it comes
+    # back to the overlay (evicting the then-oldest entry)
+    index = ivf_ops.update_rows(index, np.array([0]), mat[0:1] + 2.0)
+    assert 0 in index.ov_map and 0 not in index.pending_spill
+
+
+def test_overlay_batch_larger_than_capacity_spills_directly():
+    """One fold-in batch bigger than the whole overlay: the first `cap`
+    rows take slots, the rest spill directly from their raw values —
+    the eviction path must not starve on its own batch."""
+    mat, _ = _clustered_case(n=4_000, f=16, b=1, seed=19)
+    index = ivf_ops.build_ivf(mat, n_cells=16, seed=7, overlay_capacity=8)
+    rows = np.arange(20)
+    index = ivf_ops.update_rows(index, rows, mat[rows] + 0.5)
+    assert index.ov_used == 8
+    assert len(index.pending_spill) == 12
+    assert set(index.ov_map) | set(index.pending_spill) == set(range(20))
+    for item, (raw, _born) in index.pending_spill.items():
+        np.testing.assert_allclose(raw[:16], mat[item] + 0.5, rtol=1e-6)
+    # every updated row's base copy is tombstoned — spilled rows are
+    # invisible (not stale): full-probe results never return old values
+    sids = np.asarray(index.slot_ids)
+    assert not np.isin(rows, sids).any()
 
 
 def test_host_and_device_stage1_agree():

@@ -90,6 +90,49 @@ def test_tracker_fast_paths():
     assert tracker.filter_block(no_models) is no_models
 
 
+def test_tracker_index_ref_tracking_and_dedup():
+    """INDEX-REF (ANN index generations, serving/maintain.py) rides the
+    same topic: tracked into live_index_generation + /healthz, and an
+    at-least-once redelivery of the live one is swallowed so replicas
+    never rebuild the same clustering twice."""
+    health = ServingHealth()
+    tracker = GenerationTracker(health)
+    first = tracker.filter_block(
+        block(KeyMessage("INDEX-REF", "/m/model/index/1700000000123"))
+    )
+    assert first is not None and len(first) == 1
+    assert tracker.live_index_generation == "1700000000123"
+    assert health.live_index_generation == "1700000000123"
+
+    # duplicate delivery of the live index generation is swallowed
+    assert (
+        tracker.filter_block(
+            block(KeyMessage("INDEX-REF", "/m/model/index/1700000000123"))
+        )
+        is None
+    )
+    # a NEWER index generation passes and becomes live
+    newer = tracker.filter_block(
+        block(KeyMessage("INDEX-REF", "/m/model/index/1700000000456"))
+    )
+    assert newer is not None and len(newer) == 1
+    assert tracker.live_index_generation == "1700000000456"
+
+    # index tracking is independent of MODEL tracking
+    tracker.filter_block(block(KeyMessage("MODEL", model_message("100"))))
+    assert tracker.live_generation == "100"
+    assert tracker.live_index_generation == "1700000000456"
+
+    # mixed block: the duplicate INDEX-REF drops, the UP records pass
+    mixed = block(
+        KeyMessage("UP", "delta-1"),
+        KeyMessage("INDEX-REF", "/m/model/index/1700000000456"),
+        KeyMessage("UP", "delta-2"),
+    )
+    out = tracker.filter_block(mixed)
+    assert [km.key for km in out.iter_key_messages()] == ["UP", "UP"]
+
+
 # --- two-generation (online experiment) mode --------------------------------
 
 

@@ -1,0 +1,397 @@
+"""Always-fresh ANN maintenance at the serving level: the background
+`IndexMaintainer` compacts the speed-layer overlay + spill queue off the
+request path (no fold-in ever triggers a full re-cluster on a watch),
+install replays racing fold-ins, index generations round-trip through
+the registry layout, and replicas adopt a published clustering with one
+pointer swap. `oryx.serving.scan.ann.maintain.*` and
+`oryx.serving.store.tier.*` config blocks reach their knobs."""
+
+import time
+
+import numpy as np
+import pytest
+
+from oryx_tpu.app.als.serving_model import ALSServingModel
+from oryx_tpu.common import config as C
+from oryx_tpu.common import metrics
+from oryx_tpu.native.store import configure_tier, tier_config
+from oryx_tpu.ops import ivf as ivf_ops
+from oryx_tpu.serving import maintain as M
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs():
+    ann = (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    )
+    mnt = (
+        M.MAINTAIN_ENABLED,
+        M.MAINTAIN_INTERVAL_SEC,
+        M.MAINTAIN_WATERMARK,
+        M.MAINTAIN_SPLIT_MAX_ITEMS,
+        M.MAINTAIN_MERGE_MIN_ITEMS,
+        M.MAINTAIN_PUBLISH,
+    )
+    tier = tier_config()
+    yield
+    (
+        ivf_ops.ANN_ENABLED,
+        ivf_ops.N_CELLS,
+        ivf_ops.NPROBE,
+        ivf_ops.PROBE_FRACTION,
+        ivf_ops.MIN_ITEMS,
+        ivf_ops.OVERLAY_CAPACITY,
+        ivf_ops.QUERY_BLOCK,
+        ivf_ops.TILE_CHUNKS,
+        ivf_ops.HOST_STAGE1,
+    ) = ann
+    (
+        M.MAINTAIN_ENABLED,
+        M.MAINTAIN_INTERVAL_SEC,
+        M.MAINTAIN_WATERMARK,
+        M.MAINTAIN_SPLIT_MAX_ITEMS,
+        M.MAINTAIN_MERGE_MIN_ITEMS,
+        M.MAINTAIN_PUBLISH,
+    ) = mnt
+    configure_tier(**tier)
+
+
+F = 8
+
+
+def _model(n=500, seed=0):
+    gen = np.random.default_rng(seed)
+    m = ALSServingModel(F, implicit=True, refresh_sec=0.0, score_dtype="int8")
+    m.set_item_vectors(
+        [f"i{j}" for j in range(n)],
+        gen.standard_normal((n, F)).astype(np.float32),
+    )
+    return m
+
+
+def _warm(m):
+    q = np.zeros(F, np.float32)
+    q[0] = 1.0
+    m.top_n(q, 3)
+    idx = m._ensure_y_matrix()[2]
+    assert isinstance(idx, ivf_ops.IVFIndex)
+    return q
+
+
+def test_config_blocks_reach_maintain_and_tier_knobs():
+    from oryx_tpu.serving.layer import ServingLayer
+
+    cfg = C.get_default().with_overlay(
+        """
+        oryx {
+          input-topic.broker = "inproc://maintain-cfg"
+          update-topic.broker = "inproc://maintain-cfg"
+          serving {
+            api.port = 0
+            model-manager-class = "oryx_tpu.app.als.serving_model:ALSServingModelManager"
+            application-resources = "oryx_tpu.app.als.endpoints"
+            scan.ann.maintain {
+              enabled = true
+              interval-sec = 0.5
+              watermark = 0.25
+              split-max-items = 777
+              merge-min-items = 3
+              publish = true
+            }
+            store.tier {
+              enabled = true
+              hot-cells = 11
+              ram-mb = 64
+              spill-dir = "/tmp/oryx-tier-test"
+            }
+          }
+        }
+        """
+    )
+    ServingLayer(cfg)  # construction alone applies the knobs
+    assert M.MAINTAIN_ENABLED is True
+    assert M.MAINTAIN_INTERVAL_SEC == pytest.approx(0.5)
+    assert M.MAINTAIN_WATERMARK == pytest.approx(0.25)
+    assert M.MAINTAIN_SPLIT_MAX_ITEMS == 777
+    assert M.MAINTAIN_MERGE_MIN_ITEMS == 3
+    assert M.MAINTAIN_PUBLISH is True
+    tier = tier_config()
+    assert tier["enabled"] is True
+    assert tier["hot_cells"] == 11
+    assert tier["ram_bytes"] == 64 << 20
+    assert tier["spill_dir"] == "/tmp/oryx-tier-test"
+
+
+def test_maintainer_compacts_and_reports_freshness():
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    maint = M.IndexMaintainer(lambda: m, watermark=0.5)
+    maint._hook_model(m)
+    gen = np.random.default_rng(1)
+    m.set_item_vectors(
+        [f"new{j}" for j in range(24)],  # 16 overlay + 8 spill
+        gen.standard_normal((24, F)).astype(np.float32),
+    )
+    m.top_n(q, 3)
+    idx = m._ensure_y_matrix()[2]
+    assert idx.ov_used == 16 and len(idx.pending_spill) == 8
+
+    c0 = metrics.registry.counter("serving.ann.maintain.compactions").value
+    stats = maint.run_once()  # NOT forced: the spill makes it due
+    assert stats is not None and stats["folded"] == 24
+    after = m._ensure_y_matrix()[2]
+    assert after.ov_used == 0 and not after.pending_spill
+    assert metrics.registry.counter("serving.ann.maintain.compactions").value == c0 + 1
+    lag = metrics.registry.gauge(M.FRESHNESS_GAUGE).value
+    assert lag is not None and 0.0 <= lag < 60.0
+    # nothing pending now: the next pass is a no-op
+    assert maint.run_once() is None
+
+
+def test_fold_in_hammer_stays_on_request_budget():
+    """Satellite regression: hammer fold-ins far past the overlay
+    capacity with the maintainer attached — not one request may fall
+    back to a full re-cluster, and no request blows the p99 budget
+    relative to the no-fold baseline."""
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    maint = M.IndexMaintainer(lambda: m)
+    maint._hook_model(m)
+    woke = []
+    m.set_index_pressure_callback(lambda: woke.append(1))
+
+    # baseline: steady-state query latency with no fold-in churn
+    base = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        m.top_n(q, 3)
+        base.append(time.perf_counter() - t0)
+    budget = max(1.0, 30.0 * float(np.median(base)))
+
+    gen = np.random.default_rng(2)
+    ep0 = m._y_build_epoch
+    lat = []
+    for r in range(40):  # 200 fold-ins through a 16-slot overlay
+        m.set_item_vectors(
+            [f"h{r}_{j}" for j in range(5)],
+            gen.standard_normal((5, F)).astype(np.float32),
+        )
+        t0 = time.perf_counter()
+        res = m.top_n(q, 3)
+        lat.append(time.perf_counter() - t0)
+        assert len(res) == 3
+    assert m._y_build_epoch == ep0  # zero request-path re-clusters
+    assert woke  # overlay pressure woke the maintainer
+    lat.sort()
+    assert lat[int(0.99 * len(lat))] <= budget
+    # the maintainer drains what the hammer left behind
+    assert maint.run_once(force=True)["folded"] == 200
+    after = m._ensure_y_matrix()[2]
+    assert after.ov_used == 0 and not after.pending_spill
+
+
+def test_install_discarded_when_full_rebuild_races():
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    m.set_item_vectors(["x0"], np.ones((1, F), np.float32))
+    m.top_n(q, 3)
+    work = m.maintenance_snapshot(force=True)
+    assert work is not None
+    index, snap = work
+    new_index, stats = ivf_ops.compact_ivf(index, snap)
+    # a rotation-triggered full rebuild lands while compaction ran
+    m.retain_recent_and_item_ids({f"i{j}" for j in range(400)})
+    m.top_n(q, 3)
+    assert m.install_compacted(new_index, stats) is False
+
+
+def test_install_replays_racing_fold_ins():
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    m.set_item_vectors(["pre"], np.ones((1, F), np.float32))
+    m.top_n(q, 3)
+    work = m.maintenance_snapshot(force=True)
+    assert work is not None
+    index, snap = work
+    new_index, stats = ivf_ops.compact_ivf(index, snap)
+    # a fold-in racing the compaction: must survive the swap
+    racer = (7.0 * q).astype(np.float32)
+    m.set_item_vector("racer", racer)
+    m.top_n(q, 3)
+    assert m.install_compacted(new_index, stats) is True
+    assert stats.get("replayed", 0) >= 1
+    res = m.top_n(q, 1)
+    assert res[0][0] == "racer"
+    # the pre-snapshot fold-in is served from the compacted layout
+    idx = m._ensure_y_matrix()[2]
+    assert m._y_index["pre"] not in idx.ov_map or idx.ov_used <= 1
+
+
+def test_index_generation_roundtrip_and_replica_adoption(tmp_path):
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    gen = np.random.default_rng(3)
+    hot = gen.standard_normal((20, F)).astype(np.float32)
+    m.set_item_vectors([f"hot{j}" for j in range(20)], hot)
+    m.top_n(q, 3)
+    maint = M.IndexMaintainer(lambda: m)
+    stats = maint.run_once(force=True)
+    idx = m._ensure_y_matrix()[2]
+
+    ref = M.write_index_generation(str(tmp_path), idx, stats=stats)
+    loaded = M.read_index_generation(ref)
+    assert loaded is not None
+    gid, manifest, cents = loaded
+    assert manifest["n_cells"] == idx.n_cells
+    assert manifest["features"] == F
+    assert manifest["compaction"]["folded"] == stats["folded"]
+    np.testing.assert_array_equal(
+        cents, np.asarray(idx.centroids_t).T[:, :F]
+    )
+    assert M.read_index_generation(str(tmp_path / "nope")) is None
+
+    # a replica with the same item store adopts the clustering
+    m2 = ALSServingModel(F, implicit=True, refresh_sec=0.0, score_dtype="int8")
+    ids, mat = m.y.to_matrix()
+    m2.set_item_vectors(ids, np.asarray(mat, np.float32))
+    assert m2.apply_index_generation(ref) is True
+    assert m2.index_generation == gid
+    assert m2.apply_index_generation(ref) is False  # duplicate delivery
+    i2 = m2._ensure_y_matrix()[2]
+    np.testing.assert_array_equal(
+        np.asarray(i2.centroids_t), np.asarray(idx.centroids_t)
+    )
+    # the adopted layout answers like the publisher's
+    probe = hot[4] / np.linalg.norm(hot[4])
+    a = [i for i, _ in m.top_n(probe.astype(np.float32), 5)]
+    b = [i for i, _ in m2.top_n(probe.astype(np.float32), 5)]
+    assert a == b
+
+
+def test_maintainer_publishes_and_dedups_self_delivery(tmp_path):
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=16
+    )
+    m = _model()
+    q = _warm(m)
+    m.set_item_vectors(["p0"], np.ones((1, F), np.float32))
+    m.top_n(q, 3)
+    refs = []
+
+    def publish(index, stats):
+        ref = M.write_index_generation(str(tmp_path), index, stats=stats)
+        refs.append(ref)
+        return ref
+
+    maint = M.IndexMaintainer(lambda: m, publish_fn=publish)
+    assert maint.run_once(force=True) is not None
+    assert maint.published == 1 and len(refs) == 1
+    # self-delivery of our own INDEX-REF is a no-op on the publisher
+    assert m.index_generation is not None
+    assert m.apply_index_generation(refs[0]) is False
+
+
+@pytest.mark.fleet
+def test_fleet_adopts_index_generation_with_zero_failed_requests(tmp_path):
+    """3-replica fleet under request load while an INDEX-REF (and a
+    duplicate redelivery) rides the shared update topic: every replica's
+    tracker adopts the index generation, the duplicate is suppressed,
+    and not one request fails across the swap window."""
+    import sys
+    import urllib.request
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+    from fleet import UPDATE_TOPIC, FleetHarness
+
+    from oryx_tpu import bus
+
+    with FleetHarness(3, str(tmp_path), bus_name="fleet-index") as fleet:
+        first = fleet.publish(metric=0.9)
+        assert fleet.wait_converged(first, timeout=15.0)
+
+        failures = []
+
+        def hit(i):
+            url = f"{fleet.targets[i % 3].base_url}/probe/recommend/u{i}"
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    if resp.status != 200:
+                        failures.append((i, resp.status))
+            except Exception as e:  # noqa: BLE001 - any failure counts
+                failures.append((i, repr(e)))
+
+        gid = "1700000000123"
+        ref = f"{fleet.model_dir}/index/{gid}"
+        broker = bus.get_broker(fleet.inner_locator)
+        with broker.producer(UPDATE_TOPIC) as producer:
+            for i in range(60):
+                hit(i)
+                if i == 20:
+                    producer.send("INDEX-REF", ref)
+                if i == 40:  # at-least-once redelivery
+                    producer.send("INDEX-REF", ref)
+
+        assert not failures, failures[:5]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not all(
+            layer.generation_tracker.live_index_generation == gid
+            for layer in fleet.replicas
+        ):
+            time.sleep(0.05)
+        for i, layer in enumerate(fleet.replicas):
+            assert layer.generation_tracker.live_index_generation == gid, i
+            # the model swap machinery was untouched by INDEX-REF records
+            assert layer.health.live_generation == first, i
+        # traffic still clean after the swap settled
+        for i in range(60, 90):
+            hit(i)
+        assert not failures, failures[:5]
+
+
+def test_maintainer_loop_runs_in_background():
+    ivf_ops.configure_ann(
+        enabled=True, min_items=400, cells=16, nprobe=16, overlay_capacity=8
+    )
+    m = _model()
+    q = _warm(m)
+    maint = M.IndexMaintainer(lambda: m, interval_sec=30.0)
+    maint.start()
+    try:
+        gen = np.random.default_rng(5)
+        m.set_item_vectors(
+            [f"bg{j}" for j in range(12)],  # past capacity: spills + wakes
+            gen.standard_normal((12, F)).astype(np.float32),
+        )
+        m.top_n(q, 3)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and maint.compactions == 0:
+            time.sleep(0.05)
+        assert maint.compactions >= 1  # pressure wake-up, not the interval
+        idx = m._ensure_y_matrix()[2]
+        assert not idx.pending_spill
+    finally:
+        maint.close()
